@@ -1,0 +1,49 @@
+"""kcmc_tpu — TPU-native keypoint-consensus motion correction.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of the
+reference `keypoint-consensus-motion-correction` pipeline (see
+SURVEY.md; the reference repo was unavailable, so parity targets come
+from BASELINE.json's `north_star`/`configs`): per-frame keypoint
+detection + description, KNN descriptor matching against a reference
+frame, RANSAC consensus transform estimation (translation / rigid /
+affine / homography / piecewise-rigid / 3D rigid), and bilinear frame
+warping — all as `vmap`-batched, statically-shaped kernels over
+(frames × hypotheses), sharded across the TPU ICI mesh.
+
+Public API mirrors the reference's plugin seam:
+
+    from kcmc_tpu import MotionCorrector
+    mc = MotionCorrector(model="translation", backend="jax")
+    result = mc.correct(stack)
+"""
+
+__version__ = "0.1.0"
+
+from kcmc_tpu.models import MODELS, TransformModel, apply_transform, get_model
+
+__all__ = [
+    "MODELS",
+    "TransformModel",
+    "apply_transform",
+    "get_model",
+    "__version__",
+]
+
+
+def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only use
+    try:
+        if name in ("MotionCorrector", "CorrectionResult"):
+            from kcmc_tpu import corrector
+
+            return getattr(corrector, name)
+        if name in ("available_backends", "get_backend", "register_backend"):
+            import kcmc_tpu.backends as _b
+
+            return getattr(_b, name)
+        if name == "CorrectorConfig":
+            from kcmc_tpu.config import CorrectorConfig
+
+            return CorrectorConfig
+    except ImportError as e:  # PEP 562: attribute access must raise AttributeError
+        raise AttributeError(f"kcmc_tpu.{name} is unavailable: {e}") from e
+    raise AttributeError(f"module 'kcmc_tpu' has no attribute {name!r}")

@@ -1,0 +1,63 @@
+"""Execution-backend plugin registry.
+
+The load-bearing seam of the reference architecture (SURVEY.md §1: the
+orchestrator stays backend-agnostic; `backend=` selects the kernel
+implementations). Backends register themselves under a string name; the
+`MotionCorrector` looks them up here.
+
+Built-in backends:
+
+* ``"jax"`` — the TPU-native path (XLA-jitted, vmapped, Pallas warp).
+* ``"numpy"`` — pure-NumPy mirror of the same algorithm, used for the
+  judged CPU-parity comparison and as the oracle in tests.
+
+Third-party backends can call :func:`register_backend` directly.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_backend(name: str):
+    """Class decorator: register an execution backend under `name`."""
+
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def _ensure_builtins() -> None:
+    # Import for side effect: the modules self-register. Lazy so that
+    # `import kcmc_tpu` stays cheap and numpy-only users never pay JAX
+    # import cost (and vice versa).
+    import importlib
+
+    for mod in ("kcmc_tpu.backends.jax_backend", "kcmc_tpu.backends.numpy_backend"):
+        try:
+            importlib.import_module(mod)
+        except ImportError:
+            pass
+
+
+def available_backends() -> list[str]:
+    _ensure_builtins()
+    return sorted(_REGISTRY)
+
+
+def get_backend(name: str, config, **options):
+    """Instantiate the backend registered under `name`.
+
+    `options` are backend-specific (e.g. `mesh=` for the jax backend).
+    """
+    if name not in _REGISTRY:
+        _ensure_builtins()
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown backend {name!r}; available: {available_backends()}"
+        )
+    return _REGISTRY[name](config, **options)

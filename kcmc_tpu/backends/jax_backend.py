@@ -1,0 +1,273 @@
+"""The TPU-native execution backend: one jitted, vmapped batch program.
+
+This is the heart of the framework (BASELINE.json north star): detect ->
+describe -> match -> RANSAC -> warp compiled as a *single* XLA program
+over a (B, H, W) frame batch. The reference-frame descriptors are
+computed once and closed over as constants of the batch step; RANSAC
+keys are folded from the global frame index so results are independent
+of batch boundaries and reproducible across runs, devices, and chunk
+sizes.
+
+Multi-device execution wraps this same per-batch program in shard_map
+(kcmc_tpu.parallel), sharding the batch axis over the mesh — the batch
+program itself is mesh-agnostic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kcmc_tpu.backends import register_backend
+from kcmc_tpu.config import CorrectorConfig
+from kcmc_tpu.models import get_model
+from kcmc_tpu.ops import piecewise as pw
+from kcmc_tpu.ops.describe import describe_keypoints
+from kcmc_tpu.ops.detect import detect_keypoints
+from kcmc_tpu.ops.match import knn_match
+from kcmc_tpu.ops.ransac import ransac_estimate
+from kcmc_tpu.ops.warp import warp_frame, warp_frame_flow, warp_volume
+
+
+@register_backend("jax")
+class JaxBackend:
+    """XLA-compiled pipeline; runs on TPU (or any JAX backend)."""
+
+    name = "jax"
+
+    def __init__(self, config: CorrectorConfig, mesh=None, **_options):
+        self.config = config
+        self.mesh = mesh  # jax.sharding.Mesh: shard frame batches over it
+        self._batch_fns: dict[Any, Any] = {}
+
+    # -- reference preparation --------------------------------------------
+
+    def prepare_reference(self, ref_frame: np.ndarray) -> dict:
+        cfg = self.config
+        frame = jnp.asarray(ref_frame, jnp.float32)
+        if frame.ndim == 2:
+            kps = detect_keypoints(
+                frame,
+                max_keypoints=cfg.max_keypoints,
+                threshold=cfg.detect_threshold,
+                nms_size=cfg.nms_size,
+                border=cfg.border,
+                harris_k=cfg.harris_k,
+            )
+            desc = describe_keypoints(
+                frame, kps, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
+            )
+            return {"xy": kps.xy, "desc": desc, "valid": kps.valid}
+        from kcmc_tpu.ops.detect3d import detect_keypoints_3d
+        from kcmc_tpu.ops.describe3d import describe_keypoints_3d
+
+        kps = detect_keypoints_3d(
+            frame,
+            max_keypoints=cfg.max_keypoints,
+            threshold=cfg.detect_threshold,
+            border=min(cfg.border, min(frame.shape) // 4),
+        )
+        desc = describe_keypoints_3d(frame, kps, blur_sigma=cfg.blur_sigma)
+        return {"xy": kps.xy, "desc": desc, "valid": kps.valid}
+
+    # -- batch processing --------------------------------------------------
+
+    def process_batch(
+        self, frames: np.ndarray, ref: dict, frame_indices: np.ndarray
+    ) -> dict:
+        """Register+correct a (B, ...) batch against the prepared reference.
+
+        Returns host numpy arrays: transforms/fields, corrected frames,
+        per-frame diagnostics.
+        """
+        cfg = self.config
+        shape = tuple(frames.shape[1:])
+        fn = self._get_batch_fn(shape)
+        frames_j = jnp.asarray(frames, jnp.float32)
+        idx_j = jnp.asarray(frame_indices, jnp.uint32)
+        if self.mesh is not None:
+            from kcmc_tpu.parallel.sharded import shard_frames
+
+            frames_j = shard_frames(frames_j, self.mesh)
+            idx_j = shard_frames(idx_j, self.mesh)
+        out = fn(frames_j, ref["xy"], ref["desc"], ref["valid"], idx_j)
+        return jax.tree.map(np.asarray, out)
+
+    def _get_batch_fn(self, shape):
+        key = (shape, self.config)
+        if key not in self._batch_fns:
+            self._batch_fns[key] = self._build_batch_fn(shape)
+        return self._batch_fns[key]
+
+    def _build_batch_fn(self, shape):
+        cfg = self.config
+        is_3d = len(shape) == 3
+        if cfg.model == "piecewise":
+            per_frame = self._make_piecewise_per_frame(shape)
+        elif is_3d:
+            per_frame = self._make_matrix_per_frame_3d(shape)
+        else:
+            per_frame = self._make_matrix_per_frame(shape)
+
+        base_key = jax.random.key(cfg.seed)
+
+        if self.mesh is not None:
+            from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
+
+            return make_sharded_batch_fn(per_frame, self.mesh, base_key)
+
+        @jax.jit
+        def batch_fn(frames, ref_xy, ref_desc, ref_valid, frame_indices):
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(frame_indices)
+            return jax.vmap(
+                lambda f, k: per_frame(f, ref_xy, ref_desc, ref_valid, k)
+            )(frames, keys)
+
+        return batch_fn
+
+    def _detect_describe_match(self, cfg):
+        def stage(frame, ref_xy, ref_desc, ref_valid):
+            kps = detect_keypoints(
+                frame,
+                max_keypoints=cfg.max_keypoints,
+                threshold=cfg.detect_threshold,
+                nms_size=cfg.nms_size,
+                border=cfg.border,
+                harris_k=cfg.harris_k,
+            )
+            desc = describe_keypoints(
+                frame, kps, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
+            )
+            m = knn_match(
+                desc,
+                ref_desc,
+                kps.valid,
+                ref_valid,
+                ratio=cfg.ratio,
+                max_dist=cfg.max_hamming,
+                mutual=cfg.mutual,
+            )
+            # Correspondences: reference keypoint position -> frame position.
+            src = ref_xy[m.idx]
+            dst = kps.xy
+            return src, dst, m.valid, kps
+
+        return stage
+
+    def _make_matrix_per_frame(self, shape):
+        cfg = self.config
+        model = get_model(cfg.model)
+        stage = self._detect_describe_match(cfg)
+
+        def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
+            src, dst, valid, kps = stage(frame, ref_xy, ref_desc, ref_valid)
+            res = ransac_estimate(
+                model,
+                src,
+                dst,
+                valid,
+                key,
+                n_hypotheses=cfg.n_hypotheses,
+                threshold=cfg.inlier_threshold,
+                refine_iters=cfg.refine_iters,
+            )
+            corrected = warp_frame(frame, res.transform)
+            return {
+                "transform": res.transform,
+                "corrected": corrected,
+                "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
+                "n_matches": jnp.sum(valid).astype(jnp.int32),
+                "n_inliers": res.n_inliers,
+                "rms_residual": res.rms_residual,
+            }
+
+        return per_frame
+
+    def _make_piecewise_per_frame(self, shape):
+        cfg = self.config
+        stage = self._detect_describe_match(cfg)
+
+        def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
+            src, dst, valid, kps = stage(frame, ref_xy, ref_desc, ref_valid)
+            res = pw.estimate_field(
+                src,
+                dst,
+                valid,
+                key,
+                grid=cfg.patch_grid,
+                shape=shape,
+                n_global_hyps=cfg.n_hypotheses,
+                patch_hyps=cfg.patch_hypotheses,
+                global_threshold=cfg.global_threshold,
+                patch_threshold=cfg.inlier_threshold,
+                prior=cfg.patch_prior,
+                smooth_sigma=cfg.field_smooth_sigma,
+            )
+            corrected = warp_frame_flow(frame, res.flow)
+            return {
+                "field": res.field,
+                "corrected": corrected,
+                "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
+                "n_matches": jnp.sum(valid).astype(jnp.int32),
+                "n_inliers": res.n_inliers,
+                "rms_residual": res.rms_residual,
+            }
+
+        return per_frame
+
+    def _make_matrix_per_frame_3d(self, shape):
+        cfg = self.config
+        from kcmc_tpu.ops.detect3d import detect_keypoints_3d
+        from kcmc_tpu.ops.describe3d import describe_keypoints_3d
+        from kcmc_tpu.ops.match import knn_match as km
+
+        model = get_model(cfg.model)
+        if model.ndim != 3:
+            raise ValueError(
+                f"3D stacks require a 3D model (rigid3d), got {cfg.model!r}"
+            )
+
+        def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
+            kps = detect_keypoints_3d(
+                frame,
+                max_keypoints=cfg.max_keypoints,
+                threshold=cfg.detect_threshold,
+                border=min(cfg.border, min(shape) // 4),
+            )
+            desc = describe_keypoints_3d(frame, kps, blur_sigma=cfg.blur_sigma)
+            m = km(
+                desc,
+                ref_desc,
+                kps.valid,
+                ref_valid,
+                ratio=cfg.ratio,
+                max_dist=cfg.max_hamming,
+                mutual=cfg.mutual,
+            )
+            src = ref_xy[m.idx]
+            dst = kps.xy
+            res = ransac_estimate(
+                model,
+                src,
+                dst,
+                m.valid,
+                key,
+                n_hypotheses=cfg.n_hypotheses,
+                threshold=cfg.inlier_threshold,
+                refine_iters=cfg.refine_iters,
+            )
+            corrected = warp_volume(frame, res.transform)
+            return {
+                "transform": res.transform,
+                "corrected": corrected,
+                "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
+                "n_matches": jnp.sum(m.valid).astype(jnp.int32),
+                "n_inliers": res.n_inliers,
+                "rms_residual": res.rms_residual,
+            }
+
+        return per_frame

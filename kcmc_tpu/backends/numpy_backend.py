@@ -1,0 +1,163 @@
+"""Pure-NumPy CPU backend — the parity oracle.
+
+Implements the same backend protocol as the JAX backend using the
+kernels in `_np_kernels`. This is the "CPU backend" of the judged
+accuracy metric (BASELINE.md: transform-RMSE parity vs CPU): both
+backends implement the identical algorithm, so their recovered
+transforms agree to registration accuracy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kcmc_tpu.backends import register_backend
+from kcmc_tpu.backends import _np_kernels as K
+from kcmc_tpu.config import CorrectorConfig
+
+
+@register_backend("numpy")
+class NumpyBackend:
+    name = "numpy"
+
+    def __init__(self, config: CorrectorConfig, **_options):
+        self.config = config
+        if config.model == "rigid3d":
+            raise NotImplementedError(
+                "numpy backend: 3D volumetric path not yet implemented; "
+                "use backend='jax'"
+            )
+
+    def prepare_reference(self, ref_frame: np.ndarray) -> dict:
+        cfg = self.config
+        if ref_frame.ndim != 2:
+            raise NotImplementedError("numpy backend supports 2D frames")
+        xy, score, valid = K.detect_keypoints(
+            np.asarray(ref_frame, np.float32),
+            max_keypoints=cfg.max_keypoints,
+            threshold=cfg.detect_threshold,
+            nms_size=cfg.nms_size,
+            border=cfg.border,
+            harris_k=cfg.harris_k,
+        )
+        desc = K.describe_keypoints(
+            np.asarray(ref_frame, np.float32),
+            xy,
+            valid,
+            oriented=cfg.resolved_oriented(),
+            blur_sigma=cfg.blur_sigma,
+        )
+        return {"xy": xy, "desc": desc, "valid": valid}
+
+    def process_batch(
+        self, frames: np.ndarray, ref: dict, frame_indices: np.ndarray
+    ) -> dict:
+        cfg = self.config
+        out: dict[str, list] = {k: [] for k in self._keys()}
+        for frame, gidx in zip(frames, frame_indices):
+            self._process_one(np.asarray(frame, np.float32), int(gidx), ref, out)
+        return {k: np.stack(v) for k, v in out.items()}
+
+    def _keys(self):
+        base = ["corrected", "n_keypoints", "n_matches", "n_inliers", "rms_residual"]
+        return base + (["field"] if self.config.model == "piecewise" else ["transform"])
+
+    def _process_one(self, frame, gidx, ref, out):
+        cfg = self.config
+        xy, score, valid = K.detect_keypoints(
+            frame,
+            max_keypoints=cfg.max_keypoints,
+            threshold=cfg.detect_threshold,
+            nms_size=cfg.nms_size,
+            border=cfg.border,
+            harris_k=cfg.harris_k,
+        )
+        desc = K.describe_keypoints(
+            frame, xy, valid, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
+        )
+        idx, dist, second, ok = K.knn_match(
+            desc,
+            ref["desc"],
+            valid,
+            ref["valid"],
+            ratio=cfg.ratio,
+            max_dist=cfg.max_hamming,
+            mutual=cfg.mutual,
+        )
+        src = ref["xy"][idx]
+        dst = xy
+        rng = np.random.default_rng([cfg.seed, gidx])
+        out["n_keypoints"].append(np.int32(valid.sum()))
+        out["n_matches"].append(np.int32(ok.sum()))
+
+        if cfg.model == "piecewise":
+            field, flow, n_in, rms = self._estimate_field(src, dst, ok, rng, frame.shape)
+            out["field"].append(field)
+            out["corrected"].append(K.warp_frame_flow(frame, flow))
+            out["n_inliers"].append(np.int32(n_in))
+            out["rms_residual"].append(np.float32(rms))
+        else:
+            M, n_in, inl, rms = K.ransac_estimate(
+                cfg.model,
+                src,
+                dst,
+                ok,
+                rng,
+                n_hypotheses=cfg.n_hypotheses,
+                threshold=cfg.inlier_threshold,
+                refine_iters=cfg.refine_iters,
+            )
+            out["transform"].append(M)
+            out["corrected"].append(K.warp_frame(frame, M))
+            out["n_inliers"].append(np.int32(n_in))
+            out["rms_residual"].append(np.float32(rms))
+
+    def _estimate_field(self, src, dst, ok, rng, shape):
+        """Mirror of ops/piecewise.estimate_field in NumPy."""
+        cfg = self.config
+        gh, gw = cfg.patch_grid
+        H, W = shape
+        Mg, n_g, inl_g, rms_g = K.ransac_estimate(
+            "translation", src, dst, ok, rng,
+            n_hypotheses=cfg.n_hypotheses, threshold=cfg.global_threshold,
+        )
+        g_t = Mg[:2, 2]
+        cy = (np.arange(gh, dtype=np.float32) + 0.5) * H / gh - 0.5
+        cx = (np.arange(gw, dtype=np.float32) + 0.5) * W / gw - 0.5
+        reach = 1.5 * max(H / gh, W / gw)
+        field = np.zeros((gh, gw, 2), np.float32)
+        for i in range(gh):
+            for j in range(gw):
+                c = np.array([cx[j], cy[i]], np.float32)
+                member = inl_g & (((src - c) ** 2).sum(-1) < reach * reach)
+                Mp, n_p, _, _ = K.ransac_estimate(
+                    "translation", src, dst, member, rng,
+                    n_hypotheses=cfg.patch_hypotheses, threshold=cfg.inlier_threshold,
+                )
+                lam = n_p / (n_p + cfg.patch_prior)
+                field[i, j] = lam * Mp[:2, 2] + (1 - lam) * g_t
+        field = self._smooth_field(field, cfg.field_smooth_sigma)
+        from kcmc_tpu.utils.synthetic import upsample_field
+
+        flow = upsample_field(field, shape)
+        return field, flow, n_g, rms_g
+
+    @staticmethod
+    def _smooth_field(field, sigma):
+        if sigma <= 0:
+            return field
+        radius = max(1, int(2.0 * sigma + 0.5))
+        x = np.arange(-radius, radius + 1, dtype=np.float32)
+        k = np.exp(-0.5 * (x / max(sigma, 1e-6)) ** 2)
+        k /= k.sum()
+        ones = np.ones(field.shape[:2], np.float32)
+
+        def blur(c):
+            p = np.pad(c, radius)
+            win = np.lib.stride_tricks.sliding_window_view(p, (2 * radius + 1, 2 * radius + 1))
+            k2 = np.outer(k, k)
+            return np.einsum("ijkl,kl->ij", win, k2, optimize=True)
+
+        num = np.stack([blur(field[..., i]) for i in range(2)], -1)
+        den = blur(ones)[..., None]
+        return (num / np.maximum(den, 1e-6)).astype(np.float32)

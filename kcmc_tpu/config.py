@@ -1,0 +1,56 @@
+"""Pipeline configuration (SURVEY.md §5: config via the MotionCorrector
+constructor + per-backend options)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class CorrectorConfig:
+    """All knobs of the registration pipeline. Frozen + hashable so jitted
+    batch functions can cache on it."""
+
+    # transform family: translation | rigid | affine | homography |
+    # piecewise | rigid3d
+    model: str = "translation"
+
+    # -- detection ---------------------------------------------------------
+    max_keypoints: int = 512  # fixed K per frame (static shapes)
+    detect_threshold: float = 1e-4  # relative to the frame's peak response
+    nms_size: int = 5
+    border: int = 16  # keep descriptor patches in-bounds
+    harris_k: float = 0.04
+
+    # -- description -------------------------------------------------------
+    oriented: bool | None = None  # None => auto: off for translation
+    blur_sigma: float = 2.0
+
+    # -- matching ----------------------------------------------------------
+    ratio: float = 0.85
+    max_hamming: int = 80
+    mutual: bool = True
+
+    # -- consensus ---------------------------------------------------------
+    n_hypotheses: int = 128
+    inlier_threshold: float = 2.0  # px
+    refine_iters: int = 2
+    seed: int = 0
+
+    # -- piecewise-rigid (config 3) ---------------------------------------
+    patch_grid: tuple[int, int] = (8, 8)
+    patch_hypotheses: int = 32
+    patch_prior: float = 8.0  # inlier-mass scale blending patch vs global
+    field_smooth_sigma: float = 0.7  # in grid cells
+    global_threshold: float = 8.0  # generous inlier px for the global stage
+
+    # -- execution ---------------------------------------------------------
+    batch_size: int = 32  # frames per jitted device step
+
+    def resolved_oriented(self) -> bool:
+        if self.oriented is None:
+            return self.model not in ("translation", "piecewise")
+        return self.oriented
+
+    def replace(self, **kw) -> "CorrectorConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,163 @@
+"""MotionCorrector: the top-level, backend-agnostic orchestrator.
+
+Mirrors the reference's public API surface (SURVEY.md §0/§3 —
+`MotionCorrector(backend=...)` with a `.correct(stack)` entry point;
+reference source unavailable, contract from BASELINE.json). The
+orchestrator owns everything that is *not* kernel execution: reference-
+frame selection, chunking long stacks into fixed-size batches (padding
+the tail so every device step reuses one compiled program), per-stage
+timing, and resumable processing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from kcmc_tpu.backends import get_backend
+from kcmc_tpu.config import CorrectorConfig
+from kcmc_tpu.utils.metrics import StageTimer
+
+
+@dataclasses.dataclass
+class CorrectionResult:
+    """Output of MotionCorrector.correct."""
+
+    corrected: np.ndarray  # (T, H, W) or (T, D, H, W)
+    transforms: np.ndarray | None  # (T, d+1, d+1) for matrix models
+    fields: np.ndarray | None  # (T, gh, gw, 2) for piecewise
+    diagnostics: dict[str, np.ndarray]  # per-frame counters/residuals
+    timing: dict[str, Any]  # StageTimer report
+
+    @property
+    def frames_per_sec(self) -> float | None:
+        return self.timing.get("frames_per_sec")
+
+
+class MotionCorrector:
+    """Register every frame of a stack to a reference frame and resample.
+
+    Parameters
+    ----------
+    model:
+        Transform family: translation | rigid | affine | homography |
+        piecewise | rigid3d.
+    backend:
+        Execution backend plugin name ("jax", "numpy", ...). The plugin
+        seam matches the reference architecture: all kernel execution is
+        behind it.
+    reference:
+        Reference frame selector: an int frame index, "first", "mean"
+        (mean of the first `reference_window` frames), or an explicit
+        2D/3D array.
+    config / **overrides:
+        A full CorrectorConfig, or keyword overrides applied on top of
+        the defaults (e.g. `MotionCorrector(model="affine", n_hypotheses=256)`).
+    """
+
+    def __init__(
+        self,
+        model: str = "translation",
+        backend: str = "jax",
+        reference: int | str | np.ndarray = 0,
+        config: CorrectorConfig | None = None,
+        reference_window: int = 16,
+        mesh=None,
+        **overrides,
+    ):
+        base = config if config is not None else CorrectorConfig()
+        self.config = base.replace(model=model, **overrides)
+        self.backend_name = backend
+        options = {"mesh": mesh} if mesh is not None else {}
+        self.backend = get_backend(backend, self.config, **options)
+        self.reference = reference
+        self.reference_window = reference_window
+
+    # ------------------------------------------------------------------
+
+    def _select_reference(self, stack: np.ndarray) -> np.ndarray:
+        ref = self.reference
+        if isinstance(ref, np.ndarray):
+            if ref.shape != stack.shape[1:]:
+                raise ValueError(
+                    f"reference shape {ref.shape} != frame shape {stack.shape[1:]}"
+                )
+            return np.asarray(ref, np.float32)
+        if ref == "first":
+            return np.asarray(stack[0], np.float32)
+        if ref == "mean":
+            n = min(self.reference_window, len(stack))
+            return np.mean(stack[:n], axis=0, dtype=np.float32)
+        if isinstance(ref, (int, np.integer)):
+            idx = int(ref)
+            if not -len(stack) <= idx < len(stack):
+                raise ValueError(f"reference index {idx} out of range for {len(stack)} frames")
+            return np.asarray(stack[idx], np.float32)
+        raise ValueError(f"bad reference selector: {ref!r}")
+
+    def correct(
+        self,
+        stack: np.ndarray,
+        start_frame: int = 0,
+        end_frame: int | None = None,
+        progress: bool = False,
+    ) -> CorrectionResult:
+        """Correct a (T, H, W) or (T, D, H, W) stack.
+
+        `start_frame`/`end_frame` bound the processed range while keeping
+        *global* frame indices (RANSAC keys fold in the global index, so
+        chunked and one-shot runs produce identical transforms) — this is
+        what utils/checkpoint.py's resume manager builds on.
+        """
+        stack = np.asarray(stack)
+        if stack.ndim not in (3, 4):
+            raise ValueError(
+                f"stack must be (T, H, W) or (T, D, H, W), got shape {stack.shape}"
+            )
+        if stack.ndim == 4 and self.config.model not in ("rigid3d",):
+            raise ValueError(
+                f"4D (volumetric) stacks require model='rigid3d', got {self.config.model!r}"
+            )
+        if stack.ndim == 3 and self.config.model == "rigid3d":
+            raise ValueError("model='rigid3d' requires a (T, D, H, W) stack")
+
+        timer = StageTimer()
+        cfg = self.config
+        T = len(stack) if end_frame is None else min(end_frame, len(stack))
+
+        with timer.stage("prepare_reference"):
+            ref_frame = self._select_reference(stack)
+            ref = self.backend.prepare_reference(ref_frame)
+
+        B = cfg.batch_size
+        outs = []
+        indices = np.arange(start_frame, T)
+        with timer.stage("register_batches"):
+            for lo in range(start_frame, T, B):
+                hi = min(lo + B, T)
+                batch = stack[lo:hi]
+                idx = np.arange(lo, hi)
+                if len(batch) < B:  # pad tail to the compiled batch size
+                    pad = B - len(batch)
+                    batch = np.concatenate([batch, np.repeat(batch[-1:], pad, axis=0)])
+                    idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
+                out = self.backend.process_batch(batch, ref, idx)
+                outs.append({k: v[: hi - lo] for k, v in out.items()})
+                if progress:
+                    print(f"[kcmc] frames {hi}/{T}", flush=True)
+
+        merged = {
+            k: np.concatenate([o[k] for o in outs]) for k in outs[0]
+        } if outs else {}
+        corrected = merged.pop("corrected", np.empty((0,) + stack.shape[1:], np.float32))
+        transforms = merged.pop("transform", None)
+        fields = merged.pop("field", None)
+        return CorrectionResult(
+            corrected=corrected,
+            transforms=transforms,
+            fields=fields,
+            diagnostics=merged,
+            timing=timer.report(n_frames=len(indices)),
+        )

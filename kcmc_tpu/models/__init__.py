@@ -1,0 +1,16 @@
+"""Transform models: solve / apply / residual for each geometric family.
+
+Covers the reference's transform-model lattice (SURVEY.md §0, configs
+1–5): translation (2 DoF), rigid/euclidean (3 DoF), affine (6 DoF),
+homography (8 DoF), and 3D rigid (6 DoF). Piecewise-rigid is built on
+top of these in `kcmc_tpu.ops.piecewise`.
+"""
+
+from kcmc_tpu.models.transforms import (
+    MODELS,
+    TransformModel,
+    apply_transform,
+    get_model,
+)
+
+__all__ = ["MODELS", "TransformModel", "apply_transform", "get_model"]

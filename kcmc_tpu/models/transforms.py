@@ -1,0 +1,239 @@
+"""Geometric transform models as pure-JAX weighted closed-form solves.
+
+Each model is a :class:`TransformModel` bundling:
+
+* ``solve(src, dst, w) -> M`` — weighted least-squares estimate of the
+  transform mapping ``src`` points onto ``dst`` points. Weights make the
+  same code path serve both RANSAC minimal-sample solves (one-hot-ish
+  weights from the sampler) and masked inlier refinement — no dynamic
+  shapes anywhere, which is what lets the whole RANSAC loop vmap over
+  (frames × hypotheses) and compile once on TPU.
+* ``apply(M, pts)`` / ``residual(M, src, dst)`` — homogeneous transform
+  application and squared reprojection error.
+
+Transforms are uniformly homogeneous matrices: (3, 3) for 2D models,
+(4, 4) for the 3D model. Degenerate solves (collinear samples, zero
+weight mass) are guarded to return the identity instead of NaN so that
+downstream argmax/inlier-count logic stays well-defined; such
+hypotheses simply score ~0 inliers.
+
+Reference parity: implements the transform lattice named in SURVEY.md
+§0/§2 (reference source unavailable — driver-metadata contract):
+translation, rigid/euclidean, affine 6-DoF, homography 8-DoF, 3D rigid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-8
+
+# Solve-quality matmuls must run at full float32 precision: on TPU the
+# default matmul precision is bfloat16-grade, which is fine for image
+# convs but not for normal equations / covariance accumulation.
+_HI = jax.lax.Precision.HIGHEST
+
+
+def _mm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.matmul(a, b, precision=_HI)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformModel:
+    """A geometric transform family usable inside the RANSAC machinery."""
+
+    name: str
+    ndim: int  # spatial dimensionality of the points (2 or 3)
+    dof: int  # degrees of freedom (diagnostic only)
+    min_samples: int  # minimal sample size for a RANSAC hypothesis
+    solve: Callable  # (src (N,d), dst (N,d), w (N,)) -> (d+1, d+1)
+
+    @property
+    def mat_size(self) -> int:
+        return self.ndim + 1
+
+    def identity(self, dtype=jnp.float32) -> jnp.ndarray:
+        return jnp.eye(self.mat_size, dtype=dtype)
+
+    def apply(self, M: jnp.ndarray, pts: jnp.ndarray) -> jnp.ndarray:
+        return apply_transform(M, pts)
+
+    def residual(self, M: jnp.ndarray, src: jnp.ndarray, dst: jnp.ndarray) -> jnp.ndarray:
+        """Squared reprojection error per point: ||apply(M, src) - dst||^2."""
+        diff = self.apply(M, src) - dst
+        return jnp.sum(diff * diff, axis=-1)
+
+
+def apply_transform(M: jnp.ndarray, pts: jnp.ndarray) -> jnp.ndarray:
+    """Apply a homogeneous (d+1, d+1) transform to (..., N, d) points.
+
+    Performs the projective divide; for affine-family matrices the last
+    homogeneous coordinate is exactly 1 so the divide is a no-op. The
+    divisor's magnitude is clamped away from zero to keep points near a
+    homography's horizon finite.
+    """
+    d = pts.shape[-1]
+    lin = jnp.matmul(pts, M[:d, :d].T, precision=_HI) + M[:d, d]
+    w = jnp.matmul(pts, M[d, :d], precision=_HI) + M[d, d]
+    w = jnp.where(jnp.abs(w) < _EPS, jnp.where(w < 0, -_EPS, _EPS), w)
+    return lin / w[..., None]
+
+
+# Minimum total weight mass for a solve to be considered well-posed. A
+# RANSAC minimal sample has weight >= 1 per point, so anything below this
+# means "effectively no data".
+_MIN_MASS = 1e-3
+
+
+def _guard(M: jnp.ndarray, ok: jnp.ndarray | bool = True) -> jnp.ndarray:
+    """Replace non-finite or explicitly-degenerate solves with the identity.
+
+    Degenerate hypotheses must not produce *finite but collapsing* maps
+    (e.g. a zero rotation block sending everything to the dst centroid):
+    such maps can spuriously out-score honest hypotheses in RANSAC. The
+    identity is the safe neutral fallback — it scores whatever the
+    unmoved frame scores.
+    """
+    good = jnp.logical_and(jnp.all(jnp.isfinite(M)), ok)
+    return jnp.where(good, M, jnp.eye(M.shape[-1], dtype=M.dtype))
+
+
+def _wmean(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted mean of (N, d) points with (N,) weights."""
+    tot = jnp.maximum(jnp.sum(w), _EPS)
+    return jnp.sum(x * w[:, None], axis=0) / tot
+
+
+def _embed(ndim: int, R: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
+    M = jnp.eye(ndim + 1, dtype=R.dtype)
+    M = M.at[:ndim, :ndim].set(R)
+    M = M.at[:ndim, ndim].set(t)
+    return M
+
+
+def _normalization(pts: jnp.ndarray, w: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Hartley-style conditioning: similarity T mapping the weighted point
+    cloud to zero mean / ~unit RMS radius. Returns (T, T_inv)."""
+    d = pts.shape[-1]
+    c = _wmean(pts, w)
+    centered = pts - c
+    rms = jnp.sqrt(_wmean(jnp.sum(centered * centered, axis=-1, keepdims=True), w)[0])
+    s = jnp.sqrt(jnp.asarray(float(d), pts.dtype)) / jnp.maximum(rms, _EPS)
+    T = jnp.eye(d + 1, dtype=pts.dtype)
+    T = T.at[jnp.arange(d), jnp.arange(d)].set(s)
+    T = T.at[:d, d].set(-s * c)
+    Tinv = jnp.eye(d + 1, dtype=pts.dtype)
+    Tinv = Tinv.at[jnp.arange(d), jnp.arange(d)].set(1.0 / s)
+    Tinv = Tinv.at[:d, d].set(c)
+    return T, Tinv
+
+
+# ---------------------------------------------------------------------------
+# Solvers. All take src (N, d), dst (N, d), w (N,) and return (d+1, d+1).
+# ---------------------------------------------------------------------------
+
+
+def solve_translation(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    t = _wmean(dst - src, w)
+    return _guard(_embed(2, jnp.eye(2, dtype=src.dtype), t), ok=jnp.sum(w) > _MIN_MASS)
+
+
+def solve_rigid(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted 2D Procrustes (rotation + translation), closed form."""
+    cs = _wmean(src, w)
+    cd = _wmean(dst, w)
+    s = src - cs
+    d = dst - cd
+    # cos-like and sin-like accumulators of the optimal rotation
+    a = jnp.sum(w * (s[:, 0] * d[:, 0] + s[:, 1] * d[:, 1]))
+    b = jnp.sum(w * (s[:, 0] * d[:, 1] - s[:, 1] * d[:, 0]))
+    norm = jnp.maximum(jnp.sqrt(a * a + b * b), _EPS)
+    c, sn = a / norm, b / norm
+    R = jnp.array([[c, -sn], [sn, c]], dtype=src.dtype)
+    t = cd - _mm(R, cs)
+    # norm ~ 0 means coincident/zero-weight samples: no rotation is
+    # defined and R would be a collapse map — fall back to identity.
+    ok = jnp.logical_and(jnp.sum(w) > _MIN_MASS, norm > 1e-6)
+    return _guard(_embed(2, R, t), ok=ok)
+
+
+def solve_affine(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted least-squares 6-DoF affine via conditioned normal equations."""
+    Ts, _ = _normalization(src, w)
+    Td, Td_inv = _normalization(dst, w)
+    sn = apply_transform(Ts, src)
+    dn = apply_transform(Td, dst)
+    ones = jnp.ones((src.shape[0], 1), dtype=src.dtype)
+    A = jnp.concatenate([sn, ones], axis=-1)  # (N, 3)
+    Aw = A * w[:, None]
+    M33 = _mm(A.T, Aw) + _EPS * jnp.eye(3, dtype=src.dtype)
+    rhs = _mm(Aw.T, dn)  # (3, 2)
+    P = jnp.linalg.solve(M33, rhs).T  # (2, 3): [R | t] in normalized space
+    Mn = jnp.eye(3, dtype=src.dtype).at[:2, :].set(P)
+    return _guard(_mm(_mm(Td_inv, Mn), Ts), ok=jnp.sum(w) > _MIN_MASS)
+
+
+def solve_homography(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted normalized DLT; null vector via eigh of the 9x9 normal matrix."""
+    Ts, _ = _normalization(src, w)
+    Td, Td_inv = _normalization(dst, w)
+    sn = apply_transform(Ts, src)
+    dn = apply_transform(Td, dst)
+    x, y = sn[:, 0], sn[:, 1]
+    u, v = dn[:, 0], dn[:, 1]
+    zero = jnp.zeros_like(x)
+    one = jnp.ones_like(x)
+    r1 = jnp.stack([-x, -y, -one, zero, zero, zero, u * x, u * y, u], axis=-1)
+    r2 = jnp.stack([zero, zero, zero, -x, -y, -one, v * x, v * y, v], axis=-1)
+    rows = jnp.concatenate([r1, r2], axis=0)  # (2N, 9)
+    rw = jnp.concatenate([w, w], axis=0)
+    ATA = _mm(rows.T, rows * rw[:, None])  # (9, 9)
+    # Smallest-eigenvalue eigenvector of a symmetric PSD matrix.
+    evals, evecs = jnp.linalg.eigh(ATA)
+    h = evecs[:, 0]
+    Hn = h.reshape(3, 3)
+    H = _mm(_mm(Td_inv, Hn), Ts)
+    # Fix scale/sign: unit Frobenius norm, positive bottom-right element.
+    H = H / jnp.maximum(jnp.linalg.norm(H), _EPS)
+    H = H * jnp.where(H[2, 2] < 0, -1.0, 1.0)
+    denom = jnp.where(jnp.abs(H[2, 2]) > 1e-6, H[2, 2], 1.0)
+    return _guard(H / denom, ok=jnp.sum(w) > _MIN_MASS)
+
+
+def solve_rigid3d(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted Kabsch: optimal 3D rotation + translation via 3x3 SVD."""
+    cs = _wmean(src, w)
+    cd = _wmean(dst, w)
+    s = (src - cs) * w[:, None]
+    d = dst - cd
+    H = _mm(s.T, d)  # (3, 3) cross-covariance
+    U, _, Vt = jnp.linalg.svd(H)
+    det = jnp.linalg.det(_mm(Vt.T, U.T))
+    D = jnp.diag(jnp.array([1.0, 1.0, 1.0], dtype=src.dtype)).at[2, 2].set(det)
+    R = _mm(_mm(Vt.T, D), U.T)
+    t = cd - _mm(R, cs)
+    return _guard(_embed(3, R, t), ok=jnp.sum(w) > _MIN_MASS)
+
+
+MODELS: dict[str, TransformModel] = {
+    m.name: m
+    for m in [
+        TransformModel("translation", ndim=2, dof=2, min_samples=1, solve=solve_translation),
+        TransformModel("rigid", ndim=2, dof=3, min_samples=2, solve=solve_rigid),
+        TransformModel("affine", ndim=2, dof=6, min_samples=3, solve=solve_affine),
+        TransformModel("homography", ndim=2, dof=8, min_samples=4, solve=solve_homography),
+        TransformModel("rigid3d", ndim=3, dof=6, min_samples=3, solve=solve_rigid3d),
+    ]
+}
+
+
+def get_model(name: str) -> TransformModel:
+    # "piecewise" is handled at the pipeline level (ops/piecewise.py); the
+    # underlying per-patch model is rigid/translation.
+    if name not in MODELS:
+        raise ValueError(f"unknown transform model {name!r}; available: {sorted(MODELS)}")
+    return MODELS[name]

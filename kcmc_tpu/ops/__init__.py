@@ -1,0 +1,8 @@
+"""TPU kernels: detection, description, matching, consensus, warping.
+
+Every op in this package is statically shaped and jit/vmap-safe: fixed-K
+keypoints with validity masks instead of variable-length lists, fixed
+hypothesis counts instead of adaptive early exit — the design constraints
+that let XLA compile the whole pipeline once and tile it onto the MXU
+(SURVEY.md §7 "hard parts").
+"""

@@ -1,0 +1,118 @@
+"""Binary keypoint descriptors: oriented BRIEF (ORB-style), TPU-native.
+
+Counterpart of the reference `KeypointExtractor`'s describe stage
+(SURVEY.md §2; BASELINE.json names ORB keypoints for the affine config).
+Rebuilt for TPU rather than translated:
+
+* The classic BRIEF sampling pattern (256 Gaussian-distributed point
+  pairs in a radius-13 patch) is a host-side constant baked into the
+  compiled program.
+* Orientation comes from the intensity-centroid moment of a disc around
+  the keypoint (the ORB approach), computed with one dynamic-slice patch
+  gather per keypoint and vmapped — no per-keypoint Python.
+* Descriptor bits are bilinear samples of the blurred frame at the
+  rotated pair positions; 256 comparisons pack into 8 uint32 lanes so
+  Hamming distance is XOR + popcount on 8 words (ops/match.py).
+
+Everything is fixed-K and mask-aware: invalid keypoint slots produce
+all-zero descriptors which the matcher masks out.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kcmc_tpu.ops.detect import Keypoints, gaussian_blur
+from kcmc_tpu.ops.patterns import (  # shared, JAX-free constants
+    MOMENTS as _MOMENTS,
+    MOMENT_RADIUS as _MOMENT_RADIUS,
+    N_BITS,
+    N_WORDS,
+    PATCH_RADIUS,
+    PATTERN,
+)
+
+
+def _bilinear_sample(img: jnp.ndarray, xy: jnp.ndarray) -> jnp.ndarray:
+    """Sample (H, W) image at (..., 2) float (x, y) points, edge-clamped."""
+    H, W = img.shape
+    x = jnp.clip(xy[..., 0], 0.0, W - 1.0)
+    y = jnp.clip(xy[..., 1], 0.0, H - 1.0)
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    fx = x - x0
+    fy = y - y0
+    x0i = x0.astype(jnp.int32)
+    y0i = y0.astype(jnp.int32)
+    x1i = jnp.minimum(x0i + 1, W - 1)
+    y1i = jnp.minimum(y0i + 1, H - 1)
+    flat = img.reshape(-1)
+    v00 = flat[y0i * W + x0i]
+    v01 = flat[y0i * W + x1i]
+    v10 = flat[y1i * W + x0i]
+    v11 = flat[y1i * W + x1i]
+    return (
+        v00 * (1 - fx) * (1 - fy)
+        + v01 * fx * (1 - fy)
+        + v10 * (1 - fx) * fy
+        + v11 * fx * fy
+    )
+
+
+def _orientation(img: jnp.ndarray, xy: jnp.ndarray) -> jnp.ndarray:
+    """ORB intensity-centroid angle at one keypoint. xy: (2,) float."""
+    r = _MOMENT_RADIUS
+    H, W = img.shape
+    cy = jnp.clip(jnp.round(xy[1]).astype(jnp.int32), r, H - r - 1)
+    cx = jnp.clip(jnp.round(xy[0]).astype(jnp.int32), r, W - r - 1)
+    patch = lax.dynamic_slice(img, (cy - r, cx - r), (2 * r + 1, 2 * r + 1))
+    moms = jnp.asarray(_MOMENTS)
+    w = patch * moms[..., 2]
+    m10 = jnp.sum(w * moms[..., 0])
+    m01 = jnp.sum(w * moms[..., 1])
+    return jnp.arctan2(m01, m10)
+
+
+def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """(K, N_BITS) bool -> (K, N_WORDS) uint32."""
+    b = bits.reshape(bits.shape[0], N_WORDS, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(b << shifts[None, None, :], axis=-1, dtype=jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("oriented", "blur_sigma"))
+def describe_keypoints(
+    img: jnp.ndarray,
+    kps: Keypoints,
+    oriented: bool = True,
+    blur_sigma: float = 2.0,
+) -> jnp.ndarray:
+    """Compute (K, N_WORDS) uint32 BRIEF descriptors for one frame.
+
+    `oriented=True` steers the pattern by the intensity-centroid angle
+    (rotation-invariant, ORB-style); `False` is classic upright BRIEF —
+    slightly more discriminative when the motion model has no rotation
+    (the translation-only config).
+    """
+    smooth = gaussian_blur(img, blur_sigma)
+    pattern = jnp.asarray(PATTERN)  # (B, 2, 2)
+
+    if oriented:
+        angles = jax.vmap(lambda p: _orientation(smooth, p))(kps.xy)  # (K,)
+        c, s = jnp.cos(angles), jnp.sin(angles)
+        # Rotation matrices (K, 2, 2): steer pattern per keypoint.
+        R = jnp.stack([jnp.stack([c, -s], -1), jnp.stack([s, c], -1)], -2)
+        offs = jnp.einsum("kij,bej->kbei", R, pattern)  # (K, B, 2, 2)
+    else:
+        offs = jnp.broadcast_to(pattern[None], (kps.xy.shape[0],) + pattern.shape)
+
+    pos = kps.xy[:, None, None, :] + offs  # (K, B, 2, 2)
+    vals = _bilinear_sample(smooth, pos)  # (K, B, 2)
+    bits = vals[..., 0] < vals[..., 1]  # (K, B)
+    desc = _pack_bits(bits)
+    return jnp.where(kps.valid[:, None], desc, jnp.zeros_like(desc))

@@ -1,0 +1,64 @@
+"""3D binary descriptors: BRIEF pairs in an anisotropic ellipsoid.
+
+The 3D analogue of ops/describe.py for z-stack registration (config 5).
+Pair offsets are Gaussian-distributed with a smaller z extent (z-stacks
+are typically shallow and anisotropic). No orientation steering: the 3D
+rigid drift regime has small rotations, and upright descriptors are more
+discriminative (same trade-off as upright BRIEF for translation).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kcmc_tpu.ops.describe import _pack_bits
+from kcmc_tpu.ops.detect import Keypoints
+from kcmc_tpu.ops.detect3d import gaussian_blur_3d
+from kcmc_tpu.ops.patterns import PATTERN_3D, RADIUS_XY, RADIUS_Z
+
+
+def _trilinear_sample(vol: jnp.ndarray, xyz: jnp.ndarray) -> jnp.ndarray:
+    """Sample (D, H, W) at (..., 3) float (x, y, z), edge-clamped."""
+    D, H, W = vol.shape
+    x = jnp.clip(xyz[..., 0], 0.0, W - 1.0)
+    y = jnp.clip(xyz[..., 1], 0.0, H - 1.0)
+    z = jnp.clip(xyz[..., 2], 0.0, D - 1.0)
+    x0 = jnp.floor(x); y0 = jnp.floor(y); z0 = jnp.floor(z)
+    fx, fy, fz = x - x0, y - y0, z - z0
+    x0i = x0.astype(jnp.int32); y0i = y0.astype(jnp.int32); z0i = z0.astype(jnp.int32)
+    x1i = jnp.minimum(x0i + 1, W - 1)
+    y1i = jnp.minimum(y0i + 1, H - 1)
+    z1i = jnp.minimum(z0i + 1, D - 1)
+    flat = vol.reshape(-1)
+
+    def g(zi, yi, xi):
+        return flat[(zi * H + yi) * W + xi]
+
+    return (
+        g(z0i, y0i, x0i) * (1 - fx) * (1 - fy) * (1 - fz)
+        + g(z0i, y0i, x1i) * fx * (1 - fy) * (1 - fz)
+        + g(z0i, y1i, x0i) * (1 - fx) * fy * (1 - fz)
+        + g(z0i, y1i, x1i) * fx * fy * (1 - fz)
+        + g(z1i, y0i, x0i) * (1 - fx) * (1 - fy) * fz
+        + g(z1i, y0i, x1i) * fx * (1 - fy) * fz
+        + g(z1i, y1i, x0i) * (1 - fx) * fy * fz
+        + g(z1i, y1i, x1i) * fx * fy * fz
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("blur_sigma",))
+def describe_keypoints_3d(
+    vol: jnp.ndarray, kps: Keypoints, blur_sigma: float = 1.5
+) -> jnp.ndarray:
+    """(K, N_WORDS) uint32 3D-BRIEF descriptors for one volume."""
+    smooth = gaussian_blur_3d(vol, blur_sigma)
+    pattern = jnp.asarray(PATTERN_3D)  # (B, 2, 3)
+    pos = kps.xy[:, None, None, :] + pattern[None]  # (K, B, 2, 3)
+    vals = _trilinear_sample(smooth, pos)  # (K, B, 2)
+    bits = vals[..., 0] < vals[..., 1]
+    desc = _pack_bits(bits)
+    return jnp.where(kps.valid[:, None], desc, jnp.zeros_like(desc))

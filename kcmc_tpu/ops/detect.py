@@ -1,0 +1,161 @@
+"""Keypoint detection: Harris corner response, NMS, fixed-K top-k, subpixel.
+
+TPU-native counterpart of the reference's `KeypointExtractor` detect
+stage (SURVEY.md §2 — reference source unavailable; contract from
+BASELINE.json). Design choices for the TPU:
+
+* Harris response is built from 3x3 convolutions (`lax.conv`) — these
+  map onto the MXU/VPU and fuse with the surrounding elementwise ops.
+* Non-max suppression is a max-pool equality test — no sorting, no
+  dynamic shapes.
+* "Detect the strongest corners above a threshold" becomes a fixed-K
+  `lax.top_k` plus a validity mask (`score > threshold`), so every frame
+  yields exactly K keypoint slots and the downstream pipeline stays
+  statically shaped (SURVEY.md §7: fixed-K keypoint selection).
+* Subpixel refinement fits a 2D quadratic to the 3x3 response
+  neighborhood of each keypoint. This matters for accuracy: a pure
+  integer-grid detector quantizes the recovered drift to whole pixels.
+
+All functions operate on a single (H, W) frame and are `vmap`ed over the
+frame batch by the pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Keypoints(NamedTuple):
+    """Fixed-K keypoints for one frame (or a batch, with leading axes)."""
+
+    xy: jnp.ndarray  # (K, 2) float32 (x, y) subpixel positions
+    score: jnp.ndarray  # (K,) Harris response at the keypoint
+    valid: jnp.ndarray  # (K,) bool — False for padded slots
+
+
+def _conv2d(img: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+    """Same-padding 2D convolution of a (H, W) image with a small kernel."""
+    out = lax.conv_general_dilated(
+        img[None, None, :, :],
+        kernel[None, None, :, :],
+        window_strides=(1, 1),
+        padding="SAME",
+    )
+    return out[0, 0]
+
+
+def _gaussian_kernel1d(sigma: float, radius: int) -> jnp.ndarray:
+    x = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    k = jnp.exp(-0.5 * (x / sigma) ** 2)
+    return k / jnp.sum(k)
+
+
+def gaussian_blur(img: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    """Separable Gaussian blur of a (H, W) image."""
+    radius = max(1, int(3.0 * sigma + 0.5))
+    k = _gaussian_kernel1d(sigma, radius)
+    img = _conv2d(img, k[None, :])
+    img = _conv2d(img, k[:, None])
+    return img
+
+
+_SOBEL_X = jnp.array(
+    [[-1.0, 0.0, 1.0], [-2.0, 0.0, 2.0], [-1.0, 0.0, 1.0]], dtype=jnp.float32
+) / 8.0
+_SOBEL_Y = _SOBEL_X.T
+
+
+def harris_response(
+    img: jnp.ndarray, k: float = 0.04, window_sigma: float = 1.5
+) -> jnp.ndarray:
+    """Harris corner response R = det(M) - k * trace(M)^2 per pixel.
+
+    M is the Gaussian-windowed structure tensor of the image gradients.
+    """
+    gx = _conv2d(img, _SOBEL_X)
+    gy = _conv2d(img, _SOBEL_Y)
+    ixx = gaussian_blur(gx * gx, window_sigma)
+    iyy = gaussian_blur(gy * gy, window_sigma)
+    ixy = gaussian_blur(gx * gy, window_sigma)
+    det = ixx * iyy - ixy * ixy
+    trace = ixx + iyy
+    return det - k * trace * trace
+
+
+def _maxpool_same(x: jnp.ndarray, size: int) -> jnp.ndarray:
+    return lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(size, size),
+        window_strides=(1, 1),
+        padding="SAME",
+    )
+
+
+def _subpixel_offset(patch: jnp.ndarray) -> jnp.ndarray:
+    """Quadratic-fit subpixel offset from a 3x3 response patch.
+
+    Fits separable 1D parabolas along x and y through the center; the
+    offset is clamped to [-0.5, 0.5] (beyond that the integer NMS peak
+    would have been elsewhere).
+    """
+    c = patch[1, 1]
+    dx = 0.5 * (patch[1, 2] - patch[1, 0])
+    dy = 0.5 * (patch[2, 1] - patch[0, 1])
+    dxx = patch[1, 2] - 2.0 * c + patch[1, 0]
+    dyy = patch[2, 1] - 2.0 * c + patch[0, 1]
+    ox = jnp.where(jnp.abs(dxx) > 1e-8, -dx / dxx, 0.0)
+    oy = jnp.where(jnp.abs(dyy) > 1e-8, -dy / dyy, 0.0)
+    return jnp.clip(jnp.stack([ox, oy]), -0.5, 0.5)
+
+
+@functools.partial(jax.jit, static_argnames=("max_keypoints", "nms_size", "border"))
+def detect_keypoints(
+    img: jnp.ndarray,
+    max_keypoints: int = 512,
+    threshold: float = 1e-6,
+    nms_size: int = 5,
+    border: int = 16,
+    harris_k: float = 0.04,
+) -> Keypoints:
+    """Detect up to `max_keypoints` Harris corners in a (H, W) frame.
+
+    Returns fixed-K arrays; `valid[i]` is False for slots whose response
+    fell at/below `threshold` (relative to the frame's peak response).
+    """
+    H, W = img.shape
+    resp = harris_response(img, k=harris_k)
+    # NMS: keep strict local maxima of the response.
+    is_max = resp >= _maxpool_same(resp, nms_size)
+    # Exclude a border so descriptor patches stay in bounds.
+    ys = jnp.arange(H)[:, None]
+    xs = jnp.arange(W)[None, :]
+    inb = (ys >= border) & (ys < H - border) & (xs >= border) & (xs < W - border)
+    # Threshold is relative to the frame's max response: robust to
+    # global contrast changes across frames.
+    peak = jnp.maximum(jnp.max(resp), 1e-12)
+    masked = jnp.where(is_max & inb & (resp > threshold * peak), resp, -jnp.inf)
+
+    scores, flat_idx = lax.top_k(masked.reshape(-1), max_keypoints)
+    iy = flat_idx // W
+    ix = flat_idx % W
+    valid = jnp.isfinite(scores)
+
+    # Subpixel: quadratic fit on the 3x3 neighborhood of each peak.
+    def patch_at(y, x):
+        return lax.dynamic_slice(resp, (y - 1, x - 1), (3, 3))
+
+    patches = jax.vmap(patch_at)(jnp.clip(iy, 1, H - 2), jnp.clip(ix, 1, W - 2))
+    offsets = jax.vmap(_subpixel_offset)(patches)  # (K, 2) (ox, oy)
+
+    xy = jnp.stack([ix.astype(jnp.float32), iy.astype(jnp.float32)], axis=-1)
+    xy = xy + jnp.where(valid[:, None], offsets, 0.0)
+    scores = jnp.where(valid, scores, 0.0)
+    xy = jnp.where(valid[:, None], xy, 0.0)
+    return Keypoints(xy=xy, score=scores, valid=valid)

@@ -1,0 +1,132 @@
+"""3D keypoint detection for volumetric (z-stack) registration — config 5.
+
+3D Harris: the structure tensor of the volume gradients, Gaussian-
+windowed, scored by det(M) - k * trace(M)^3 (the 3D analogue of the 2D
+Harris response). NMS is a 3x3x3 max-pool equality; selection is fixed-K
+top-k with validity mask, exactly like the 2D path, so the downstream
+matcher/RANSAC code is shared unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kcmc_tpu.ops.detect import Keypoints
+
+
+def _conv3d_axis(vol: jnp.ndarray, k: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """1D convolution along one axis of a (D, H, W) volume."""
+    shape = [1, 1, 1]
+    shape[axis] = k.shape[0]
+    kernel = k.reshape(shape)
+    out = lax.conv_general_dilated(
+        vol[None, None],
+        kernel[None, None],
+        window_strides=(1, 1, 1),
+        padding="SAME",
+    )
+    return out[0, 0]
+
+
+def _gauss1d(sigma: float) -> jnp.ndarray:
+    radius = max(1, int(3.0 * sigma + 0.5))
+    x = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    k = jnp.exp(-0.5 * (x / sigma) ** 2)
+    return k / jnp.sum(k)
+
+
+def gaussian_blur_3d(vol: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    k = _gauss1d(sigma)
+    for axis in range(3):
+        vol = _conv3d_axis(vol, k, axis)
+    return vol
+
+
+_DIFF = jnp.array([-0.5, 0.0, 0.5], dtype=jnp.float32)
+
+
+def harris_response_3d(vol: jnp.ndarray, k: float = 0.005, window_sigma: float = 1.5) -> jnp.ndarray:
+    gz = _conv3d_axis(vol, _DIFF, 0)
+    gy = _conv3d_axis(vol, _DIFF, 1)
+    gx = _conv3d_axis(vol, _DIFF, 2)
+    # unique structure-tensor entries, Gaussian-windowed
+    sxx = gaussian_blur_3d(gx * gx, window_sigma)
+    syy = gaussian_blur_3d(gy * gy, window_sigma)
+    szz = gaussian_blur_3d(gz * gz, window_sigma)
+    sxy = gaussian_blur_3d(gx * gy, window_sigma)
+    sxz = gaussian_blur_3d(gx * gz, window_sigma)
+    syz = gaussian_blur_3d(gy * gz, window_sigma)
+    det = (
+        sxx * (syy * szz - syz * syz)
+        - sxy * (sxy * szz - syz * sxz)
+        + sxz * (sxy * syz - syy * sxz)
+    )
+    trace = sxx + syy + szz
+    return det - k * trace * trace * trace
+
+
+def _maxpool3_same(x: jnp.ndarray) -> jnp.ndarray:
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, window_dimensions=(3, 3, 3), window_strides=(1, 1, 1), padding="SAME"
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("max_keypoints", "border"))
+def detect_keypoints_3d(
+    vol: jnp.ndarray,
+    max_keypoints: int = 256,
+    threshold: float = 1e-4,
+    border: int = 6,
+    harris_k: float = 0.005,
+) -> Keypoints:
+    """Detect fixed-K 3D corners in a (D, H, W) volume.
+
+    Returns Keypoints with xy = (K, 3) float (x, y, z) positions.
+    """
+    D, H, W = vol.shape
+    resp = harris_response_3d(vol, k=harris_k)
+    is_max = resp >= _maxpool3_same(resp)
+    zs = jnp.arange(D)[:, None, None]
+    ys = jnp.arange(H)[None, :, None]
+    xs = jnp.arange(W)[None, None, :]
+    bz = min(border, max(1, D // 8))
+    inb = (
+        (zs >= bz) & (zs < D - bz)
+        & (ys >= border) & (ys < H - border)
+        & (xs >= border) & (xs < W - border)
+    )
+    peak = jnp.maximum(jnp.max(resp), 1e-12)
+    masked = jnp.where(is_max & inb & (resp > threshold * peak), resp, -jnp.inf)
+    scores, flat = lax.top_k(masked.reshape(-1), max_keypoints)
+    iz = flat // (H * W)
+    iy = (flat // W) % H
+    ix = flat % W
+    valid = jnp.isfinite(scores)
+
+    # per-axis parabola subpixel refinement
+    czi = jnp.clip(iz, 1, D - 2)
+    cyi = jnp.clip(iy, 1, H - 2)
+    cxi = jnp.clip(ix, 1, W - 2)
+
+    def axis_offset(plus, minus, center):
+        d1 = 0.5 * (plus - minus)
+        d2 = plus - 2.0 * center + minus
+        return jnp.clip(jnp.where(jnp.abs(d2) > 1e-8, -d1 / d2, 0.0), -0.5, 0.5)
+
+    c = resp[czi, cyi, cxi]
+    ox = axis_offset(resp[czi, cyi, cxi + 1], resp[czi, cyi, cxi - 1], c)
+    oy = axis_offset(resp[czi, cyi + 1, cxi], resp[czi, cyi - 1, cxi], c)
+    oz = axis_offset(resp[czi + 1, cyi, cxi], resp[czi - 1, cyi, cxi], c)
+
+    xyz = jnp.stack(
+        [ix.astype(jnp.float32) + ox, iy.astype(jnp.float32) + oy, iz.astype(jnp.float32) + oz],
+        axis=-1,
+    )
+    xyz = jnp.where(valid[:, None], xyz, 0.0)
+    scores = jnp.where(valid, scores, 0.0)
+    return Keypoints(xy=xyz, score=scores, valid=valid)

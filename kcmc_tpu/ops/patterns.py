@@ -1,0 +1,54 @@
+"""Descriptor sampling patterns — shared, JAX-free constants.
+
+Both execution backends (XLA and pure NumPy) build descriptors from the
+*same* host-side pattern constants, which is what makes cross-backend
+descriptor parity exact. This module must stay importable without JAX so
+the CPU parity backend works on hosts where JAX init is broken or slow.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+N_BITS = 256
+N_WORDS = N_BITS // 32
+PATCH_RADIUS = 13  # BRIEF pattern support radius, pixels
+MOMENT_RADIUS = 7  # intensity-centroid disc radius (ORB orientation)
+
+# 3D descriptor support (anisotropic: z-stacks are shallow)
+RADIUS_XY = 9.0
+RADIUS_Z = 3.0
+
+
+def make_pattern(seed: int = 7) -> np.ndarray:
+    """The BRIEF pair pattern: (N_BITS, 2, 2) float32 (pair, endpoint, (x, y)).
+
+    Gaussian-distributed offsets (sigma = radius/2), clipped to the patch,
+    fixed seed => identical pattern across backends.
+    """
+    rng = np.random.default_rng(seed)
+    pts = rng.normal(0.0, PATCH_RADIUS / 2.0, size=(N_BITS, 2, 2))
+    return np.clip(pts, -PATCH_RADIUS, PATCH_RADIUS).astype(np.float32)
+
+
+def moment_offsets(radius: int = MOMENT_RADIUS) -> np.ndarray:
+    """Disc sample offsets and weights for the orientation moment: (P, P, 3)
+    float32 of (dx, dy, inside-disc)."""
+    ys, xs = np.mgrid[-radius : radius + 1, -radius : radius + 1]
+    inside = (xs * xs + ys * ys) <= radius * radius
+    return np.stack([xs, ys, inside], axis=-1).astype(np.float32)
+
+
+def make_pattern_3d(seed: int = 11) -> np.ndarray:
+    """(N_BITS, 2, 3) float32 (pair, endpoint, (x, y, z)) offsets."""
+    rng = np.random.default_rng(seed)
+    xy = rng.normal(0.0, RADIUS_XY / 2.0, size=(N_BITS, 2, 2))
+    z = rng.normal(0.0, RADIUS_Z / 2.0, size=(N_BITS, 2, 1))
+    pts = np.concatenate([xy, z], axis=-1)
+    lim = np.array([RADIUS_XY, RADIUS_XY, RADIUS_Z])
+    return np.clip(pts, -lim, lim).astype(np.float32)
+
+
+PATTERN = make_pattern()
+MOMENTS = moment_offsets()
+PATTERN_3D = make_pattern_3d()

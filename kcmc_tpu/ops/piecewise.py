@@ -1,0 +1,159 @@
+"""Piecewise-rigid (patch-grid) non-rigid correction — judged config 3.
+
+SURVEY.md §2: "8x8 patch grid, per-patch consensus, smoothed displacement
+field". TPU-native structure:
+
+1. A *global* translation RANSAC (generous threshold) rejects gross
+   mismatches and anchors patches with little data.
+2. Per-patch translation consensus runs as one extra vmap axis over the
+   grid's patches: each patch sees the matches within ~1.5 patch radii
+   of its center (soft membership mask) and votes with a small fixed
+   hypothesis budget.
+3. Patch displacements blend with the global displacement by inlier
+   mass (few-match patches fall back to the global motion), then the
+   (gh, gw, 2) field is smoothed by a normalized Gaussian and bilinearly
+   upsampled to a dense flow for `warp_frame_flow`.
+
+Field convention matches the synthetic generator and the matcher: the
+field u lives on reference coordinates, u(r) = position-in-frame(r) - r,
+and the corrected frame is frame(p + u(p)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from kcmc_tpu.models.transforms import MODELS
+from kcmc_tpu.ops.ransac import ransac_estimate
+
+
+class FieldResult(NamedTuple):
+    field: jnp.ndarray  # (gh, gw, 2) patch-center displacements
+    flow: jnp.ndarray  # (H, W, 2) dense upsampled flow
+    n_inliers: jnp.ndarray  # () int32 — global-stage inliers
+    rms_residual: jnp.ndarray  # () float32 — global-stage rms
+
+
+def patch_centers(grid: tuple[int, int], shape: tuple[int, int]) -> jnp.ndarray:
+    """(gh, gw, 2) cell-center (x, y) coordinates of the patch grid."""
+    gh, gw = grid
+    H, W = shape
+    cy = (jnp.arange(gh, dtype=jnp.float32) + 0.5) * H / gh - 0.5
+    cx = (jnp.arange(gw, dtype=jnp.float32) + 0.5) * W / gw - 0.5
+    return jnp.stack(jnp.meshgrid(cx, cy, indexing="xy"), axis=-1)  # (gh, gw, 2)
+
+
+def _gauss1d(sigma: float, radius: int) -> jnp.ndarray:
+    x = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    k = jnp.exp(-0.5 * (x / max(sigma, 1e-6)) ** 2)
+    return k / jnp.sum(k)
+
+
+def smooth_field(field: jnp.ndarray, sigma: float) -> jnp.ndarray:
+    """Normalized separable Gaussian smoothing of a (gh, gw, 2) field."""
+    if sigma <= 0:
+        return field
+    radius = max(1, int(2.0 * sigma + 0.5))
+    k = _gauss1d(sigma, radius)
+    ones = jnp.ones(field.shape[:2], field.dtype)
+    from jax import lax
+
+    def blur(chan):
+        c = chan[None, None]  # NCHW
+        kh = k[None, None, :, None]
+        kw = k[None, None, None, :]
+        c = lax.conv_general_dilated(c, kh, (1, 1), [(radius, radius), (0, 0)])
+        c = lax.conv_general_dilated(c, kw, (1, 1), [(0, 0), (radius, radius)])
+        return c[0, 0]
+
+    num = jnp.stack([blur(field[..., i]) for i in range(field.shape[-1])], axis=-1)
+    den = blur(ones)[..., None]
+    return num / jnp.maximum(den, 1e-6)
+
+
+def upsample_field(field: jnp.ndarray, shape: tuple[int, int]) -> jnp.ndarray:
+    """Bilinear cell-centered upsample of (gh, gw, 2) -> (H, W, 2)."""
+    gh, gw, _ = field.shape
+    H, W = shape
+    ys = jnp.clip((jnp.arange(H, dtype=jnp.float32) + 0.5) * gh / H - 0.5, 0, gh - 1)
+    xs = jnp.clip((jnp.arange(W, dtype=jnp.float32) + 0.5) * gw / W - 0.5, 0, gw - 1)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, gh - 1)
+    x1 = jnp.minimum(x0 + 1, gw - 1)
+    fy = (ys - y0)[:, None, None]
+    fx = (xs - x0)[None, :, None]
+    f00 = field[y0][:, x0]
+    f01 = field[y0][:, x1]
+    f10 = field[y1][:, x0]
+    f11 = field[y1][:, x1]
+    return (
+        f00 * (1 - fy) * (1 - fx)
+        + f01 * (1 - fy) * fx
+        + f10 * fy * (1 - fx)
+        + f11 * fy * fx
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("grid", "shape", "n_global_hyps", "patch_hyps", "smooth_sigma"),
+)
+def estimate_field(
+    src: jnp.ndarray,  # (N, 2) reference keypoint positions of matches
+    dst: jnp.ndarray,  # (N, 2) frame keypoint positions of matches
+    valid: jnp.ndarray,  # (N,) bool
+    key: jnp.ndarray,
+    grid: tuple[int, int],
+    shape: tuple[int, int],
+    n_global_hyps: int = 64,
+    patch_hyps: int = 32,
+    global_threshold: float = 8.0,
+    patch_threshold: float = 2.0,
+    prior: float = 8.0,
+    smooth_sigma: float = 0.7,
+) -> FieldResult:
+    """Per-patch consensus displacement field for one frame."""
+    gh, gw = grid
+    translation = MODELS["translation"]
+    kg, kp = jax.random.split(key)
+
+    # 1. Global stage: robust overall translation, generous threshold.
+    gres = ransac_estimate(
+        translation, src, dst, valid, kg,
+        n_hypotheses=n_global_hyps, threshold=global_threshold,
+    )
+    g_t = gres.transform[:2, 2]  # global displacement
+    ok = gres.inlier_mask  # matches consistent with *some* coherent motion
+
+    centers = patch_centers(grid, shape).reshape(-1, 2)  # (P, 2)
+    ph, pw = shape[0] / gh, shape[1] / gw
+    # Soft membership: matches within 1.5 patch sizes of a center participate
+    # (overlap keeps the field smooth and gives edge patches enough data).
+    reach = 1.5 * jnp.float32(max(ph, pw))
+
+    def per_patch(center, k):
+        d2 = jnp.sum((src - center) ** 2, axis=-1)
+        member = ok & (d2 < reach * reach)
+        res = ransac_estimate(
+            translation, src, dst, member, k,
+            n_hypotheses=patch_hyps, threshold=patch_threshold,
+        )
+        disp = res.transform[:2, 2]
+        mass = res.n_inliers.astype(jnp.float32)
+        # Blend toward the global displacement when the patch has few inliers.
+        lam = mass / (mass + prior)
+        return lam * disp + (1.0 - lam) * g_t
+
+    pkeys = jax.random.split(kp, centers.shape[0])
+    disps = jax.vmap(per_patch)(centers, pkeys)  # (P, 2)
+    field = disps.reshape(gh, gw, 2)
+    field = smooth_field(field, smooth_sigma)
+    flow = upsample_field(field, shape)
+    return FieldResult(
+        field=field, flow=flow, n_inliers=gres.n_inliers, rms_residual=gres.rms_residual
+    )

@@ -1,0 +1,115 @@
+"""Consensus transform estimation: statically-shaped RANSAC for TPU.
+
+Counterpart of the reference's `ConsensusTransformEstimator` (SURVEY.md
+§2: hypothesis sampling -> transform solve -> residual/inlier scoring ->
+least-squares refinement). Re-designed for XLA rather than translated:
+
+* A *fixed* hypothesis count H (no adaptive early exit — SURVEY.md §7
+  "hard parts"): all H minimal-sample solves + scores run as one vmapped
+  batch, and the whole thing vmaps again over frames, giving the
+  (frames x hypotheses) batching named in BASELINE.json's north star.
+* Minimal-set sampling is Gumbel top-m over the valid-match mask: an
+  O(N) way to draw m distinct valid indices per hypothesis with no
+  rejection loops, deterministic given the PRNG key (so CPU/TPU backends
+  can reproduce each other bit-for-bit).
+* Samples become one-hot *weights* into the same weighted solver used
+  for refinement — one code path, no dynamic gathers of variable size.
+* Refinement is fixed-iteration IRLS: re-score inliers, re-solve with
+  the inlier mask as weights. The candidate with the most inliers wins
+  via argmax; a refinement step that loses inliers is rolled back.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kcmc_tpu.models.transforms import TransformModel
+
+
+class RansacResult(NamedTuple):
+    transform: jnp.ndarray  # (d+1, d+1) best refined transform
+    n_inliers: jnp.ndarray  # () int32
+    inlier_mask: jnp.ndarray  # (N,) bool under the final transform
+    rms_residual: jnp.ndarray  # () float32 RMS residual over final inliers
+
+
+def _sample_weights(key, valid: jnp.ndarray, m: int) -> jnp.ndarray:
+    """One-hot weights selecting m distinct valid indices (Gumbel top-m).
+
+    If fewer than m matches are valid the extra picks land on invalid
+    slots and are zeroed — the solver's weight-mass guard then returns
+    the identity for that hypothesis.
+    """
+    g = jax.random.gumbel(key, valid.shape, dtype=jnp.float32)
+    scores = jnp.where(valid, g, -jnp.inf)
+    _, idx = lax.top_k(scores, m)
+    w = jnp.zeros(valid.shape, jnp.float32).at[idx].set(1.0)
+    return w * valid.astype(jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "n_hypotheses", "refine_iters")
+)
+def ransac_estimate(
+    model: TransformModel,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    valid: jnp.ndarray,
+    key: jnp.ndarray,
+    n_hypotheses: int = 128,
+    threshold: float = 2.0,
+    refine_iters: int = 2,
+) -> RansacResult:
+    """Estimate `model`'s transform mapping src -> dst by RANSAC consensus.
+
+    src/dst: (N, d) matched point pairs; valid: (N,) mask of real matches.
+    Fully jit/vmap-safe: fixed H hypotheses, masked scoring, fixed-round
+    IRLS refinement.
+    """
+    thresh_sq = jnp.float32(threshold * threshold)
+    validf = valid.astype(jnp.float32)
+
+    def one_hypothesis(k):
+        w = _sample_weights(k, valid, model.min_samples)
+        M = model.solve(src, dst, w)
+        r = model.residual(M, src, dst)
+        inl = (r < thresh_sq) & valid
+        return M, jnp.sum(inl)
+
+    keys = jax.random.split(key, n_hypotheses)
+    Ms, scores = jax.vmap(one_hypothesis)(keys)
+    best = jnp.argmax(scores)
+    M0 = Ms[best]
+    n0 = scores[best]
+
+    def refine_step(carry, _):
+        M, n_in = carry
+        r = model.residual(M, src, dst)
+        w = ((r < thresh_sq) & valid).astype(jnp.float32)
+        M2 = model.solve(src, dst, w)
+        r2 = model.residual(M2, src, dst)
+        n2 = jnp.sum((r2 < thresh_sq) & valid)
+        # Keep the refinement only if it doesn't lose consensus.
+        better = n2 >= n_in
+        M_out = jnp.where(better, M2, M)
+        return (M_out, jnp.maximum(n2, n_in)), None
+
+    (Mf, _), _ = lax.scan(refine_step, (M0, n0), None, length=refine_iters)
+
+    r = model.residual(Mf, src, dst)
+    inl = (r < thresh_sq) & valid
+    n_in = jnp.sum(inl)
+    rms = jnp.sqrt(
+        jnp.sum(jnp.where(inl, r, 0.0)) / jnp.maximum(n_in.astype(jnp.float32), 1.0)
+    )
+    return RansacResult(
+        transform=Mf,
+        n_inliers=n_in.astype(jnp.int32),
+        inlier_mask=inl,
+        rms_residual=rms,
+    )

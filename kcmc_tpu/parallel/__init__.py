@@ -1,0 +1,12 @@
+"""Distributed execution: frame-batch sharding over the TPU ICI mesh.
+
+SURVEY.md §2's parallelism contract: data parallelism over frames with
+one collective — the all-gather of reference-frame descriptors. Built on
+`jax.sharding.Mesh` + `shard_map` with XLA collectives over ICI/DCN (the
+TPU-native equivalent of the reference's multi-device backend).
+"""
+
+from kcmc_tpu.parallel.mesh import make_mesh, FRAME_AXIS
+from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
+
+__all__ = ["make_mesh", "make_sharded_batch_fn", "FRAME_AXIS"]

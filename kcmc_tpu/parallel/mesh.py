@@ -1,0 +1,24 @@
+"""Device-mesh helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# The single mesh axis of this pipeline: data parallelism over frames.
+# (The reference workload has no sequence/tensor/pipeline dimension —
+# SURVEY.md §2 — so the mesh is 1-D; multi-host meshes simply extend
+# this axis across hosts and the same program runs over ICI + DCN.)
+FRAME_AXIS = "frames"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the first `n_devices` (default: all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (FRAME_AXIS,))

@@ -1,0 +1,1 @@
+"""Utilities: synthetic workloads, evaluation metrics, timing, checkpointing."""

@@ -1,0 +1,37 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Tests never require real TPU hardware; multi-chip sharding is exercised
+on fake CPU devices per SURVEY.md §4. Must run before `jax` is first
+imported anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# NOTE: do NOT enable JAX_COMPILATION_CACHE_DIR here — the persistent
+# compilation cache hangs indefinitely in this image (verified: even a
+# trivial jit never completes with it set).
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+# The image's TPU-tunnel plugin ("axon", registered by sitecustomize)
+# force-sets jax_platforms="axon,cpu" via jax.config, which overrides the
+# env var above and makes every backend init dial the (single-tenant) TPU
+# tunnel — hanging tests whenever the chip is busy or wedged. Tests are
+# CPU-only by design (SURVEY.md §4), so pin the config back.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,114 @@
+"""Behavioral tests: detection finds real corners; descriptors match across
+translated frames; KNN matching recovers the ground-truth shift."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kcmc_tpu.ops.detect import detect_keypoints
+from kcmc_tpu.ops.describe import describe_keypoints, N_WORDS
+from kcmc_tpu.ops.match import knn_match, popcount_u32, hamming_matrix
+from kcmc_tpu.utils import synthetic
+
+
+@pytest.fixture(scope="module")
+def scene():
+    rng = np.random.default_rng(42)
+    return synthetic.render_scene(rng, (160, 160), n_blobs=60)
+
+
+def test_detect_finds_blob_peaks(scene):
+    kps = detect_keypoints(jnp.asarray(scene), max_keypoints=128)
+    assert kps.xy.shape == (128, 2)
+    n_valid = int(kps.valid.sum())
+    assert n_valid > 20, f"expected plenty of corners, got {n_valid}"
+    # all valid keypoints inside the border
+    xy = np.asarray(kps.xy)[np.asarray(kps.valid)]
+    assert (xy >= 15).all() and (xy <= 160 - 15).all()
+    # scores sorted descending
+    sc = np.asarray(kps.score)[np.asarray(kps.valid)]
+    assert (np.diff(sc) <= 1e-6).all()
+
+
+def test_detect_subpixel_tracks_shift(scene):
+    """Shifting the image by a fraction of a pixel must move detections."""
+    shift = 0.4
+    H, W = scene.shape
+    ys, xs = np.meshgrid(np.arange(H, dtype=np.float32), np.arange(W, dtype=np.float32), indexing="ij")
+    shifted = synthetic._bilinear(scene, xs - shift, ys)
+    k0 = detect_keypoints(jnp.asarray(scene), max_keypoints=64)
+    k1 = detect_keypoints(jnp.asarray(shifted), max_keypoints=64)
+    xy0 = np.asarray(k0.xy)[np.asarray(k0.valid)]
+    xy1 = np.asarray(k1.xy)[np.asarray(k1.valid)]
+    # match nearest keypoints between the two sets
+    d = np.linalg.norm(xy0[:, None] - xy1[None, :], axis=-1)
+    nn = d.argmin(1)
+    close = d[np.arange(len(xy0)), nn] < 1.5
+    dx = (xy1[nn[close], 0] - xy0[close, 0]).mean()
+    assert abs(dx - shift) < 0.15, f"mean dx {dx}, want ~{shift}"
+
+
+def test_describe_shapes_and_masking(scene):
+    kps = detect_keypoints(jnp.asarray(scene), max_keypoints=64)
+    desc = describe_keypoints(jnp.asarray(scene), kps)
+    assert desc.shape == (64, N_WORDS)
+    assert desc.dtype == jnp.uint32
+    invalid = ~np.asarray(kps.valid)
+    assert (np.asarray(desc)[invalid] == 0).all()
+
+
+def test_popcount():
+    x = jnp.asarray(np.array([0, 1, 3, 0xFFFFFFFF, 0xAAAAAAAA], dtype=np.uint32))
+    np.testing.assert_array_equal(np.asarray(popcount_u32(x)), [0, 1, 2, 32, 16])
+
+
+def test_match_recovers_translation(scene):
+    """detect+describe+match across a shifted frame: displacement of valid
+    matches equals the shift."""
+    t = np.array([5.0, -3.0], dtype=np.float32)
+    H, W = scene.shape
+    ys, xs = np.meshgrid(np.arange(H, dtype=np.float32), np.arange(W, dtype=np.float32), indexing="ij")
+    moved = synthetic._bilinear(scene, xs - t[0], ys - t[1])
+
+    kr = detect_keypoints(jnp.asarray(scene), max_keypoints=128)
+    dr = describe_keypoints(jnp.asarray(scene), kr)
+    kq = detect_keypoints(jnp.asarray(moved), max_keypoints=128)
+    dq = describe_keypoints(jnp.asarray(moved), kq)
+
+    m = knn_match(dq, dr, kq.valid, kr.valid)
+    n_valid = int(m.valid.sum())
+    assert n_valid > 15, f"too few matches: {n_valid}"
+    q_xy = np.asarray(kq.xy)
+    r_xy = np.asarray(kr.xy)[np.asarray(m.idx)]
+    disp = (q_xy - r_xy)[np.asarray(m.valid)]
+    med = np.median(disp, axis=0)
+    np.testing.assert_allclose(med, t, atol=0.3)
+
+
+def test_match_masks_invalid():
+    """Zero/invalid descriptors must never produce valid matches."""
+    q = jnp.zeros((16, N_WORDS), dtype=jnp.uint32)
+    r = jnp.zeros((16, N_WORDS), dtype=jnp.uint32)
+    v = jnp.zeros(16, dtype=bool)
+    m = knn_match(q, r, v, v)
+    assert not bool(m.valid.any())
+
+
+def test_hamming_matrix_identity():
+    rng = np.random.default_rng(0)
+    d = jnp.asarray(rng.integers(0, 2**32, size=(8, N_WORDS), dtype=np.uint32))
+    v = jnp.ones(8, dtype=bool)
+    D = np.asarray(hamming_matrix(d, d, v, v))
+    assert (np.diag(D) == 0).all()
+    assert (D == D.T).all()
+
+
+def test_detect_describe_vmap_over_frames(scene):
+    """The per-frame ops must vmap over a frame batch (pipeline contract)."""
+    stack = jnp.stack([jnp.asarray(scene)] * 3)
+    kps = jax.vmap(lambda f: detect_keypoints(f, max_keypoints=32))(stack)
+    assert kps.xy.shape == (3, 32, 2)
+    descs = jax.vmap(describe_keypoints)(stack, kps)
+    assert descs.shape == (3, 32, N_WORDS)
+    np.testing.assert_array_equal(np.asarray(descs[0]), np.asarray(descs[2]))

@@ -1,0 +1,44 @@
+"""End-to-end 3D volumetric rigid registration — judged config 5."""
+
+import numpy as np
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.utils import synthetic
+from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+SHAPE3D = (24, 96, 96)
+
+
+def test_rigid3d_drift_recovery():
+    data = synthetic.make_drift_stack_3d(
+        n_frames=4, shape=SHAPE3D, max_drift=3.0, max_angle=0.02, seed=13
+    )
+    mc = MotionCorrector(
+        model="rigid3d",
+        backend="jax",
+        batch_size=2,
+        max_keypoints=256,
+        border=10,
+        inlier_threshold=2.0,
+    )
+    res = mc.correct(data.stack)
+    assert res.corrected.shape == data.stack.shape
+    assert res.transforms.shape == (4, 4, 4)
+    rel = relative_transforms(data.transforms)
+    rmse = transform_rmse(res.transforms, rel, SHAPE3D, n_per_axis=5)
+    assert rmse < 1.0, f"3D rigid RMSE {rmse:.3f} px"
+    assert (res.diagnostics["n_inliers"][1:] > 8).all()
+
+
+def test_3d_detection_finds_features():
+    import jax.numpy as jnp
+
+    from kcmc_tpu.ops.detect3d import detect_keypoints_3d
+
+    rng = np.random.default_rng(0)
+    vol = synthetic.render_scene(rng, (16, 64, 64), n_blobs=60)
+    kps = detect_keypoints_3d(jnp.asarray(vol), max_keypoints=64, border=6)
+    n = int(np.asarray(kps.valid).sum())
+    assert n > 10
+    xyz = np.asarray(kps.xy)[np.asarray(kps.valid)]
+    assert (xyz[:, 0] <= 63).all() and (xyz[:, 2] <= 15).all()

@@ -1,0 +1,109 @@
+"""RANSAC consensus tests: outlier rejection, exact recovery, vmap over frames."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kcmc_tpu.models import apply_transform, get_model
+from kcmc_tpu.ops.ransac import ransac_estimate
+
+from test_transforms import make_gt, random_points
+
+
+def corrupt(dst, rng, frac):
+    """Replace a fraction of correspondences with gross outliers."""
+    dst = np.array(dst)
+    n = len(dst)
+    k = int(frac * n)
+    idx = rng.choice(n, k, replace=False)
+    dst[idx] = rng.uniform(0, 200, size=(k, dst.shape[1])).astype(np.float32)
+    return dst, idx
+
+
+@pytest.mark.parametrize("name", ["translation", "rigid", "affine", "homography", "rigid3d"])
+def test_ransac_rejects_outliers(name, rng):
+    model = get_model(name)
+    src = random_points(rng, 128, model.ndim)
+    M_gt = make_gt(name, rng)
+    dst_clean = np.asarray(apply_transform(jnp.asarray(M_gt), jnp.asarray(src)))
+    dst, out_idx = corrupt(dst_clean, rng, frac=0.4)
+    # small noise on inliers
+    dst = dst + rng.normal(0, 0.05, dst.shape).astype(np.float32)
+
+    res = ransac_estimate(
+        model,
+        jnp.asarray(src),
+        jnp.asarray(dst),
+        jnp.ones(128, dtype=bool),
+        jax.random.key(0),
+        n_hypotheses=128,
+        threshold=2.0,
+    )
+    resid = model.residual(res.transform, jnp.asarray(src), jnp.asarray(dst_clean))
+    rms = float(jnp.sqrt(jnp.mean(resid)))
+    assert rms < 0.2, f"{name}: rms vs clean dst {rms}"
+    assert int(res.n_inliers) > 60
+    # the gross outliers must be flagged as outliers
+    inl = np.asarray(res.inlier_mask)
+    assert not inl[out_idx].any()
+
+
+def test_ransac_respects_valid_mask(rng):
+    """Invalid matches must be ignored even if geometrically consistent."""
+    model = get_model("translation")
+    src = random_points(rng, 64, 2)
+    # valid half moves by (5, 5); invalid half by (-20, -20)
+    dst = src.copy()
+    dst[:32] += 5.0
+    dst[32:] -= 20.0
+    valid = np.zeros(64, dtype=bool)
+    valid[:32] = True
+    res = ransac_estimate(
+        model, jnp.asarray(src), jnp.asarray(dst), jnp.asarray(valid), jax.random.key(1)
+    )
+    np.testing.assert_allclose(np.asarray(res.transform)[:2, 2], [5.0, 5.0], atol=1e-3)
+    assert int(res.n_inliers) == 32
+
+
+def test_ransac_no_valid_matches_gives_identity():
+    model = get_model("affine")
+    src = jnp.zeros((32, 2))
+    dst = jnp.zeros((32, 2))
+    valid = jnp.zeros(32, dtype=bool)
+    res = ransac_estimate(model, src, dst, valid, jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(res.transform), np.eye(3), atol=1e-6)
+    assert int(res.n_inliers) == 0
+
+
+def test_ransac_vmaps_over_frames(rng):
+    """(frames x hypotheses) batching — the BASELINE north-star structure."""
+    model = get_model("rigid")
+    F, N = 4, 64
+    srcs = np.stack([random_points(rng, N, 2) for _ in range(F)])
+    gts = np.stack([make_gt("rigid", rng) for _ in range(F)])
+    dsts = np.stack(
+        [np.asarray(apply_transform(jnp.asarray(gts[i]), jnp.asarray(srcs[i]))) for i in range(F)]
+    )
+    keys = jax.random.split(jax.random.key(7), F)
+    fn = jax.jit(
+        jax.vmap(
+            lambda s, d, k: ransac_estimate(
+                model, s, d, jnp.ones(N, dtype=bool), k, n_hypotheses=64
+            )
+        )
+    )
+    res = fn(jnp.asarray(srcs), jnp.asarray(dsts), keys)
+    assert res.transform.shape == (F, 3, 3)
+    for i in range(F):
+        np.testing.assert_allclose(np.asarray(res.transform[i]), gts[i], atol=5e-2)
+
+
+def test_ransac_deterministic(rng):
+    """Same key => identical result (cross-backend reproducibility contract)."""
+    model = get_model("translation")
+    src = random_points(rng, 64, 2)
+    dst = src + np.array([3.0, -2.0], np.float32)
+    a = ransac_estimate(model, jnp.asarray(src), jnp.asarray(dst), jnp.ones(64, bool), jax.random.key(5))
+    b = ransac_estimate(model, jnp.asarray(src), jnp.asarray(dst), jnp.ones(64, bool), jax.random.key(5))
+    np.testing.assert_array_equal(np.asarray(a.transform), np.asarray(b.transform))

@@ -1,0 +1,70 @@
+"""Tests for synthetic workload generation and evaluation metrics."""
+
+import numpy as np
+
+from kcmc_tpu.utils import metrics, synthetic
+
+
+def test_drift_stack_shapes():
+    data = synthetic.make_drift_stack(n_frames=4, shape=(64, 64), model="translation")
+    assert data.stack.shape == (4, 64, 64)
+    assert data.transforms.shape == (4, 3, 3)
+    assert np.isfinite(data.stack).all()
+    # frame 0 drift is small but transforms are exact homogeneous matrices
+    np.testing.assert_allclose(data.transforms[:, 2, 2], 1.0)
+
+
+def test_drift_stack_translation_consistency():
+    """The generated frame must equal the scene shifted by the gt transform."""
+    data = synthetic.make_drift_stack(n_frames=3, shape=(96, 96), model="translation", noise=0.0, seed=3)
+    t = data.transforms[2][:2, 2]
+    # Sample the scene at integer grid minus drift and compare interior.
+    H, W = data.stack.shape[1:]
+    ys, xs = np.meshgrid(np.arange(H, dtype=np.float32), np.arange(W, dtype=np.float32), indexing="ij")
+    expected = synthetic._bilinear(data.reference, xs - t[0], ys - t[1])
+    m = 20
+    np.testing.assert_allclose(
+        data.stack[2][m:-m, m:-m], expected[m:-m, m:-m], atol=1e-4
+    )
+
+
+def test_piecewise_stack_shapes():
+    data = synthetic.make_piecewise_stack(n_frames=3, shape=(64, 64), grid=(8, 8))
+    assert data.stack.shape == (3, 64, 64)
+    assert data.fields.shape == (3, 8, 8, 2)
+
+
+def test_3d_stack_shapes():
+    data = synthetic.make_drift_stack_3d(n_frames=2, shape=(16, 48, 48))
+    assert data.stack.shape == (2, 16, 48, 48)
+    assert data.transforms.shape == (2, 4, 4)
+    R = data.transforms[1][:3, :3]
+    np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-5)
+
+
+def test_transform_rmse_zero_for_identical():
+    T = np.tile(np.eye(3, dtype=np.float32), (5, 1, 1))
+    assert metrics.transform_rmse(T, T, (64, 64)) == 0.0
+
+
+def test_transform_rmse_translation_units():
+    """A pure 3-4 translation error must give RMSE = 5 px exactly."""
+    gt = np.tile(np.eye(3, dtype=np.float32), (2, 1, 1))
+    est = gt.copy()
+    est[:, 0, 2] = 3.0
+    est[:, 1, 2] = 4.0
+    assert abs(metrics.transform_rmse(est, gt, (64, 64)) - 5.0) < 1e-5
+
+
+def test_stage_timer():
+    t = metrics.StageTimer()
+    with t.stage("detect"):
+        pass
+    with t.stage("detect"):
+        pass
+    with t.stage("warp"):
+        pass
+    rep = t.report(n_frames=10)
+    assert set(rep["stages_s"]) == {"detect", "warp"}
+    assert t.counts["detect"] == 2
+    assert rep["frames_per_sec"] > 0

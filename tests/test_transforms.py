@@ -1,0 +1,139 @@
+"""Unit tests for transform models: recover known transforms from point sets."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kcmc_tpu.models import MODELS, apply_transform, get_model
+
+
+def random_points(rng, n, ndim, scale=100.0):
+    return rng.uniform(10, scale, size=(n, ndim)).astype(np.float32)
+
+
+def make_gt(name, rng):
+    """A ground-truth matrix for each model family."""
+    if name == "translation":
+        M = np.eye(3, dtype=np.float32)
+        M[:2, 2] = rng.uniform(-20, 20, 2)
+    elif name == "rigid":
+        th = rng.uniform(-0.5, 0.5)
+        c, s = np.cos(th), np.sin(th)
+        M = np.array([[c, -s, 5.0], [s, c, -3.0], [0, 0, 1]], dtype=np.float32)
+    elif name == "affine":
+        M = np.eye(3, dtype=np.float32)
+        M[:2, :2] += rng.uniform(-0.2, 0.2, (2, 2))
+        M[:2, 2] = rng.uniform(-10, 10, 2)
+    elif name == "homography":
+        M = np.eye(3, dtype=np.float32)
+        M[:2, :2] += rng.uniform(-0.1, 0.1, (2, 2))
+        M[:2, 2] = rng.uniform(-10, 10, 2)
+        M[2, :2] = rng.uniform(-1e-4, 1e-4, 2)
+    elif name == "rigid3d":
+        ax = rng.normal(size=3)
+        ax /= np.linalg.norm(ax)
+        th = rng.uniform(-0.4, 0.4)
+        K = np.array(
+            [[0, -ax[2], ax[1]], [ax[2], 0, -ax[0]], [-ax[1], ax[0], 0]], dtype=np.float64
+        )
+        R = np.eye(3) + np.sin(th) * K + (1 - np.cos(th)) * K @ K
+        M = np.eye(4, dtype=np.float32)
+        M[:3, :3] = R.astype(np.float32)
+        M[:3, 3] = rng.uniform(-5, 5, 3)
+    else:
+        raise ValueError(name)
+    return M
+
+
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_exact_recovery(name, rng):
+    """solve() on noiseless correspondences recovers the transform."""
+    model = get_model(name)
+    src = random_points(rng, 64, model.ndim)
+    M_gt = make_gt(name, rng)
+    dst = np.asarray(apply_transform(jnp.asarray(M_gt), jnp.asarray(src)))
+    w = np.ones(64, dtype=np.float32)
+    M = model.solve(jnp.asarray(src), jnp.asarray(dst), jnp.asarray(w))
+    resid = model.residual(M, jnp.asarray(src), jnp.asarray(dst))
+    assert float(jnp.max(resid)) < 1e-3, f"{name}: max sq-resid {float(jnp.max(resid))}"
+
+
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_weights_ignore_outliers(name, rng):
+    """Zero-weighted gross outliers must not perturb the solve."""
+    model = get_model(name)
+    src = random_points(rng, 64, model.ndim)
+    M_gt = make_gt(name, rng)
+    dst = np.array(apply_transform(jnp.asarray(M_gt), jnp.asarray(src)))
+    dst[::4] += 500.0  # corrupt 25% of points
+    w = np.ones(64, dtype=np.float32)
+    w[::4] = 0.0
+    M = model.solve(jnp.asarray(src), jnp.asarray(dst), jnp.asarray(w))
+    resid = model.residual(M, jnp.asarray(src), jnp.asarray(dst))
+    inlier_resid = np.asarray(resid)[w > 0]
+    assert inlier_resid.max() < 1e-3
+
+
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_minimal_sample_solve(name, rng):
+    """Solving from exactly min_samples points reproduces those points."""
+    model = get_model(name)
+    n = model.min_samples
+    src = random_points(rng, n, model.ndim)
+    M_gt = make_gt(name, rng)
+    dst = np.asarray(apply_transform(jnp.asarray(M_gt), jnp.asarray(src)))
+    w = np.ones(n, dtype=np.float32)
+    M = model.solve(jnp.asarray(src), jnp.asarray(dst), jnp.asarray(w))
+    resid = model.residual(M, jnp.asarray(src), jnp.asarray(dst))
+    assert float(jnp.max(resid)) < 1e-2
+
+
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_degenerate_inputs_are_finite(name):
+    """All-zero weights / coincident points must yield a finite matrix."""
+    model = get_model(name)
+    src = jnp.ones((8, model.ndim), dtype=jnp.float32)
+    dst = jnp.ones((8, model.ndim), dtype=jnp.float32)
+    w = jnp.zeros(8, dtype=jnp.float32)
+    M = model.solve(src, dst, w)
+    # Must fall back to the identity, not a finite collapse map (which
+    # could spuriously win the RANSAC inlier vote).
+    np.testing.assert_allclose(np.asarray(M), np.eye(model.mat_size), atol=1e-6)
+    M2 = model.solve(src, dst, jnp.ones(8, dtype=jnp.float32))
+    assert bool(jnp.all(jnp.isfinite(M2)))
+    if name == "rigid":
+        # coincident points with real weight mass: rotation undefined
+        np.testing.assert_allclose(
+            np.asarray(M2)[:2, :2], np.eye(2), atol=1e-6
+        )
+
+
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_solve_is_vmappable_and_jittable(name, rng):
+    """The solve must compile and batch over leading axes (frames x hyps)."""
+    model = get_model(name)
+    B = 5
+    srcs, dsts = [], []
+    for _ in range(B):
+        src = random_points(rng, 16, model.ndim)
+        M_gt = make_gt(name, rng)
+        dst = np.asarray(apply_transform(jnp.asarray(M_gt), jnp.asarray(src)))
+        srcs.append(src)
+        dsts.append(dst)
+    src_b = jnp.asarray(np.stack(srcs))
+    dst_b = jnp.asarray(np.stack(dsts))
+    w_b = jnp.ones((B, 16), dtype=jnp.float32)
+    solve_b = jax.jit(jax.vmap(model.solve))
+    M_b = solve_b(src_b, dst_b, w_b)
+    assert M_b.shape == (B, model.mat_size, model.mat_size)
+    resid = jax.vmap(model.residual)(M_b, src_b, dst_b)
+    assert float(jnp.max(resid)) < 1e-2
+
+
+def test_homography_projective_divide():
+    """apply_transform performs the w-divide for true projective maps."""
+    H = jnp.array([[1.0, 0, 0], [0, 1.0, 0], [0.001, 0, 1.0]], dtype=jnp.float32)
+    pts = jnp.array([[100.0, 50.0]], dtype=jnp.float32)
+    out = apply_transform(H, pts)
+    np.testing.assert_allclose(np.asarray(out), [[100 / 1.1, 50 / 1.1]], rtol=1e-5)
