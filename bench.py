@@ -1,0 +1,124 @@
+"""Benchmark: registration throughput on the judged workload.
+
+Runs the flagship translation-drift config (BASELINE.md: 512x512 stack,
+target >= 200 frames/sec/chip) on whatever accelerator JAX exposes (the
+real TPU chip under the driver; CPU if forced) and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`vs_baseline` is value / 200 — the driver-set target, since the
+reference has no published numbers (BASELINE.json `published` == {}).
+
+Flags:
+    --frames N     total frames to time (default 2048; the 10k-frame
+                   judged stack is pure steady-state repetition)
+    --size S       frame side (default 512)
+    --model M      transform family (default translation)
+    --batch B      frames per device step (default 64)
+    --all          also print per-config lines for the other workloads
+                   (stderr, diagnostic only)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _build_stack(n_frames: int, size: int, model: str):
+    """Synthetic drift stack; generation is host-side and excluded from
+    the timed region. For speed, generate `base` frames and tile."""
+    from kcmc_tpu.utils.synthetic import make_drift_stack, make_piecewise_stack
+
+    base = min(n_frames, 64)
+    if model == "piecewise":
+        data = make_piecewise_stack(n_frames=base, shape=(size, size), seed=0)
+    else:
+        data = make_drift_stack(
+            n_frames=base, shape=(size, size), model=model, max_drift=10.0, seed=0
+        )
+    reps = (n_frames + base - 1) // base
+    stack = np.tile(data.stack, (reps, 1, 1))[:n_frames]
+    return data, stack
+
+
+def run_bench(n_frames: int, size: int, model: str, batch: int) -> dict:
+    from kcmc_tpu import MotionCorrector
+
+    data, stack = _build_stack(n_frames, size, model)
+    mc = MotionCorrector(model=model, backend="jax", batch_size=batch)
+
+    # Warmup: compile the batch program + reference prep outside the
+    # timed region (steady-state throughput is the judged number).
+    mc.correct(stack[: batch * 2])
+
+    t0 = time.perf_counter()
+    res = mc.correct(stack)
+    dt = time.perf_counter() - t0
+    fps = n_frames / dt
+
+    # sanity: the recovered motion must actually be correct
+    base = len(data.stack)
+    if model == "piecewise":
+        from kcmc_tpu.utils.metrics import field_rmse
+
+        rmse = field_rmse(res.fields[:base], data.fields - data.fields[0])
+    else:
+        from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+        rmse = transform_rmse(
+            res.transforms[:base],
+            relative_transforms(data.transforms),
+            (size, size),
+        )
+    return {"fps": fps, "seconds": dt, "rmse_px": rmse, "n_frames": n_frames}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frames", type=int, default=2048)
+    ap.add_argument("--size", type=int, default=512)
+    ap.add_argument("--model", default="translation")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--all", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"[bench] device: {dev}", file=sys.stderr)
+
+    r = run_bench(args.frames, args.size, args.model, args.batch)
+    print(
+        f"[bench] {args.model} {args.size}x{args.size}: {r['fps']:.1f} fps, "
+        f"rmse {r['rmse_px']:.3f} px",
+        file=sys.stderr,
+    )
+
+    if args.all:
+        for model in ("rigid", "affine", "homography", "piecewise"):
+            rr = run_bench(max(256, args.frames // 4), args.size, model, args.batch)
+            print(
+                f"[bench] {model}: {rr['fps']:.1f} fps, rmse {rr['rmse_px']:.3f} px",
+                file=sys.stderr,
+            )
+
+    target = 200.0  # frames/sec/chip — BASELINE.json north-star target
+    print(
+        json.dumps(
+            {
+                "metric": f"registration_throughput_{args.model}_{args.size}x{args.size}",
+                "value": round(r["fps"], 2),
+                "unit": "frames/sec/chip",
+                "vs_baseline": round(r["fps"] / target, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -158,10 +158,34 @@ class JaxBackend:
 
         return stage
 
+    def _resolve_warp_fn(self):
+        """Pick the warp implementation per the `warp` config policy."""
+        cfg = self.config
+        # The Pallas kernel lowers via TPU Mosaic only. "axon" is this
+        # image's tunneled-TPU platform name.
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+        use_pallas = cfg.warp == "pallas" or (
+            cfg.warp == "auto" and cfg.model == "translation" and on_tpu
+        )
+        if use_pallas:
+            if cfg.model != "translation":
+                raise ValueError(
+                    "warp='pallas' is the gather-free translation kernel; "
+                    f"model {cfg.model!r} needs warp='jnp' (or 'auto')"
+                )
+            from kcmc_tpu.ops.pallas_warp import warp_frame_translation
+
+            interp = not on_tpu  # interpret mode off-TPU
+            return lambda frame, M: warp_frame_translation(
+                frame, jnp.stack([M[0, 2], M[1, 2]]), interpret=interp
+            )
+        return warp_frame
+
     def _make_matrix_per_frame(self, shape):
         cfg = self.config
         model = get_model(cfg.model)
         stage = self._detect_describe_match(cfg)
+        warp_fn = self._resolve_warp_fn()
 
         def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
             src, dst, valid, kps = stage(frame, ref_xy, ref_desc, ref_valid)
@@ -175,7 +199,7 @@ class JaxBackend:
                 threshold=cfg.inlier_threshold,
                 refine_iters=cfg.refine_iters,
             )
-            corrected = warp_frame(frame, res.transform)
+            corrected = warp_fn(frame, res.transform)
             return {
                 "transform": res.transform,
                 "corrected": corrected,

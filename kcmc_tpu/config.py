@@ -46,6 +46,16 @@ class CorrectorConfig:
 
     # -- execution ---------------------------------------------------------
     batch_size: int = 32  # frames per jitted device step
+    # Warp kernel selection: "jnp" = XLA gather warp (all models);
+    # "pallas" = gather-free Pallas kernel (translation model only);
+    # "auto" = pallas for translation on an accelerator, jnp otherwise.
+    warp: str = "auto"
+
+    def __post_init__(self):
+        if self.warp not in ("auto", "jnp", "pallas"):
+            raise ValueError(
+                f"warp must be 'auto', 'jnp', or 'pallas', got {self.warp!r}"
+            )
 
     def resolved_oriented(self) -> bool:
         if self.oriented is None:

@@ -1,0 +1,124 @@
+"""Pallas TPU kernel: gather-free bilinear warp for translation motion.
+
+The generic warp (ops/warp.py) is 4 arbitrary gathers — exactly what the
+TPU memory system dislikes. For *pure translation* (the flagship
+config-1 benchmark path) the bilinear resample needs no gathers at all:
+every output pixel samples the same fractional offset, so
+
+    out = w00*S(0,0) + w01*S(0,1) + w10*S(1,0) + w11*S(1,1)
+
+where S(dy, dx) are four statically-shifted views of ONE dynamically
+positioned VMEM window (origin = floor of the shift, from SMEM scalars),
+and the four weights are scalars. The kernel is a pure VPU FMA stream
+at full lane utilization.
+
+Out-of-bounds semantics match ops/warp.py: the frame is edge-padded on
+the host (so interior blends clamp like the jnp gather version) and an
+iota-based validity mask zeroes pixels whose true source falls outside
+the frame. Translations beyond PAD pixels (far outside the judged drift
+regime of tens of pixels) zero the whole frame rather than silently
+returning misregistered content.
+
+Exposed via `warp_frame_translation(frame, t)`, and selected by the jax
+backend's `warp="auto"` policy for the translation model on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PAD = 128  # max |shift| handled exactly, pixels
+
+
+def _warp_kernel(scal_ref, src_ref, out_ref):
+    """scal_ref: (7,) float32 scalars in SMEM:
+    [y0, x0] window origin into the padded source, [fy, fx] bilinear
+    fractions, [ty, tx] the true shift (for the validity mask), and
+    [exact] the shift-within-window flag.
+    """
+    y0 = scal_ref[0].astype(jnp.int32)
+    x0 = scal_ref[1].astype(jnp.int32)
+    fy = scal_ref[2]
+    fx = scal_ref[3]
+    ty = scal_ref[4]
+    tx = scal_ref[5]
+    exact = scal_ref[6]  # 1.0 iff the shift is within the window's range
+
+    H, W = out_ref.shape
+    # One dynamically-positioned window read; four static shifted views.
+    win = src_ref[pl.ds(y0, H + 1), pl.ds(x0, W + 1)]
+    w00 = (1.0 - fy) * (1.0 - fx)
+    w01 = (1.0 - fy) * fx
+    w10 = fy * (1.0 - fx)
+    w11 = fy * fx
+    blend = (
+        w00 * win[:-1, :-1]
+        + w01 * win[:-1, 1:]
+        + w10 * win[1:, :-1]
+        + w11 * win[1:, 1:]
+    )
+    # Validity: true source coord (r + ty, c + tx) inside the frame.
+    rows = jax.lax.broadcasted_iota(jnp.float32, (H, W), 0) + ty
+    cols = jax.lax.broadcasted_iota(jnp.float32, (H, W), 1) + tx
+    inb = (
+        (rows >= 0.0) & (rows <= H - 1.0) & (cols >= 0.0) & (cols <= W - 1.0)
+        & (exact > 0.5)
+    )
+    out_ref[:, :] = jnp.where(inb, blend, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def warp_frame_translation(
+    frame: jnp.ndarray, t: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Correct a (H, W) frame under pure translation t = (tx, ty).
+
+    Matches `warp_frame(frame, M)` for M = [[1,0,tx],[0,1,ty],[0,0,1]]
+    up to float rounding, with zero gathers on TPU.
+    """
+    H, W = frame.shape
+    tx, ty = t[0], t[1]
+    # Edge-pad so interior blends clamp exactly like the gather version.
+    padded = jnp.pad(frame, PAD, mode="edge")
+    y0 = jnp.floor(ty)
+    x0 = jnp.floor(tx)
+    fy = ty - y0
+    fx = tx - x0
+    # Exactness range of the dynamic window: origin must not clamp.
+    # Beyond it the kernel cannot fetch the right content, so the whole
+    # frame is masked to zero (conservative) instead of silently
+    # returning misregistered pixels.
+    exact = (
+        (y0 >= -PAD) & (y0 <= PAD - 1) & (x0 >= -PAD) & (x0 <= PAD - 1)
+    ).astype(jnp.float32)
+    oy = jnp.clip(y0.astype(jnp.int32) + PAD, 0, 2 * PAD - 1)
+    ox = jnp.clip(x0.astype(jnp.int32) + PAD, 0, 2 * PAD - 1)
+    scal = jnp.stack(
+        [oy.astype(jnp.float32), ox.astype(jnp.float32), fy, fx, ty, tx, exact]
+    )
+
+    return pl.pallas_call(
+        _warp_kernel,
+        out_shape=jax.ShapeDtypeStruct((H, W), jnp.float32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(scal, padded.astype(jnp.float32))
+
+
+def warp_batch_translation(
+    frames: jnp.ndarray, transforms: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """(B, H, W) frames, (B, 3, 3) translation matrices -> corrected batch."""
+    ts = transforms[:, :2, 2]  # (B, 2) (tx, ty)
+    return jax.vmap(lambda f, t: warp_frame_translation(f, t, interpret=interpret))(
+        frames, ts
+    )

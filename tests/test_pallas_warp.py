@@ -1,0 +1,42 @@
+"""Pallas translation-warp kernel vs the jnp gather warp (interpret mode on CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kcmc_tpu.ops.pallas_warp import warp_batch_translation, warp_frame_translation
+from kcmc_tpu.ops.warp import warp_frame
+from kcmc_tpu.utils import synthetic
+
+
+def _mat(tx, ty):
+    return jnp.asarray(
+        np.array([[1, 0, tx], [0, 1, ty], [0, 0, 1]], dtype=np.float32)
+    )
+
+
+@pytest.fixture(scope="module")
+def img():
+    rng = np.random.default_rng(5)
+    return jnp.asarray(synthetic.render_scene(rng, (96, 96), n_blobs=40))
+
+
+@pytest.mark.parametrize(
+    "tx,ty",
+    [(0.0, 0.0), (3.0, -2.0), (2.5, 1.25), (-7.75, 4.5), (0.5, 0.5), (-20.25, 30.5)],
+)
+def test_matches_gather_warp(img, tx, ty):
+    ref = np.asarray(warp_frame(img, _mat(tx, ty)))
+    out = np.asarray(
+        warp_frame_translation(img, jnp.asarray([tx, ty], jnp.float32), interpret=True)
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_batch(img):
+    frames = jnp.stack([img, img * 0.5, img + 0.1])
+    mats = jnp.stack([_mat(1.5, -2.0), _mat(0.0, 0.0), _mat(-3.25, 4.0)])
+    out = np.asarray(warp_batch_translation(frames, mats, interpret=True))
+    for i in range(3):
+        ref = np.asarray(warp_frame(frames[i], mats[i]))
+        np.testing.assert_allclose(out[i], ref, atol=1e-5)

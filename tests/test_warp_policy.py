@@ -1,0 +1,40 @@
+"""Warp-kernel selection policy and pallas-path pipeline equivalence."""
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.utils import synthetic
+
+
+def test_pallas_warp_pipeline_matches_jnp():
+    """Forcing the Pallas translation warp must not change results
+    (interpret mode on CPU)."""
+    data = synthetic.make_drift_stack(
+        n_frames=4, shape=(128, 128), model="translation", seed=51
+    )
+    r_jnp = MotionCorrector(
+        model="translation", backend="jax", batch_size=4, warp="jnp"
+    ).correct(data.stack)
+    r_pl = MotionCorrector(
+        model="translation", backend="jax", batch_size=4, warp="pallas"
+    ).correct(data.stack)
+    np.testing.assert_allclose(r_pl.transforms, r_jnp.transforms, atol=1e-6)
+    np.testing.assert_allclose(r_pl.corrected, r_jnp.corrected, atol=1e-5)
+
+
+def test_pallas_rejected_for_non_translation():
+    data = synthetic.make_drift_stack(n_frames=2, shape=(96, 96), model="affine", seed=1)
+    mc = MotionCorrector(model="affine", backend="jax", batch_size=2, warp="pallas")
+    with pytest.raises(ValueError, match="pallas"):
+        mc.correct(data.stack)
+
+
+def test_auto_on_cpu_uses_jnp():
+    """auto must fall back to the gather warp on CPU (no accelerator)."""
+    from kcmc_tpu.backends.jax_backend import JaxBackend
+    from kcmc_tpu.config import CorrectorConfig
+    from kcmc_tpu.ops.warp import warp_frame
+
+    b = JaxBackend(CorrectorConfig(model="translation", warp="auto"))
+    assert b._resolve_warp_fn() is warp_frame
