@@ -18,9 +18,11 @@ from kcmc_tpu.ops.patterns import (
     MOMENTS as _MOMENTS,
     MOMENT_RADIUS as _MOMENT_RADIUS,
     N_BITS,
+    N_ORIENT_BINS,
     N_WORDS,
     PATCH_RADIUS,
     PATTERN,
+    ROT_PATTERNS,
 )
 
 # ---------------------------------------------------------------------------
@@ -142,9 +144,11 @@ def describe_keypoints(
             patch = smooth[cy[i] - r : cy[i] + r + 1, cx[i] - r : cx[i] + r + 1]
             w = patch * moms[..., 2]
             angles[i] = np.arctan2((w * moms[..., 1]).sum(), (w * moms[..., 0]).sum())
-        c, s = np.cos(angles), np.sin(angles)
-        R = np.stack([np.stack([c, -s], -1), np.stack([s, c], -1)], -2)  # (K,2,2)
-        offs = np.einsum("kij,bej->kbei", R, PATTERN)
+        # Quantized orientation bins with precomputed rotated integer
+        # patterns — same definition as ops/describe.py (ORB-style).
+        nb = N_ORIENT_BINS
+        bins = np.mod(np.rint(angles * (nb / (2.0 * np.pi))).astype(np.int64), nb)
+        offs = ROT_PATTERNS[bins]  # (K, N_BITS, 2, 2)
     else:
         offs = np.broadcast_to(PATTERN[None], (K,) + PATTERN.shape)
     pos = xy[:, None, None, :] + offs  # (K,B,2,2)

@@ -30,7 +30,7 @@ from kcmc_tpu.ops.describe import describe_keypoints
 from kcmc_tpu.ops.detect import detect_keypoints
 from kcmc_tpu.ops.match import knn_match
 from kcmc_tpu.ops.ransac import ransac_estimate
-from kcmc_tpu.ops.warp import warp_frame, warp_frame_flow, warp_volume
+from kcmc_tpu.ops.warp import warp_batch, warp_frame_flow, warp_volume
 
 
 @register_backend("jax")
@@ -84,7 +84,16 @@ class JaxBackend:
         Returns host numpy arrays: transforms/fields, corrected frames,
         per-frame diagnostics.
         """
-        cfg = self.config
+        out = self.process_batch_async(frames, ref, frame_indices)
+        return jax.tree.map(np.asarray, out)
+
+    def process_batch_async(self, frames, ref: dict, frame_indices, to_host=True) -> dict:
+        """Dispatch one batch; return the *device* output arrays without
+        blocking. With `to_host` (the orchestrator's host-fed path) the
+        device->host copies of this batch start immediately so they overlap
+        with the compute of later batches (the host<->device link is the
+        scarce resource for host-fed stacks); `to_host=False` keeps
+        everything on device (device-resident pipelines, benchmarking)."""
         shape = tuple(frames.shape[1:])
         fn = self._get_batch_fn(shape)
         frames_j = jnp.asarray(frames, jnp.float32)
@@ -95,7 +104,11 @@ class JaxBackend:
             frames_j = shard_frames(frames_j, self.mesh)
             idx_j = shard_frames(idx_j, self.mesh)
         out = fn(frames_j, ref["xy"], ref["desc"], ref["valid"], idx_j)
-        return jax.tree.map(np.asarray, out)
+        if to_host:
+            for v in out.values():  # start D2H copies in the background
+                if hasattr(v, "copy_to_host_async"):
+                    v.copy_to_host_async()
+        return out
 
     def _get_batch_fn(self, shape):
         key = (shape, self.config)
@@ -115,17 +128,37 @@ class JaxBackend:
 
         base_key = jax.random.key(cfg.seed)
 
+        # For 2D matrix models the warp runs once over the whole batch
+        # *after* the vmapped estimation — batch-level is where the Pallas
+        # kernel lives (its batch axis is a Pallas grid axis, which cannot
+        # sit inside a vmap), and the jnp path fuses identically.
+        if cfg.model != "piecewise" and not is_3d:
+            batch_warp = self._resolve_batch_warp()
+
+            def batch_post(frames, out):
+                out = dict(out)
+                out["corrected"] = batch_warp(frames, out["transform"])
+                return out
+
+        else:
+            batch_post = None
+
         if self.mesh is not None:
             from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
 
-            return make_sharded_batch_fn(per_frame, self.mesh, base_key)
+            return make_sharded_batch_fn(
+                per_frame, self.mesh, base_key, batch_post=batch_post
+            )
 
         @jax.jit
         def batch_fn(frames, ref_xy, ref_desc, ref_valid, frame_indices):
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(frame_indices)
-            return jax.vmap(
+            out = jax.vmap(
                 lambda f, k: per_frame(f, ref_xy, ref_desc, ref_valid, k)
             )(frames, keys)
+            if batch_post is not None:
+                out = batch_post(frames, out)
+            return out
 
         return batch_fn
 
@@ -158,8 +191,11 @@ class JaxBackend:
 
         return stage
 
-    def _resolve_warp_fn(self):
-        """Pick the warp implementation per the `warp` config policy."""
+    def _resolve_batch_warp(self):
+        """Pick the batched warp implementation per the `warp` policy.
+
+        Returns fn(frames (B,H,W), transforms (B,3,3)) -> (B,H,W).
+        """
         cfg = self.config
         # The Pallas kernel lowers via TPU Mosaic only. "axon" is this
         # image's tunneled-TPU platform name.
@@ -173,19 +209,16 @@ class JaxBackend:
                     "warp='pallas' is the gather-free translation kernel; "
                     f"model {cfg.model!r} needs warp='jnp' (or 'auto')"
                 )
-            from kcmc_tpu.ops.pallas_warp import warp_frame_translation
+            from kcmc_tpu.ops.pallas_warp import warp_batch_translation
 
             interp = not on_tpu  # interpret mode off-TPU
-            return lambda frame, M: warp_frame_translation(
-                frame, jnp.stack([M[0, 2], M[1, 2]]), interpret=interp
-            )
-        return warp_frame
+            return functools.partial(warp_batch_translation, interpret=interp)
+        return warp_batch
 
     def _make_matrix_per_frame(self, shape):
         cfg = self.config
         model = get_model(cfg.model)
         stage = self._detect_describe_match(cfg)
-        warp_fn = self._resolve_warp_fn()
 
         def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
             src, dst, valid, kps = stage(frame, ref_xy, ref_desc, ref_valid)
@@ -199,10 +232,10 @@ class JaxBackend:
                 threshold=cfg.inlier_threshold,
                 refine_iters=cfg.refine_iters,
             )
-            corrected = warp_fn(frame, res.transform)
+            # NOTE: no warp here — the batch program warps the whole batch
+            # at once after the vmap (see _build_batch_fn / batch_post).
             return {
                 "transform": res.transform,
-                "corrected": corrected,
                 "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
                 "n_matches": jnp.sum(valid).astype(jnp.int32),
                 "n_inliers": res.n_inliers,

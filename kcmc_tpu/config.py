@@ -56,6 +56,11 @@ class CorrectorConfig:
             raise ValueError(
                 f"warp must be 'auto', 'jnp', or 'pallas', got {self.warp!r}"
             )
+        if self.warp == "pallas" and self.model != "translation":
+            raise ValueError(
+                "warp='pallas' is the gather-free translation kernel; "
+                f"model {self.model!r} needs warp='jnp' (or 'auto')"
+            )
 
     def resolved_oriented(self) -> bool:
         if self.oriented is None:

@@ -134,6 +134,18 @@ class MotionCorrector:
         B = cfg.batch_size
         outs = []
         indices = np.arange(start_frame, T)
+        # Pipelined dispatch: keep a window of batches in flight so the
+        # host->device upload of batch i+1, the compute of batch i, and
+        # the device->host download of batch i-1 all overlap (the
+        # process_batch_async seam; backends without it run synchronously).
+        dispatch = getattr(self.backend, "process_batch_async", None)
+        inflight: list[tuple[int, dict]] = []
+        depth = 3
+
+        def drain(entry):
+            n, out = entry
+            outs.append({k: np.asarray(v)[:n] for k, v in out.items()})
+
         with timer.stage("register_batches"):
             for lo in range(start_frame, T, B):
                 hi = min(lo + B, T)
@@ -143,10 +155,17 @@ class MotionCorrector:
                     pad = B - len(batch)
                     batch = np.concatenate([batch, np.repeat(batch[-1:], pad, axis=0)])
                     idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
-                out = self.backend.process_batch(batch, ref, idx)
-                outs.append({k: v[: hi - lo] for k, v in out.items()})
+                if dispatch is not None:
+                    inflight.append((hi - lo, dispatch(batch, ref, idx)))
+                    if len(inflight) >= depth:
+                        drain(inflight.pop(0))
+                else:
+                    out = self.backend.process_batch(batch, ref, idx)
+                    outs.append({k: v[: hi - lo] for k, v in out.items()})
                 if progress:
                     print(f"[kcmc] frames {hi}/{T}", flush=True)
+            for entry in inflight:
+                drain(entry)
 
         merged = {
             k: np.concatenate([o[k] for o in outs]) for k in outs[0]

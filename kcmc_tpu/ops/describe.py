@@ -2,17 +2,26 @@
 
 Counterpart of the reference `KeypointExtractor`'s describe stage
 (SURVEY.md §2; BASELINE.json names ORB keypoints for the affine config).
-Rebuilt for TPU rather than translated:
+Rebuilt for TPU rather than translated — the design rule is ZERO
+arbitrary pointwise gathers (XLA scalarizes them on TPU; the naive
+sample-256-points-per-keypoint formulation is ~1M scalar gathers per
+frame and dominated the whole pipeline):
 
-* The classic BRIEF sampling pattern (256 Gaussian-distributed point
-  pairs in a radius-13 patch) is a host-side constant baked into the
-  compiled program.
-* Orientation comes from the intensity-centroid moment of a disc around
-  the keypoint (the ORB approach), computed with one dynamic-slice patch
-  gather per keypoint and vmapped — no per-keypoint Python.
-* Descriptor bits are bilinear samples of the blurred frame at the
-  rotated pair positions; 256 comparisons pack into 8 uint32 lanes so
-  Hamming distance is XOR + popcount on 8 words (ops/match.py).
+* One P x P patch is cut around each keypoint with a vmapped
+  `lax.dynamic_slice` — batched slice-gather is a fast native path on
+  TPU (whole minor-dim rows move at once).
+* The four bilinear taps for the keypoint's subpixel fraction are
+  applied to the WHOLE patch as one fused elementwise blend (`pb`),
+  after which every integer-offset sample is just an element of `pb`.
+* The BRIEF pattern offsets are integers (ops/patterns.py), so reading
+  the 512 sample values per keypoint is a CONSTANT one-hot selection:
+  a (P-1)^2 x 512 0/1 matmul on the MXU — exact, no gathers.
+* Orientation (the ORB intensity-centroid angle) is quantized into
+  N_ORIENT_BINS bins with a precomputed rotated integer pattern per bin
+  (exactly ORB's own precomputed-rotation trick); each bin is one more
+  constant one-hot matmul, masked-accumulated per keypoint. The angle
+  itself comes from moments of the already-extracted patch — pure
+  elementwise math.
 
 Everything is fixed-K and mask-aware: invalid keypoint slots produce
 all-zero descriptors which the matcher masks out.
@@ -32,49 +41,91 @@ from kcmc_tpu.ops.patterns import (  # shared, JAX-free constants
     MOMENTS as _MOMENTS,
     MOMENT_RADIUS as _MOMENT_RADIUS,
     N_BITS,
+    N_ORIENT_BINS,
     N_WORDS,
     PATCH_RADIUS,
     PATTERN,
+    ROT_PATTERNS,
+    ROT_RADIUS,
 )
 
 
-def _bilinear_sample(img: jnp.ndarray, xy: jnp.ndarray) -> jnp.ndarray:
-    """Sample (H, W) image at (..., 2) float (x, y) points, edge-clamped."""
-    H, W = img.shape
-    x = jnp.clip(xy[..., 0], 0.0, W - 1.0)
-    y = jnp.clip(xy[..., 1], 0.0, H - 1.0)
-    x0 = jnp.floor(x)
-    y0 = jnp.floor(y)
-    fx = x - x0
-    fy = y - y0
-    x0i = x0.astype(jnp.int32)
-    y0i = y0.astype(jnp.int32)
-    x1i = jnp.minimum(x0i + 1, W - 1)
-    y1i = jnp.minimum(y0i + 1, H - 1)
-    flat = img.reshape(-1)
-    v00 = flat[y0i * W + x0i]
-    v01 = flat[y0i * W + x1i]
-    v10 = flat[y1i * W + x0i]
-    v11 = flat[y1i * W + x1i]
-    return (
-        v00 * (1 - fx) * (1 - fy)
-        + v01 * fx * (1 - fy)
-        + v10 * (1 - fx) * fy
-        + v11 * fx * fy
+def _selection_matrix(pattern: np.ndarray, radius: int) -> np.ndarray:
+    """(L, 512) 0/1 one-hot matrix reading integer-offset samples out of a
+    flattened (2*radius+1)^2 blended patch. Host-side constant."""
+    side = 2 * radius + 1
+    offs = pattern.reshape(-1, 2).astype(np.int64)  # (512, 2) integer (dx, dy)
+    lin = (offs[:, 1] + radius) * side + (offs[:, 0] + radius)
+    sel = np.zeros((side * side, offs.shape[0]), np.float32)
+    sel[lin, np.arange(offs.shape[0])] = 1.0
+    return sel
+
+
+_SEL_UPRIGHT = _selection_matrix(PATTERN, PATCH_RADIUS)  # (27^2, 512)
+_SEL_ROT = np.stack(
+    [_selection_matrix(ROT_PATTERNS[b], ROT_RADIUS) for b in range(N_ORIENT_BINS)]
+)  # (NB, 31^2, 512)
+
+
+def _extract_patches(
+    smooth: jnp.ndarray, xy: jnp.ndarray, radius: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-keypoint patches around each (subpixel) keypoint.
+
+    Returns (raw, blended): raw is the (K, 2r+2, 2r+2) integer-grid patch
+    with origin floor(xy) - r; blended is the (K, 2r+1, 2r+1) bilinear
+    resample at the keypoint's subpixel fraction, i.e.
+    blended[k, i, j] = smooth sampled at xy[k] + (j - r, i - r),
+    edge-clamped."""
+    r = radius
+    P = 2 * r + 2  # +1 row/col for the bilinear blend
+    padded = jnp.pad(smooth, r + 1, mode="edge")
+    x0 = jnp.floor(xy[:, 0])
+    y0 = jnp.floor(xy[:, 1])
+    fx = (xy[:, 0] - x0)[:, None, None]
+    fy = (xy[:, 1] - y0)[:, None, None]
+    # patch origin in padded coords: floor(kp) - r + (r + 1) = floor(kp) + 1
+    oy = y0.astype(jnp.int32) + 1
+    ox = x0.astype(jnp.int32) + 1
+    raw = jax.vmap(
+        lambda y, x: lax.dynamic_slice(padded, (y, x), (P, P))
+    )(oy, ox)  # (K, P, P)
+    blended = (
+        (1.0 - fy) * (1.0 - fx) * raw[:, :-1, :-1]
+        + (1.0 - fy) * fx * raw[:, :-1, 1:]
+        + fy * (1.0 - fx) * raw[:, 1:, :-1]
+        + fy * fx * raw[:, 1:, 1:]
     )
+    return raw, blended
 
 
-def _orientation(img: jnp.ndarray, xy: jnp.ndarray) -> jnp.ndarray:
-    """ORB intensity-centroid angle at one keypoint. xy: (2,) float."""
+def _moment_angles(patches: jnp.ndarray, xy: jnp.ndarray, radius: int) -> jnp.ndarray:
+    """ORB intensity-centroid angle per keypoint, from the extracted patch.
+
+    The moment disc (radius MOMENT_RADIUS) is centered on round(xy) —
+    patch index radius + round(frac) — so it matches the integer-centered
+    definition of the CPU oracle. patches: (K, P, P) RAW samples (the
+    blended patch would shift the centroid by the subpixel fraction).
+    """
     r = _MOMENT_RADIUS
-    H, W = img.shape
-    cy = jnp.clip(jnp.round(xy[1]).astype(jnp.int32), r, H - r - 1)
-    cx = jnp.clip(jnp.round(xy[0]).astype(jnp.int32), r, W - r - 1)
-    patch = lax.dynamic_slice(img, (cy - r, cx - r), (2 * r + 1, 2 * r + 1))
+    c = radius  # patch center index for offset 0
+
+    def disc(dy, dx):
+        return patches[:, c + dy - r : c + dy + r + 1, c + dx - r : c + dx + r + 1]
+
+    fx = xy[:, 0] - jnp.floor(xy[:, 0])
+    fy = xy[:, 1] - jnp.floor(xy[:, 1])
+    rx = (fx >= 0.5)[:, None, None]
+    ry = (fy >= 0.5)[:, None, None]
+    patch = jnp.where(
+        ry,
+        jnp.where(rx, disc(1, 1), disc(1, 0)),
+        jnp.where(rx, disc(0, 1), disc(0, 0)),
+    )  # (K, 2r+1, 2r+1)
     moms = jnp.asarray(_MOMENTS)
     w = patch * moms[..., 2]
-    m10 = jnp.sum(w * moms[..., 0])
-    m01 = jnp.sum(w * moms[..., 1])
+    m10 = jnp.sum(w * moms[..., 0], axis=(-2, -1))
+    m01 = jnp.sum(w * moms[..., 1], axis=(-2, -1))
     return jnp.arctan2(m01, m10)
 
 
@@ -94,25 +145,39 @@ def describe_keypoints(
 ) -> jnp.ndarray:
     """Compute (K, N_WORDS) uint32 BRIEF descriptors for one frame.
 
-    `oriented=True` steers the pattern by the intensity-centroid angle
-    (rotation-invariant, ORB-style); `False` is classic upright BRIEF —
-    slightly more discriminative when the motion model has no rotation
-    (the translation-only config).
+    `oriented=True` steers the pattern by the quantized intensity-
+    centroid angle (rotation-invariant, ORB-style); `False` is classic
+    upright BRIEF — slightly more discriminative when the motion model
+    has no rotation (the translation-only config).
     """
     smooth = gaussian_blur(img, blur_sigma)
-    pattern = jnp.asarray(PATTERN)  # (B, 2, 2)
+    K = kps.xy.shape[0]
+
+    # Precision.HIGHEST: the default TPU matmul truncates inputs to bf16,
+    # which would quantize the selected sample values and flip comparison
+    # bits relative to the f32 CPU oracle — the selection must stay exact.
+    dot = functools.partial(jnp.matmul, precision=lax.Precision.HIGHEST)
 
     if oriented:
-        angles = jax.vmap(lambda p: _orientation(smooth, p))(kps.xy)  # (K,)
-        c, s = jnp.cos(angles), jnp.sin(angles)
-        # Rotation matrices (K, 2, 2): steer pattern per keypoint.
-        R = jnp.stack([jnp.stack([c, -s], -1), jnp.stack([s, c], -1)], -2)
-        offs = jnp.einsum("kij,bej->kbei", R, pattern)  # (K, B, 2, 2)
+        raw, pb = _extract_patches(smooth, kps.xy, ROT_RADIUS)
+        angles = _moment_angles(raw, kps.xy, ROT_RADIUS)
+        nb = N_ORIENT_BINS
+        bins = jnp.mod(
+            jnp.rint(angles * (nb / (2.0 * jnp.pi))).astype(jnp.int32), nb
+        )
+        flat = pb.reshape(K, -1)
+        # One constant 0/1 matmul per orientation bin, masked-accumulated:
+        # MXU work, small (K, 512) accumulator, no (K, NB, 512) blow-up.
+        vals = jnp.zeros((K, PATTERN.shape[0] * 2), jnp.float32)
+        for b in range(nb):
+            sel = jnp.asarray(_SEL_ROT[b])
+            mask = (bins == b).astype(jnp.float32)[:, None]
+            vals = vals + mask * dot(flat, sel)
     else:
-        offs = jnp.broadcast_to(pattern[None], (kps.xy.shape[0],) + pattern.shape)
+        _, pb = _extract_patches(smooth, kps.xy, PATCH_RADIUS)
+        vals = dot(pb.reshape(K, -1), jnp.asarray(_SEL_UPRIGHT))  # (K, 512)
 
-    pos = kps.xy[:, None, None, :] + offs  # (K, B, 2, 2)
-    vals = _bilinear_sample(smooth, pos)  # (K, B, 2)
-    bits = vals[..., 0] < vals[..., 1]  # (K, B)
+    vals = vals.reshape(K, N_BITS, 2)
+    bits = vals[..., 0] < vals[..., 1]  # (K, N_BITS)
     desc = _pack_bits(bits)
     return jnp.where(kps.valid[:, None], desc, jnp.zeros_like(desc))

@@ -12,6 +12,11 @@ positioned VMEM window (origin = floor of the shift, from SMEM scalars),
 and the four weights are scalars. The kernel is a pure VPU FMA stream
 at full lane utilization.
 
+The batch dimension is a Pallas *grid* axis (one program per frame) with
+the per-frame scalars delivered through scalar prefetch — the idiomatic
+TPU structure (vmap-of-pallas_call would batch the SMEM operand into a
+block shape Mosaic rejects).
+
 Out-of-bounds semantics match ops/warp.py: the frame is edge-padded on
 the host (so interior blends clamp like the jnp gather version) and an
 iota-based validity mask zeroes pixels whose true source falls outside
@@ -19,8 +24,9 @@ the frame. Translations beyond PAD pixels (far outside the judged drift
 regime of tens of pixels) zero the whole frame rather than silently
 returning misregistered content.
 
-Exposed via `warp_frame_translation(frame, t)`, and selected by the jax
-backend's `warp="auto"` policy for the translation model on TPU.
+Exposed via `warp_batch_translation(frames, transforms)`, and selected
+by the jax backend's `warp="auto"` policy for the translation model on
+TPU.
 """
 
 from __future__ import annotations
@@ -35,23 +41,37 @@ from jax.experimental.pallas import tpu as pltpu
 PAD = 128  # max |shift| handled exactly, pixels
 
 
-def _warp_kernel(scal_ref, src_ref, out_ref):
-    """scal_ref: (7,) float32 scalars in SMEM:
-    [y0, x0] window origin into the padded source, [fy, fx] bilinear
-    fractions, [ty, tx] the true shift (for the validity mask), and
-    [exact] the shift-within-window flag.
+def _warp_kernel(iscal_ref, fscal_ref, src_ref, out_ref):
+    """One program per frame (grid axis 0 = batch).
+
+    iscal_ref: (B, 2) int32 scalar-prefetch: [y0, x0] window origin into
+    the padded source. fscal_ref: (B, 8) float32 in SMEM: [fy, fx]
+    bilinear fractions, [ty, tx] the true shift (for the validity mask),
+    [exact] the shift-within-window flag, + padding.
     """
-    y0 = scal_ref[0].astype(jnp.int32)
-    x0 = scal_ref[1].astype(jnp.int32)
-    fy = scal_ref[2]
-    fx = scal_ref[3]
-    ty = scal_ref[4]
-    tx = scal_ref[5]
-    exact = scal_ref[6]  # 1.0 iff the shift is within the window's range
+    b = pl.program_id(0)
+    y0 = iscal_ref[b, 0]
+    x0 = iscal_ref[b, 1]
+    fy = fscal_ref[b, 0]
+    fx = fscal_ref[b, 1]
+    ty = fscal_ref[b, 2]
+    tx = fscal_ref[b, 3]
+    exact = fscal_ref[b, 4]  # 1.0 iff the shift is within the window's range
 
     H, W = out_ref.shape
-    # One dynamically-positioned window read; four static shifted views.
-    win = src_ref[pl.ds(y0, H + 1), pl.ds(x0, W + 1)]
+    # Dynamic positioning via rotate (Mosaic's supported dynamic-shift
+    # primitive — arbitrary dynamic slice starts can't be proven tile-
+    # aligned), then four static shifted views of the front window.
+    # Shifts MUST be non-negative: Mosaic's dynamic rotate mis-wraps
+    # negative amounts on multi-tile arrays (verified on TPU v5e), so
+    # roll by (dim - y0) ≡ -y0 instead. oy/ox are clipped to
+    # [0, 2*PAD-1] on the host, so rows 0..H and cols 0..W of the
+    # rotated array never see wrap-around content.
+    Hp, Wp = src_ref.shape
+    full = src_ref[:, :]
+    full = pltpu.roll(full, Hp - y0, 0)
+    full = pltpu.roll(full, Wp - x0, 1)
+    win = full[: H + 1, : W + 1]
     w00 = (1.0 - fy) * (1.0 - fx)
     w01 = (1.0 - fy) * fx
     w10 = fy * (1.0 - fx)
@@ -63,8 +83,9 @@ def _warp_kernel(scal_ref, src_ref, out_ref):
         + w11 * win[1:, 1:]
     )
     # Validity: true source coord (r + ty, c + tx) inside the frame.
-    rows = jax.lax.broadcasted_iota(jnp.float32, (H, W), 0) + ty
-    cols = jax.lax.broadcasted_iota(jnp.float32, (H, W), 1) + tx
+    # (Mosaic only supports integer iota; cast to float after.)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (H, W), 0).astype(jnp.float32) + ty
+    cols = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1).astype(jnp.float32) + tx
     inb = (
         (rows >= 0.0) & (rows <= H - 1.0) & (cols >= 0.0) & (cols <= W - 1.0)
         & (exact > 0.5)
@@ -73,18 +94,19 @@ def _warp_kernel(scal_ref, src_ref, out_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def warp_frame_translation(
-    frame: jnp.ndarray, t: jnp.ndarray, interpret: bool = False
+def warp_batch_translation(
+    frames: jnp.ndarray, transforms: jnp.ndarray, interpret: bool = False
 ) -> jnp.ndarray:
-    """Correct a (H, W) frame under pure translation t = (tx, ty).
+    """Correct (B, H, W) frames under pure translations.
 
-    Matches `warp_frame(frame, M)` for M = [[1,0,tx],[0,1,ty],[0,0,1]]
-    up to float rounding, with zero gathers on TPU.
+    transforms: (B, 3, 3) matrices [[1,0,tx],[0,1,ty],[0,0,1]]. Matches
+    `vmap(warp_frame)` up to float rounding, with zero gathers on TPU.
     """
-    H, W = frame.shape
-    tx, ty = t[0], t[1]
+    B, H, W = frames.shape
+    tx = transforms[:, 0, 2]
+    ty = transforms[:, 1, 2]
     # Edge-pad so interior blends clamp exactly like the gather version.
-    padded = jnp.pad(frame, PAD, mode="edge")
+    padded = jnp.pad(frames, ((0, 0), (PAD, PAD), (PAD, PAD)), mode="edge")
     y0 = jnp.floor(ty)
     x0 = jnp.floor(tx)
     fy = ty - y0
@@ -98,27 +120,39 @@ def warp_frame_translation(
     ).astype(jnp.float32)
     oy = jnp.clip(y0.astype(jnp.int32) + PAD, 0, 2 * PAD - 1)
     ox = jnp.clip(x0.astype(jnp.int32) + PAD, 0, 2 * PAD - 1)
-    scal = jnp.stack(
-        [oy.astype(jnp.float32), ox.astype(jnp.float32), fy, fx, ty, tx, exact]
-    )
+    iscal = jnp.stack([oy, ox], axis=-1)  # (B, 2) int32
+    zeros = jnp.zeros_like(fy)
+    fscal = jnp.stack(
+        [fy, fx, ty, tx, exact, zeros, zeros, zeros], axis=-1
+    )  # (B, 8) float32
 
-    return pl.pallas_call(
-        _warp_kernel,
-        out_shape=jax.ShapeDtypeStruct((H, W), jnp.float32),
+    Hp, Wp = H + 2 * PAD, W + 2 * PAD
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((None, Hp, Wp), lambda b, iscal: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(scal, padded.astype(jnp.float32))
-
-
-def warp_batch_translation(
-    frames: jnp.ndarray, transforms: jnp.ndarray, interpret: bool = False
-) -> jnp.ndarray:
-    """(B, H, W) frames, (B, 3, 3) translation matrices -> corrected batch."""
-    ts = transforms[:, :2, 2]  # (B, 2) (tx, ty)
-    return jax.vmap(lambda f, t: warp_frame_translation(f, t, interpret=interpret))(
-        frames, ts
+        out_specs=pl.BlockSpec((None, H, W), lambda b, iscal: (b, 0, 0)),
     )
+    return pl.pallas_call(
+        _warp_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, W), jnp.float32),
+        interpret=interpret,
+    )(iscal, fscal, padded.astype(jnp.float32))
+
+
+def warp_frame_translation(
+    frame: jnp.ndarray, t: jnp.ndarray, interpret: bool = False
+) -> jnp.ndarray:
+    """Correct a (H, W) frame under pure translation t = (tx, ty).
+
+    Single-frame convenience wrapper over the batched kernel.
+    """
+    M = jnp.array(
+        [[1.0, 0.0, 0.0], [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]], dtype=jnp.float32
+    )
+    M = M.at[0, 2].set(t[0]).at[1, 2].set(t[1])
+    return warp_batch_translation(frame[None], M[None], interpret=interpret)[0]

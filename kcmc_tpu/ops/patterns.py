@@ -14,6 +14,8 @@ N_BITS = 256
 N_WORDS = N_BITS // 32
 PATCH_RADIUS = 13  # BRIEF pattern support radius, pixels
 MOMENT_RADIUS = 7  # intensity-centroid disc radius (ORB orientation)
+N_ORIENT_BINS = 16  # orientation quantization (22.5 deg, ORB-style)
+ROT_RADIUS = 15  # rotated-pattern support radius (rotated offsets clipped)
 
 # 3D descriptor support (anisotropic: z-stacks are shallow)
 RADIUS_XY = 9.0
@@ -24,11 +26,35 @@ def make_pattern(seed: int = 7) -> np.ndarray:
     """The BRIEF pair pattern: (N_BITS, 2, 2) float32 (pair, endpoint, (x, y)).
 
     Gaussian-distributed offsets (sigma = radius/2), clipped to the patch,
-    fixed seed => identical pattern across backends.
+    rounded to INTEGER pixel offsets (classic BRIEF/ORB uses integer pixel
+    pairs; on TPU integer offsets make descriptor sampling a constant
+    one-hot selection — pure MXU work, zero arbitrary gathers). Fixed seed
+    => identical pattern across backends.
     """
     rng = np.random.default_rng(seed)
     pts = rng.normal(0.0, PATCH_RADIUS / 2.0, size=(N_BITS, 2, 2))
-    return np.clip(pts, -PATCH_RADIUS, PATCH_RADIUS).astype(np.float32)
+    return np.rint(np.clip(pts, -PATCH_RADIUS, PATCH_RADIUS)).astype(np.float32)
+
+
+def make_rotated_patterns(n_bins: int = N_ORIENT_BINS) -> np.ndarray:
+    """Per-orientation-bin rotated integer patterns: (n_bins, N_BITS, 2, 2).
+
+    The ORB trick, TPU-shaped: instead of steering the pattern by a
+    per-keypoint rotation matrix (which makes sample positions dynamic
+    and forces pointwise gathers), quantize orientation into `n_bins`
+    bins and precompute the rotated pattern per bin host-side, rounded
+    back to integer offsets. Descriptor sampling then stays a constant
+    selection for every bin; the keypoint only picks its bin.
+    """
+    base = make_pattern()  # (N_BITS, 2, 2) integer-valued
+    out = np.empty((n_bins,) + base.shape, np.float32)
+    for b in range(n_bins):
+        th = 2.0 * np.pi * b / n_bins
+        c, s = np.cos(th), np.sin(th)
+        R = np.array([[c, -s], [s, c]], np.float32)
+        rot = base @ R.T  # rotate each (x, y) offset
+        out[b] = np.clip(np.rint(rot), -(ROT_RADIUS - 1), ROT_RADIUS - 1)
+    return out
 
 
 def moment_offsets(radius: int = MOMENT_RADIUS) -> np.ndarray:
@@ -50,5 +76,6 @@ def make_pattern_3d(seed: int = 11) -> np.ndarray:
 
 
 PATTERN = make_pattern()
+ROT_PATTERNS = make_rotated_patterns()
 MOMENTS = moment_offsets()
 PATTERN_3D = make_pattern_3d()

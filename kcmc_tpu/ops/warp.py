@@ -16,6 +16,7 @@ rigid config): sample coords = identity + flow.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -64,6 +65,13 @@ def warp_frame(frame: jnp.ndarray, M: jnp.ndarray) -> jnp.ndarray:
     sx = (M[0, 0] * xs + M[0, 1] * ys + M[0, 2]) / w
     sy = (M[1, 0] * xs + M[1, 1] * ys + M[1, 2]) / w
     return bilinear_sample(frame, sx, sy)
+
+
+def warp_batch(frames: jnp.ndarray, transforms: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, W) frames, (B, 3, 3) transforms -> corrected batch (vmapped
+    gather warp — the generic batched counterpart of the Pallas
+    translation kernel in ops/pallas_warp.py)."""
+    return jax.vmap(warp_frame)(frames, transforms)
 
 
 def warp_frame_flow(frame: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:

@@ -24,17 +24,18 @@ def test_pallas_warp_pipeline_matches_jnp():
 
 
 def test_pallas_rejected_for_non_translation():
-    data = synthetic.make_drift_stack(n_frames=2, shape=(96, 96), model="affine", seed=1)
-    mc = MotionCorrector(model="affine", backend="jax", batch_size=2, warp="pallas")
-    with pytest.raises(ValueError, match="pallas"):
-        mc.correct(data.stack)
+    # Validated at config time — covers the piecewise/3D paths too, where
+    # the warp policy is otherwise never consulted.
+    for model in ("affine", "piecewise"):
+        with pytest.raises(ValueError, match="pallas"):
+            MotionCorrector(model=model, backend="jax", batch_size=2, warp="pallas")
 
 
 def test_auto_on_cpu_uses_jnp():
     """auto must fall back to the gather warp on CPU (no accelerator)."""
     from kcmc_tpu.backends.jax_backend import JaxBackend
     from kcmc_tpu.config import CorrectorConfig
-    from kcmc_tpu.ops.warp import warp_frame
+    from kcmc_tpu.ops.warp import warp_batch
 
     b = JaxBackend(CorrectorConfig(model="translation", warp="auto"))
-    assert b._resolve_warp_fn() is warp_frame
+    assert b._resolve_batch_warp() is warp_batch
