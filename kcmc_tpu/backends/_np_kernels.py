@@ -15,6 +15,7 @@ from __future__ import annotations
 import numpy as np
 
 from kcmc_tpu.ops.patterns import (
+    CAND_TILE,
     MOMENTS as _MOMENTS,
     MOMENT_RADIUS as _MOMENT_RADIUS,
     N_BITS,
@@ -85,11 +86,32 @@ def detect_keypoints(
     inb = (ys >= border) & (ys < H - border) & (xs >= border) & (xs < W - border)
     peak = max(resp.max(), 1e-12)
     cand = is_max & inb & (resp > threshold * peak)
-    flat = np.where(cand, resp, -np.inf).ravel()
-    order = np.argsort(-flat)[:max_keypoints]
-    scores = flat[order]
+    masked = np.where(cand, resp, -np.inf)
+    # Tile-bucketed candidate reduction — same rule as ops/detect.py
+    # (strongest surviving pixel per tile, then global top-k), so the
+    # two backends select the same keypoint set.
+    T = CAND_TILE
+    Hp, Wp = -(-H // T) * T, -(-W // T) * T
+    m = np.full((Hp, Wp), -np.inf, np.float32)
+    m[:H, :W] = masked
+    tiles = m.reshape(Hp // T, T, Wp // T, T).transpose(0, 2, 1, 3)
+    tiles = tiles.reshape(Hp // T, Wp // T, T * T)
+    tile_val = tiles.max(-1)
+    tile_arg = tiles.argmax(-1)
+    k = min(max_keypoints, tile_val.size)
+    order = np.argsort(-tile_val.ravel(), kind="stable")[:k]
+    scores = tile_val.ravel()[order]
+    if k < max_keypoints:
+        pad = max_keypoints - k
+        scores = np.concatenate([scores, np.full(pad, -np.inf, np.float32)])
+        order = np.concatenate([order, np.zeros(pad, order.dtype)])
     valid = np.isfinite(scores)
-    iy, ix = np.unravel_index(order, (H, W))
+    within = tile_arg.ravel()[order]
+    tw = tile_val.shape[1]
+    iy = (order // tw) * T + within // T
+    ix = (order % tw) * T + within % T
+    iy = np.clip(iy, 0, H - 1)
+    ix = np.clip(ix, 0, W - 1)
 
     # quadratic subpixel refinement (same formula as ops/detect.py)
     xy = np.stack([ix, iy], axis=-1).astype(np.float32)

@@ -4,16 +4,20 @@ TPU-native counterpart of the reference's `KeypointExtractor` detect
 stage (SURVEY.md §2 — reference source unavailable; contract from
 BASELINE.json). Design choices for the TPU:
 
-* Harris response is built from 3x3 convolutions (`lax.conv`) — these
-  map onto the MXU/VPU and fuse with the surrounding elementwise ops.
-* Non-max suppression is a max-pool equality test — no sorting, no
-  dynamic shapes.
-* "Detect the strongest corners above a threshold" becomes a fixed-K
-  `lax.top_k` plus a validity mask (`score > threshold`), so every frame
-  yields exactly K keypoint slots and the downstream pipeline stays
-  statically shaped (SURVEY.md §7: fixed-K keypoint selection).
-* Subpixel refinement fits a 2D quadratic to the 3x3 response
-  neighborhood of each keypoint. This matters for accuracy: a pure
+* Harris response is built from SEPARABLE 1D convolutions (Sobel as
+  smooth x diff, Gaussian window as two 1D passes) — XLA's fast TPU
+  path; a 2D 3x3 single-channel conv lowers ~200x slower.
+* Non-max suppression is a (separable) max-pool equality test — no
+  sorting, no dynamic shapes.
+* "Detect the strongest corners above a threshold" becomes: strongest
+  surviving pixel per CAND_TILE x CAND_TILE tile (grid-bucketed spatial
+  spreading, at most one keypoint per tile), then a fixed-K `lax.top_k`
+  over the tile winners plus a validity mask (`score > threshold`), so
+  every frame yields exactly K keypoint slots and the downstream
+  pipeline stays statically shaped (SURVEY.md §7: fixed-K selection).
+* Subpixel refinement fits separable quadratics to the response around
+  each peak, computed as dense offset fields (pure elementwise shifts)
+  and sampled at the K peaks. This matters for accuracy: a pure
   integer-grid detector quantizes the recovered drift to whole pixels.
 
 All functions operate on a single (H, W) frame and are `vmap`ed over the
@@ -28,6 +32,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from kcmc_tpu.ops.patterns import CAND_TILE
 
 
 class Keypoints(NamedTuple):
@@ -64,10 +70,11 @@ def gaussian_blur(img: jnp.ndarray, sigma: float) -> jnp.ndarray:
     return img
 
 
-_SOBEL_X = jnp.array(
-    [[-1.0, 0.0, 1.0], [-2.0, 0.0, 2.0], [-1.0, 0.0, 1.0]], dtype=jnp.float32
-) / 8.0
-_SOBEL_Y = _SOBEL_X.T
+# Sobel, separably: outer([1,2,1]/4, [-1,0,1]/2). XLA lowers 1D spatial
+# convs to fast vectorized passes, but a 2D 3x3 single-channel conv hits
+# a slow TPU path (measured ~200x slower than the two 1D passes).
+_SOBEL_SMOOTH = jnp.array([1.0, 2.0, 1.0], dtype=jnp.float32) / 4.0
+_SOBEL_DIFF = jnp.array([-1.0, 0.0, 1.0], dtype=jnp.float32) / 2.0
 
 
 def harris_response(
@@ -77,8 +84,8 @@ def harris_response(
 
     M is the Gaussian-windowed structure tensor of the image gradients.
     """
-    gx = _conv2d(img, _SOBEL_X)
-    gy = _conv2d(img, _SOBEL_Y)
+    gx = _conv2d(_conv2d(img, _SOBEL_SMOOTH[:, None]), _SOBEL_DIFF[None, :])
+    gy = _conv2d(_conv2d(img, _SOBEL_SMOOTH[None, :]), _SOBEL_DIFF[:, None])
     ixx = gaussian_blur(gx * gx, window_sigma)
     iyy = gaussian_blur(gy * gy, window_sigma)
     ixy = gaussian_blur(gx * gy, window_sigma)
@@ -88,31 +95,36 @@ def harris_response(
 
 
 def _maxpool_same(x: jnp.ndarray, size: int) -> jnp.ndarray:
+    # Separable: max over rows then columns (max is associative/idempotent).
+    x = lax.reduce_window(
+        x, -jnp.inf, lax.max, (size, 1), (1, 1), "SAME"
+    )
     return lax.reduce_window(
-        x,
-        -jnp.inf,
-        lax.max,
-        window_dimensions=(size, size),
-        window_strides=(1, 1),
-        padding="SAME",
+        x, -jnp.inf, lax.max, (1, size), (1, 1), "SAME"
     )
 
 
-def _subpixel_offset(patch: jnp.ndarray) -> jnp.ndarray:
-    """Quadratic-fit subpixel offset from a 3x3 response patch.
+def _subpixel_fields(resp: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dense quadratic-fit subpixel offsets (ox, oy) per pixel.
 
-    Fits separable 1D parabolas along x and y through the center; the
-    offset is clamped to [-0.5, 0.5] (beyond that the integer NMS peak
-    would have been elsewhere).
+    Separable 1D parabola fits through each pixel and its axis
+    neighbors, clamped to [-0.5, 0.5] (beyond that the integer NMS peak
+    would have been elsewhere). Computing the whole field is a handful
+    of fused elementwise shifts — far cheaper on TPU than cutting a 3x3
+    patch per keypoint — and the per-keypoint values are then two tiny
+    pointwise gathers.
     """
-    c = patch[1, 1]
-    dx = 0.5 * (patch[1, 2] - patch[1, 0])
-    dy = 0.5 * (patch[2, 1] - patch[0, 1])
-    dxx = patch[1, 2] - 2.0 * c + patch[1, 0]
-    dyy = patch[2, 1] - 2.0 * c + patch[0, 1]
+    r = jnp.pad(resp, 1, mode="edge")
+    c = resp
+    left, right = r[1:-1, :-2], r[1:-1, 2:]
+    up, down = r[:-2, 1:-1], r[2:, 1:-1]
+    dx = 0.5 * (right - left)
+    dy = 0.5 * (down - up)
+    dxx = right - 2.0 * c + left
+    dyy = down - 2.0 * c + up
     ox = jnp.where(jnp.abs(dxx) > 1e-8, -dx / dxx, 0.0)
     oy = jnp.where(jnp.abs(dyy) > 1e-8, -dy / dyy, 0.0)
-    return jnp.clip(jnp.stack([ox, oy]), -0.5, 0.5)
+    return jnp.clip(ox, -0.5, 0.5), jnp.clip(oy, -0.5, 0.5)
 
 
 @functools.partial(jax.jit, static_argnames=("max_keypoints", "nms_size", "border"))
@@ -128,6 +140,9 @@ def detect_keypoints(
 
     Returns fixed-K arrays; `valid[i]` is False for slots whose response
     fell at/below `threshold` (relative to the frame's peak response).
+    Dense corner clusters are thinned to at most one keypoint per
+    CAND_TILE x CAND_TILE tile (in addition to `nms_size` suppression) —
+    the candidate-reduction grid both backends share.
     """
     H, W = img.shape
     resp = harris_response(img, k=harris_k)
@@ -142,17 +157,38 @@ def detect_keypoints(
     peak = jnp.maximum(jnp.max(resp), 1e-12)
     masked = jnp.where(is_max & inb & (resp > threshold * peak), resp, -jnp.inf)
 
-    scores, flat_idx = lax.top_k(masked.reshape(-1), max_keypoints)
-    iy = flat_idx // W
-    ix = flat_idx % W
+    # Candidate reduction: strongest surviving pixel per TILE x TILE tile
+    # (reshape + argmax — no gathers), then an exact top-k over the tile
+    # winners. Cuts the top-k from H*W candidates to (H*W)/TILE^2 with an
+    # at-most-one-keypoint-per-tile cap (grid-bucketed detection, the
+    # ORB-style spatial spreading), which for K << #tiles is benign.
+    T = CAND_TILE
+    Hp, Wp = -(-H // T) * T, -(-W // T) * T
+    m = jnp.pad(masked, ((0, Hp - H), (0, Wp - W)), constant_values=-jnp.inf)
+    tiles = m.reshape(Hp // T, T, Wp // T, T).transpose(0, 2, 1, 3)
+    tiles = tiles.reshape(Hp // T, Wp // T, T * T)
+    tile_val = jnp.max(tiles, axis=-1)  # (th, tw)
+    tile_arg = jnp.argmax(tiles, axis=-1).astype(jnp.int32)
+
+    n_tiles = tile_val.size
+    k = min(max_keypoints, n_tiles)
+    scores, cand = lax.top_k(tile_val.reshape(-1), k)
+    if k < max_keypoints:  # tiny frames: pad back up to the fixed K
+        pad = max_keypoints - k
+        scores = jnp.concatenate([scores, jnp.full((pad,), -jnp.inf)])
+        cand = jnp.concatenate([cand, jnp.zeros((pad,), cand.dtype)])
+    within = tile_arg.reshape(-1)[cand]  # (K,) pointwise gather, tiny
+    tw = tile_val.shape[1]
+    iy = (cand // tw) * T + within // T
+    ix = (cand % tw) * T + within % T
     valid = jnp.isfinite(scores)
 
-    # Subpixel: quadratic fit on the 3x3 neighborhood of each peak.
-    def patch_at(y, x):
-        return lax.dynamic_slice(resp, (y - 1, x - 1), (3, 3))
-
-    patches = jax.vmap(patch_at)(jnp.clip(iy, 1, H - 2), jnp.clip(ix, 1, W - 2))
-    offsets = jax.vmap(_subpixel_offset)(patches)  # (K, 2) (ox, oy)
+    # Subpixel: sample the dense quadratic-fit offset fields at the peaks.
+    ox_f, oy_f = _subpixel_fields(resp)
+    flat = jnp.clip(iy, 0, H - 1) * W + jnp.clip(ix, 0, W - 1)
+    offsets = jnp.stack(
+        [ox_f.reshape(-1)[flat], oy_f.reshape(-1)[flat]], axis=-1
+    )  # (K, 2) (ox, oy)
 
     xy = jnp.stack([ix.astype(jnp.float32), iy.astype(jnp.float32)], axis=-1)
     xy = xy + jnp.where(valid[:, None], offsets, 0.0)

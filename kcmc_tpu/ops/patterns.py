@@ -16,6 +16,8 @@ PATCH_RADIUS = 13  # BRIEF pattern support radius, pixels
 MOMENT_RADIUS = 7  # intensity-centroid disc radius (ORB orientation)
 N_ORIENT_BINS = 16  # orientation quantization (22.5 deg, ORB-style)
 ROT_RADIUS = 15  # rotated-pattern support radius (rotated offsets clipped)
+CAND_TILE = 8  # detector candidate-reduction tile side (one keypoint/tile);
+# shared so both backends bucket candidates into the same grid
 
 # 3D descriptor support (anisotropic: z-stacks are shallow)
 RADIUS_XY = 9.0
