@@ -30,7 +30,7 @@ from kcmc_tpu.ops.describe import describe_keypoints
 from kcmc_tpu.ops.detect import detect_keypoints
 from kcmc_tpu.ops.match import knn_match
 from kcmc_tpu.ops.ransac import ransac_estimate
-from kcmc_tpu.ops.warp import warp_batch, warp_frame_flow, warp_volume
+from kcmc_tpu.ops.warp import warp_batch_with_ok, warp_frame_flow, warp_volume
 
 
 @register_backend("jax")
@@ -120,7 +120,10 @@ class JaxBackend:
         cfg = self.config
         is_3d = len(shape) == 3
         if cfg.model == "piecewise":
-            per_frame = self._make_piecewise_per_frame(shape)
+            self._flow_warp = self._resolve_flow_warp()
+            per_frame = self._make_piecewise_per_frame(
+                shape, emit_flow=self._flow_warp is not None
+            )
         elif is_3d:
             per_frame = self._make_matrix_per_frame_3d(shape)
         else:
@@ -128,20 +131,37 @@ class JaxBackend:
 
         base_key = jax.random.key(cfg.seed)
 
-        # For 2D matrix models the warp runs once over the whole batch
-        # *after* the vmapped estimation — batch-level is where the Pallas
-        # kernel lives (its batch axis is a Pallas grid axis, which cannot
-        # sit inside a vmap), and the jnp path fuses identically.
-        if cfg.model != "piecewise" and not is_3d:
+        # The warp runs once over the whole batch *after* the vmapped
+        # estimation — batch-level is where the gather-free kernels live
+        # (the Pallas kernel's batch axis is a grid axis, which cannot sit
+        # inside a vmap), and the jnp path fuses identically. Every batch
+        # warp returns (corrected, ok); frames a bounded gather-free
+        # kernel could not resample are zeroed and flagged via the
+        # per-frame `warp_ok` diagnostic.
+        if cfg.model == "piecewise":
+            flow_warp = self._flow_warp  # resolved above (emit_flow)
+            if flow_warp is not None:
+
+                def batch_post(frames, out):
+                    out = dict(out)
+                    out["corrected"], out["warp_ok"] = flow_warp(
+                        frames, out.pop("flow")
+                    )
+                    return out
+
+            else:
+                batch_post = None
+        elif is_3d:
+            batch_post = None
+        else:
             batch_warp = self._resolve_batch_warp()
 
             def batch_post(frames, out):
                 out = dict(out)
-                out["corrected"] = batch_warp(frames, out["transform"])
+                out["corrected"], out["warp_ok"] = batch_warp(
+                    frames, out["transform"]
+                )
                 return out
-
-        else:
-            batch_post = None
 
         if self.mesh is not None:
             from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
@@ -191,29 +211,62 @@ class JaxBackend:
 
         return stage
 
+    @staticmethod
+    def _on_accelerator() -> bool:
+        # Where the gather-free kernels pay off (and, for Pallas, lower
+        # via TPU Mosaic). "axon" is this image's tunneled-TPU platform.
+        return jax.default_backend() in ("tpu", "axon")
+
     def _resolve_batch_warp(self):
         """Pick the batched warp implementation per the `warp` policy.
 
-        Returns fn(frames (B,H,W), transforms (B,3,3)) -> (B,H,W).
+        Returns fn(frames (B,H,W), transforms (B,3,3)) ->
+        (corrected (B,H,W), ok (B,) bool). ok is False for frames a
+        bounded gather-free kernel zeroed instead of mis-resampling.
         """
         cfg = self.config
-        # The Pallas kernel lowers via TPU Mosaic only. "axon" is this
-        # image's tunneled-TPU platform name.
-        on_tpu = jax.default_backend() in ("tpu", "axon")
+        on_tpu = self._on_accelerator()
         use_pallas = cfg.warp == "pallas" or (
             cfg.warp == "auto" and cfg.model == "translation" and on_tpu
         )
         if use_pallas:
-            if cfg.model != "translation":
-                raise ValueError(
-                    "warp='pallas' is the gather-free translation kernel; "
-                    f"model {cfg.model!r} needs warp='jnp' (or 'auto')"
-                )
             from kcmc_tpu.ops.pallas_warp import warp_batch_translation
 
             interp = not on_tpu  # interpret mode off-TPU
-            return functools.partial(warp_batch_translation, interpret=interp)
-        return warp_batch
+            return functools.partial(
+                warp_batch_translation, interpret=interp, with_ok=True
+            )
+        use_separable = cfg.warp == "separable" or (
+            cfg.warp == "auto" and cfg.model in ("rigid", "affine") and on_tpu
+        )
+        if use_separable:
+            from kcmc_tpu.ops.warp_separable import warp_batch_affine
+
+            return functools.partial(
+                warp_batch_affine, shear_px=cfg.max_shear_px, with_ok=True
+            )
+        if cfg.warp == "auto" and cfg.model == "homography" and on_tpu:
+            from kcmc_tpu.ops.warp_field import warp_batch_homography
+
+            return functools.partial(
+                warp_batch_homography,
+                shear_px=cfg.max_shear_px,
+                max_px=cfg.max_projective_px,
+                with_ok=True,
+            )
+        return warp_batch_with_ok
+
+    def _resolve_flow_warp(self):
+        """Batched dense-flow warp for the piecewise model, or None to
+        warp per-frame inside the vmap (the gather path, default off-TPU)."""
+        cfg = self.config
+        if cfg.warp == "auto" and self._on_accelerator():
+            from kcmc_tpu.ops.warp_field import warp_batch_flow
+
+            return functools.partial(
+                warp_batch_flow, max_px=cfg.max_flow_px, with_ok=True
+            )
+        return None
 
     def _make_matrix_per_frame(self, shape):
         cfg = self.config
@@ -244,7 +297,10 @@ class JaxBackend:
 
         return per_frame
 
-    def _make_piecewise_per_frame(self, shape):
+    def _make_piecewise_per_frame(self, shape, emit_flow: bool = False):
+        """With emit_flow the per-frame fn returns the dense flow for the
+        batch-level gather-free warp (batch_post consumes it); otherwise
+        it warps inline with the jnp gather flow warp."""
         cfg = self.config
         stage = self._detect_describe_match(cfg)
 
@@ -264,15 +320,18 @@ class JaxBackend:
                 prior=cfg.patch_prior,
                 smooth_sigma=cfg.field_smooth_sigma,
             )
-            corrected = warp_frame_flow(frame, res.flow)
-            return {
+            out = {
                 "field": res.field,
-                "corrected": corrected,
                 "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
                 "n_matches": jnp.sum(valid).astype(jnp.int32),
                 "n_inliers": res.n_inliers,
                 "rms_residual": res.rms_residual,
             }
+            if emit_flow:
+                out["flow"] = res.flow
+            else:
+                out["corrected"] = warp_frame_flow(frame, res.flow)
+            return out
 
         return per_frame
 

@@ -46,19 +46,43 @@ class CorrectorConfig:
 
     # -- execution ---------------------------------------------------------
     batch_size: int = 32  # frames per jitted device step
-    # Warp kernel selection: "jnp" = XLA gather warp (all models);
-    # "pallas" = gather-free Pallas kernel (translation model only);
-    # "auto" = pallas for translation on an accelerator, jnp otherwise.
+    # Warp kernel selection: "jnp" = XLA gather warp (all models, exact,
+    # slow on TPU); "pallas" = gather-free Pallas kernel (translation
+    # only); "separable" = gather-free shear/scale multi-pass (affine
+    # family); "auto" = on an accelerator, the gather-free kernel for the
+    # model (pallas for translation, separable for rigid/affine, the
+    # affine+residual-field split for homography, the translation+
+    # residual-field split for piecewise) and jnp elsewhere. The
+    # gather-free kernels are bounded: frames whose motion exceeds the
+    # max_*_px bounds below are zeroed and flagged in the per-frame
+    # `warp_ok` diagnostic instead of being silently mis-resampled.
     warp: str = "auto"
+    # Static bound on the separable warp's shear magnitude, pixels
+    # (covers |tan(rotation/2)| * frame_side/2; 8 px ~ 3.6 deg at 512).
+    max_shear_px: int = 8
+    # Static bound on the field warp's residual displacement after the
+    # mean translation is factored out (piecewise-rigid local motion).
+    max_flow_px: int = 6
+    # Static bound on the projective residual after the homography's
+    # first-order affine part is factored out.
+    max_projective_px: int = 4
 
     def __post_init__(self):
-        if self.warp not in ("auto", "jnp", "pallas"):
+        if self.warp not in ("auto", "jnp", "pallas", "separable"):
             raise ValueError(
-                f"warp must be 'auto', 'jnp', or 'pallas', got {self.warp!r}"
+                "warp must be 'auto', 'jnp', 'pallas', or 'separable', "
+                f"got {self.warp!r}"
             )
         if self.warp == "pallas" and self.model != "translation":
             raise ValueError(
                 "warp='pallas' is the gather-free translation kernel; "
+                f"model {self.model!r} needs warp='jnp' (or 'auto')"
+            )
+        if self.warp == "separable" and self.model not in (
+            "translation", "rigid", "affine"
+        ):
+            raise ValueError(
+                "warp='separable' resamples affine-family transforms; "
                 f"model {self.model!r} needs warp='jnp' (or 'auto')"
             )
 

@@ -93,14 +93,19 @@ def _warp_kernel(iscal_ref, fscal_ref, src_ref, out_ref):
     out_ref[:, :] = jnp.where(inb, blend, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "with_ok"))
 def warp_batch_translation(
-    frames: jnp.ndarray, transforms: jnp.ndarray, interpret: bool = False
+    frames: jnp.ndarray,
+    transforms: jnp.ndarray,
+    interpret: bool = False,
+    with_ok: bool = False,
 ) -> jnp.ndarray:
     """Correct (B, H, W) frames under pure translations.
 
     transforms: (B, 3, 3) matrices [[1,0,tx],[0,1,ty],[0,0,1]]. Matches
     `vmap(warp_frame)` up to float rounding, with zero gathers on TPU.
+    `with_ok` also returns the (B,) bool flag marking frames whose shift
+    was within the +-PAD exactness window (False = frame zeroed).
     """
     B, H, W = frames.shape
     tx = transforms[:, 0, 2]
@@ -136,12 +141,13 @@ def warp_batch_translation(
         ],
         out_specs=pl.BlockSpec((None, H, W), lambda b, iscal: (b, 0, 0)),
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _warp_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, W), jnp.float32),
         interpret=interpret,
     )(iscal, fscal, padded.astype(jnp.float32))
+    return (out, exact > 0.5) if with_ok else out
 
 
 def warp_frame_translation(

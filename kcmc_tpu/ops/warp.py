@@ -69,9 +69,15 @@ def warp_frame(frame: jnp.ndarray, M: jnp.ndarray) -> jnp.ndarray:
 
 def warp_batch(frames: jnp.ndarray, transforms: jnp.ndarray) -> jnp.ndarray:
     """(B, H, W) frames, (B, 3, 3) transforms -> corrected batch (vmapped
-    gather warp — the generic batched counterpart of the Pallas
-    translation kernel in ops/pallas_warp.py)."""
+    gather warp — the generic batched counterpart of the gather-free
+    kernels in ops/pallas_warp.py / ops/warp_separable.py)."""
     return jax.vmap(warp_frame)(frames, transforms)
+
+
+def warp_batch_with_ok(frames: jnp.ndarray, transforms: jnp.ndarray):
+    """warp_batch plus an all-True (B,) ok flag — the gather warp handles
+    every transform, so it matches the gather-free kernels' with_ok API."""
+    return warp_batch(frames, transforms), jnp.ones(frames.shape[0], bool)
 
 
 def warp_frame_flow(frame: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:

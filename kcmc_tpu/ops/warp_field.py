@@ -1,0 +1,208 @@
+"""Gather-free warps for dense flow fields and homographies.
+
+Completes the gather-free warp family (ops/pallas_warp.py: translation;
+ops/warp_separable.py: affine) for the remaining two workloads
+(SURVEY.md §0 configs 3-4):
+
+* `warp_batch_flow` — piecewise-rigid dense displacement fields. The
+  flow splits into its mean translation (exact, via the separable
+  warp's unbounded-offset resample matrices) plus a SMALL residual
+  field, which is resampled by a statically-bounded sum of shifted
+  views weighted by per-pixel bilinear hats — pure VPU elementwise
+  work, no gathers. Piecewise-rigid residuals are local patch motion
+  around the global drift, a few pixels by construction.
+
+* `warp_batch_homography` — projective transforms. The homography
+  splits as H = A @ N with A its first-order (affine) Taylor expansion
+  about the frame center — warped by the separable affine passes — and
+  N = A^-1 H a near-identity projective residual warped by the same
+  small-field kernel. Wide-field projective drift keeps |N(p) - p|
+  to a couple of pixels across the frame.
+
+Frames whose residual exceeds the static bound are zeroed rather than
+silently mis-resampled, matching the policy of the other kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kcmc_tpu.ops.warp_separable import warp_batch_affine
+
+
+def _clamped_shift_matrix(n_in: int, n_out: int, offset) -> jnp.ndarray:
+    """(n_out, n_in) matrix: out[i] = in[clip(i + offset, 0, n_in-1)].
+
+    For integer offsets every row is one-hot — an exact shift with
+    edge-clamped overhang (the gather warp's tap semantics)."""
+    pos = jnp.clip(
+        jnp.arange(n_out, dtype=jnp.float32) + offset, 0.0, n_in - 1.0
+    )
+    src = jnp.arange(n_in, dtype=jnp.float32)
+    return jnp.maximum(1.0 - jnp.abs(pos[:, None] - src[None, :]), 0.0)
+
+
+def _field_resample_small(padded: jnp.ndarray, flow: jnp.ndarray, R: int) -> jnp.ndarray:
+    """out[p] = padded[p + R+1 + flow[p]] for |flow| <= R: a masked-shift
+    sum over a (H+2R+2, W+2R+2) source whose halo carries the border
+    content (edge-replicated or real). flow: (H, W, 2) of (ux, uy).
+    Bilinear; the caller masks out-of-frame sample positions.
+    """
+    H, W = flow.shape[:2]
+    ux, uy = flow[..., 0], flow[..., 1]
+    mx = jnp.floor(ux)
+    my = jnp.floor(uy)
+    fx = ux - mx
+    fy = uy - my
+    mxi = mx.astype(jnp.int32)
+    myi = my.astype(jnp.int32)
+    out = jnp.zeros((H, W), padded.dtype)
+    for ky in range(-R, R + 2):
+        wy = jnp.where(myi == ky, 1.0 - fy, 0.0) + jnp.where(myi == ky - 1, fy, 0.0)
+        for kx in range(-R, R + 2):
+            wx = jnp.where(mxi == kx, 1.0 - fx, 0.0) + jnp.where(
+                mxi == kx - 1, fx, 0.0
+            )
+            view = jax.lax.dynamic_slice(
+                padded, (R + 1 + ky, R + 1 + kx), (H, W)
+            )
+            out = out + (wy * wx) * view
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("max_px", "with_ok"))
+def warp_batch_flow(
+    frames: jnp.ndarray, flows: jnp.ndarray, max_px: int = 6, with_ok: bool = False
+) -> jnp.ndarray:
+    """Correct (B, H, W) frames through (B, H, W, 2) forward displacement
+    fields (corrected(p) = frame(p + u(p))) with zero gathers.
+
+    The per-frame mean displacement, rounded to whole pixels, is applied
+    exactly as an integer translation onto a haloed canvas (unbounded,
+    interpolation-free, source taps edge-clamped like the gather warp's),
+    so the result matches one-shot bilinear sampling up to float
+    association; the residual — including the fractional part — must
+    stay within the static `max_px` bound or the frame is zeroed.
+    """
+    B, H, W = frames.shape
+    frames = jnp.asarray(frames, jnp.float32)
+    flows = jnp.asarray(flows, jnp.float32)
+    t = jnp.round(jnp.mean(flows, axis=(1, 2)))  # (B, 2) integer (tx, ty)
+
+    # Integer-translate onto a canvas with a (max_px+1)-pixel halo of real
+    # border content, so the residual pass's taps near the frame edge read
+    # what one-shot bilinear would (clamped to the source frame).
+    P = max_px + 1
+
+    def translate_halo(img, txy):
+        Kx = _clamped_shift_matrix(W, W + 2 * P, txy[0] - P)
+        Ky = _clamped_shift_matrix(H, H + 2 * P, txy[1] - P)
+        x = jnp.matmul(img, Kx.T, precision=jax.lax.Precision.HIGHEST)
+        return jnp.matmul(Ky, x, precision=jax.lax.Precision.HIGHEST)
+
+    halos = jax.vmap(translate_halo)(frames, t)
+
+    resid = flows - t[:, None, None, :]
+    ok = jnp.max(jnp.abs(resid), axis=(1, 2, 3)) <= max_px  # (B,)
+
+    # Residual resample of the translated image: corrected(p) =
+    # frame(p + t + r(p)) = shifted(p + r(p)) exactly (r evaluated at p).
+    out = jax.vmap(lambda ha, fl: _field_resample_small(ha, fl, max_px))(
+        halos, resid
+    )
+    # Coverage: zero where the TRUE sample position leaves the frame.
+    xs = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+    ys = jnp.arange(H, dtype=jnp.float32)[None, :, None]
+    sx = xs + flows[..., 0]
+    sy = ys + flows[..., 1]
+    inb = (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+    res = jnp.where(ok[:, None, None], out * inb, 0.0)
+    return (res, ok) if with_ok else res
+
+
+def _affine_about_center(M: jnp.ndarray, cx: float, cy: float):
+    """First-order Taylor expansion of the projective map at the center:
+    returns (A (3,3) affine, ok) with A(p) ~ M(p) near (cx, cy)."""
+    m = M / M[2, 2]
+    g, h = m[2, 0], m[2, 1]
+    w0 = g * cx + h * cy + 1.0
+    ok = jnp.abs(w0) > 1e-3
+    w0 = jnp.where(ok, w0, 1.0)
+    sx0 = (m[0, 0] * cx + m[0, 1] * cy + m[0, 2]) / w0
+    sy0 = (m[1, 0] * cx + m[1, 1] * cy + m[1, 2]) / w0
+    # d(sx)/dx = (m00 - g*sx)/w at the center, etc.
+    a00 = (m[0, 0] - g * sx0) / w0
+    a01 = (m[0, 1] - h * sx0) / w0
+    a10 = (m[1, 0] - g * sy0) / w0
+    a11 = (m[1, 1] - h * sy0) / w0
+    A = jnp.array(
+        [
+            [a00, a01, sx0 - a00 * cx - a01 * cy],
+            [a10, a11, sy0 - a10 * cx - a11 * cy],
+            [0.0, 0.0, 1.0],
+        ],
+        dtype=jnp.float32,
+    )
+    return A, ok
+
+
+@functools.partial(jax.jit, static_argnames=("shear_px", "max_px", "with_ok"))
+def warp_batch_homography(
+    frames: jnp.ndarray,
+    transforms: jnp.ndarray,
+    shear_px: int = 8,
+    max_px: int = 4,
+    with_ok: bool = False,
+) -> jnp.ndarray:
+    """Correct (B, H, W) frames through (B, 3, 3) homographies with zero
+    gathers: separable affine passes for the first-order part, the
+    small-field kernel for the projective residual N = A^-1 H.
+    """
+    B, H, W = frames.shape
+    frames = jnp.asarray(frames, jnp.float32)
+    Ms = jnp.asarray(transforms, jnp.float32)
+    cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+
+    def split(M):
+        A, ok = _affine_about_center(M, cx, cy)
+        N = jnp.linalg.solve(A, M / M[2, 2])
+        return A, N, ok & (jnp.abs(M[2, 2]) > 1e-6)
+
+    As, Ns, oks = jax.vmap(split)(Ms)
+    base, affine_ok = warp_batch_affine(frames, As, shear_px=shear_px, with_ok=True)
+    oks = oks & affine_ok
+
+    xs = jnp.arange(W, dtype=jnp.float32)[None, :]
+    ys = jnp.arange(H, dtype=jnp.float32)[:, None]
+
+    def resid_flow(N):
+        w = N[2, 0] * xs + N[2, 1] * ys + N[2, 2]
+        w = jnp.where(jnp.abs(w) < 1e-8, 1e-8, w)
+        sx = (N[0, 0] * xs + N[0, 1] * ys + N[0, 2]) / w
+        sy = (N[1, 0] * xs + N[1, 1] * ys + N[1, 2]) / w
+        return jnp.stack([sx - xs, sy - ys], -1)
+
+    flows = jax.vmap(resid_flow)(Ns)  # (B, H, W, 2): N(p) - p
+    ok = oks & (jnp.max(jnp.abs(flows), axis=(1, 2, 3)) <= max_px)
+    padded = jnp.pad(
+        base, ((0, 0), (max_px + 1, max_px + 1), (max_px + 1, max_px + 1)),
+        mode="edge",
+    )
+    out = jax.vmap(lambda im, fl: _field_resample_small(im, fl, max_px))(
+        padded, flows
+    )
+
+    # Coverage from the TRUE homography sample positions.
+    def inb_mask(M):
+        w = M[2, 0] * xs + M[2, 1] * ys + M[2, 2]
+        w = jnp.where(jnp.abs(w) < 1e-8, 1e-8, w)
+        sx = (M[0, 0] * xs + M[0, 1] * ys + M[0, 2]) / w
+        sy = (M[1, 0] * xs + M[1, 1] * ys + M[1, 2]) / w
+        return (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+
+    inb = jax.vmap(inb_mask)(Ms)
+    res = jnp.where(ok[:, None, None], out * inb, 0.0)
+    return (res, ok) if with_ok else res

@@ -1,0 +1,165 @@
+"""Gather-free batched warp for affine-family transforms: shear/scale passes.
+
+The generic warp (ops/warp.py) is 4 arbitrary gathers per pixel — the
+one memory pattern the TPU cannot vectorize. This module resamples
+through any *affine* transform (translation / rigid / affine — the
+motion-correction families; SURVEY.md §0 configs 1-2) with ZERO
+gathers, by the classic multi-pass decomposition (Catmull-Smith),
+mapped onto what the TPU does well:
+
+    M2 = Sx(alpha) @ Sy(beta) @ diag(u, v)        (2x2 linear part)
+
+    warp_M = scale_y . scale_x . shear_y . shear_x      (applied order)
+
+* The two SHEAR passes sample `x + alpha*(y - cy)` (resp.
+  `y + beta*(x - cx)`): per-row constant fractional shifts. They are
+  computed as a short statically-bounded loop of shifted views blended
+  by per-row bilinear coefficients — pure VPU elementwise work. The
+  static bound `shear_px` covers |alpha| * H/2 pixels; drift-correction
+  rotations are small (tan(theta/2) * H/2; ~2.3 px at 1 deg for
+  H=512), and frames whose shear exceeds the bound are zeroed and
+  flagged rather than silently mis-resampled.
+* The two SCALE passes sample `u*x + c` (uniform stride per row, same
+  for all rows) and absorb the WHOLE translation: each is a banded
+  bilinear-interpolation matrix built on the fly from iota comparisons
+  and applied as one MXU matmul — arbitrary offsets at zero extra cost,
+  which is why the translation lives here and not in the shear range.
+
+Multi-pass 1D-linear interpolation is not bit-identical to one-shot 2D
+bilinear (it is slightly smoother along the shear direction); the
+registration transforms are unaffected (the warp does not feed back
+into estimation) and tests bound the interior difference on smooth
+imagery.
+
+Out-of-frame samples produce 0, matching ops/warp.py's coverage mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def decompose_affine(M: jnp.ndarray) -> dict:
+    """Split a 3x3 affine (row [0,0,1] last) into shear/scale pass params.
+
+    Returns per-frame scalars: alpha, beta (shears), u, v (strides),
+    c, d (x/y offsets for the scale passes), and `ok` (False where the
+    decomposition degenerates: m11 ~ 0 or u ~ 0, far outside the
+    drift-correction regime).
+    """
+    m00, m01, m02 = M[0, 0], M[0, 1], M[0, 2]
+    m10, m11, m12 = M[1, 0], M[1, 1], M[1, 2]
+    ok1 = jnp.abs(m11) > 1e-3
+    m11s = jnp.where(ok1, m11, 1.0)
+    alpha = m01 / m11s
+    u = m00 - alpha * m10
+    ok2 = jnp.abs(u) > 1e-3
+    us = jnp.where(ok2, u, 1.0)
+    beta = m10 / us
+    v = m11
+    c = m02 - alpha * m12
+    return {
+        "alpha": alpha, "beta": beta, "u": us, "v": v, "c": c, "m12": m12,
+        "ok": ok1 & ok2,
+    }
+
+
+def _shear_x(img: jnp.ndarray, alpha: jnp.ndarray, cy: float, R: int) -> jnp.ndarray:
+    """Resample rows at x + alpha*(y - cy); |alpha*(y-cy)| must be <= R."""
+    H, W = img.shape
+    y = jnp.arange(H, dtype=jnp.float32) - cy
+    s = alpha * y  # (H,) per-row shift
+    m = jnp.floor(s)
+    f = (s - m)[:, None]
+    mi = m.astype(jnp.int32)[:, None]
+    padded = jnp.pad(img, ((0, 0), (R + 1, R + 1)), mode="edge")
+    out = jnp.zeros_like(img)
+    for k in range(-R, R + 1):
+        # rows with floor(shift) == k contribute (1-f) at tap k and rows
+        # with floor(shift) == k-1 contribute f at their +1 tap.
+        coef = jnp.where(mi == k, 1.0 - f, 0.0) + jnp.where(mi == k - 1, f, 0.0)
+        out = out + coef * lax.dynamic_slice_in_dim(padded, R + 1 + k, W, axis=1)
+    return out
+
+
+def _shear_y(img: jnp.ndarray, beta: jnp.ndarray, cx: float, R: int) -> jnp.ndarray:
+    """Resample columns at y + beta*(x - cx); |beta*(x-cx)| must be <= R."""
+    H, W = img.shape
+    x = jnp.arange(W, dtype=jnp.float32) - cx
+    s = beta * x
+    m = jnp.floor(s)
+    f = (s - m)[None, :]
+    mi = m.astype(jnp.int32)[None, :]
+    padded = jnp.pad(img, ((R + 1, R + 1), (0, 0)), mode="edge")
+    out = jnp.zeros_like(img)
+    for k in range(-R, R + 1):
+        coef = jnp.where(mi == k, 1.0 - f, 0.0) + jnp.where(mi == k - 1, f, 0.0)
+        out = out + coef * lax.dynamic_slice_in_dim(padded, R + 1 + k, H, axis=0)
+    return out
+
+
+def _resample_matrix(n_in: int, n_out: int, stride, offset) -> jnp.ndarray:
+    """(n_out, n_in) banded bilinear matrix: out[i] = in at stride*i+offset.
+
+    Rows whose source position falls outside [0, n_in-1] are all-zero
+    (out-of-frame -> 0, matching the gather warp's coverage semantics).
+    """
+    pos = stride * jnp.arange(n_out, dtype=jnp.float32) + offset  # (n_out,)
+    src = jnp.arange(n_in, dtype=jnp.float32)  # (n_in,)
+    w = 1.0 - jnp.abs(pos[:, None] - src[None, :])
+    K = jnp.maximum(w, 0.0)
+    inb = (pos >= 0.0) & (pos <= n_in - 1.0)
+    return K * inb[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("shear_px", "with_ok"))
+def warp_batch_affine(
+    frames: jnp.ndarray,
+    transforms: jnp.ndarray,
+    shear_px: int = 8,
+    with_ok: bool = False,
+) -> jnp.ndarray:
+    """Correct (B, H, W) frames through (B, 3, 3) affine transforms with
+    zero gathers. Matches vmap(warp_frame) up to the multi-pass
+    interpolation difference; frames whose shear magnitude exceeds
+    `shear_px` (or whose transform is projective/degenerate) are zeroed
+    rather than silently mis-resampled. `with_ok` also returns the (B,)
+    bool flag marking frames that were within bounds (False = zeroed).
+    """
+    B, H, W = frames.shape
+    cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+    hi = jnp.asarray(frames, jnp.float32)
+
+    def per_frame(img, M):
+        p = decompose_affine(M)
+        shear_ok = (
+            (jnp.abs(p["alpha"]) * max(cy, H - 1 - cy) <= shear_px)
+            & (jnp.abs(p["beta"]) * max(cx, W - 1 - cx) <= shear_px)
+            & p["ok"]
+            # affine only: projective row must be [0, 0, 1]
+            & (jnp.abs(M[2, 0]) < 1e-12) & (jnp.abs(M[2, 1]) < 1e-12)
+            & (jnp.abs(M[2, 2] - 1.0) < 1e-6)
+        )
+        # Shear offsets are center-relative; the residual constants fold
+        # into the scale-pass offsets (cX absorbs the x-shear's +alpha*cy;
+        # dY is solved from the row-1 offset given the ACTUAL cX, since
+        # the y-shear pass sees x coordinates that the x-scale pass will
+        # later shift by cX - and re-centers by +beta*cx itself).
+        x1 = _shear_x(img, p["alpha"], cy, shear_px)
+        x2 = _shear_y(x1, p["beta"], cx, shear_px)
+        cX = p["c"] + p["alpha"] * cy
+        dY = p["m12"] - p["beta"] * (cX - cx)
+        Kx = _resample_matrix(W, W, p["u"], cX)
+        Ky = _resample_matrix(H, H, p["v"], dY)
+        # x-scale: out[h, j] = sum_w x2[h, w] Kx[j, w]  (MXU)
+        x3 = jnp.matmul(x2, Kx.T, precision=lax.Precision.HIGHEST)
+        # y-scale: out[i, w] = sum_h x3[h, w] Ky[i, h]
+        x4 = jnp.matmul(Ky, x3, precision=lax.Precision.HIGHEST)
+        return jnp.where(shear_ok, x4, 0.0), shear_ok
+
+    out, ok = jax.vmap(per_frame)(hi, jnp.asarray(transforms, jnp.float32))
+    return (out, ok) if with_ok else out

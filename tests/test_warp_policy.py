@@ -35,7 +35,29 @@ def test_auto_on_cpu_uses_jnp():
     """auto must fall back to the gather warp on CPU (no accelerator)."""
     from kcmc_tpu.backends.jax_backend import JaxBackend
     from kcmc_tpu.config import CorrectorConfig
-    from kcmc_tpu.ops.warp import warp_batch
+    from kcmc_tpu.ops.warp import warp_batch_with_ok
 
     b = JaxBackend(CorrectorConfig(model="translation", warp="auto"))
-    assert b._resolve_batch_warp() is warp_batch
+    assert b._resolve_batch_warp() is warp_batch_with_ok
+
+
+def test_warp_ok_flag_surfaces():
+    """Frames a bounded gather-free kernel zeroes must be flagged."""
+    data = synthetic.make_drift_stack(
+        n_frames=4, shape=(128, 128), model="rigid", max_drift=4.0, seed=2
+    )
+    # max_shear_px=0 makes any nonzero rotation exceed the bound.
+    res = MotionCorrector(
+        model="rigid", backend="jax", batch_size=4, warp="separable",
+        max_shear_px=0,
+    ).correct(data.stack)
+    ok = res.diagnostics["warp_ok"]
+    assert ok.shape == (4,)
+    # the rotated frames exceed a zero shear budget -> flagged + zeroed
+    assert not ok[1:].any()
+    assert np.all(res.corrected[~ok] == 0.0)
+    # sanity: with the default bound everything is within range
+    res2 = MotionCorrector(
+        model="rigid", backend="jax", batch_size=4, warp="separable"
+    ).correct(data.stack)
+    assert np.all(res2.diagnostics["warp_ok"])
