@@ -1,0 +1,132 @@
+"""Command-line interface: `python -m kcmc_tpu <command>`.
+
+    python -m kcmc_tpu info stack.tif
+    python -m kcmc_tpu correct stack.tif -o corrected.tif \
+        --model affine --transforms transforms.npz --progress
+
+`correct` streams: chunks decode in a background thread (native TIFF
+decoder), register on the accelerator, and corrected frames append to
+the output TIFF incrementally — constant host memory regardless of
+stack length.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _cmd_info(args) -> int:
+    from kcmc_tpu.io import TiffStack
+
+    with TiffStack(args.stack) as ts:
+        print(
+            json.dumps(
+                {
+                    "path": args.stack,
+                    "n_frames": ts.n_frames,
+                    "frame_shape": list(ts.frame_shape),
+                    "dtype": str(ts.dtype),
+                    "decoder": ts.backend,
+                }
+            )
+        )
+    return 0
+
+
+def _cmd_correct(args) -> int:
+    from kcmc_tpu import MotionCorrector
+
+    ref = args.reference
+    if ref not in ("first", "mean"):
+        ref = int(ref)
+    overrides = {}
+    if args.batch_size:
+        overrides["batch_size"] = args.batch_size
+    if args.max_keypoints:
+        overrides["max_keypoints"] = args.max_keypoints
+    if args.hypotheses:
+        overrides["n_hypotheses"] = args.hypotheses
+    if args.warp:
+        overrides["warp"] = args.warp
+
+    mc = MotionCorrector(
+        model=args.model, backend=args.backend, reference=ref, **overrides
+    )
+    res = mc.correct_file(
+        args.stack,
+        output=args.output,
+        compression=args.compression,
+        progress=args.progress,
+        n_threads=args.io_threads,
+    )
+
+    if args.transforms:
+        payload = {k: v for k, v in res.diagnostics.items()}
+        if res.transforms is not None:
+            payload["transforms"] = res.transforms
+        if res.fields is not None:
+            payload["fields"] = res.fields
+        np.savez(args.transforms, **payload)
+
+    fps = res.frames_per_sec
+    summary = {
+        "model": args.model,
+        "backend": args.backend,
+        "output": args.output,
+        "transforms": args.transforms,
+        "frames_per_sec": round(fps, 2) if fps else None,
+        "mean_inliers": float(np.mean(res.diagnostics["n_inliers"]))
+        if "n_inliers" in res.diagnostics
+        else None,
+    }
+    if "warp_ok" in res.diagnostics:
+        summary["warp_flagged_frames"] = int(
+            (~res.diagnostics["warp_ok"]).sum()
+        )
+    print(json.dumps(summary))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kcmc_tpu",
+        description="TPU-native keypoint-consensus motion correction",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("info", help="describe a TIFF stack")
+    p.add_argument("stack")
+    p.set_defaults(fn=_cmd_info)
+
+    p = sub.add_parser("correct", help="register + correct a stack")
+    p.add_argument("stack", help="input multi-page TIFF")
+    p.add_argument("-o", "--output", help="corrected-stack TIFF to write")
+    p.add_argument(
+        "--model",
+        default="translation",
+        choices=["translation", "rigid", "affine", "homography", "piecewise"],
+    )
+    p.add_argument("--backend", default="jax")
+    p.add_argument("--reference", default="0",
+                   help="frame index, 'first', or 'mean'")
+    p.add_argument("--transforms", help=".npz for transforms + diagnostics")
+    p.add_argument("--batch-size", type=int, default=0)
+    p.add_argument("--max-keypoints", type=int, default=0)
+    p.add_argument("--hypotheses", type=int, default=0)
+    p.add_argument("--warp", default="", choices=["", "auto", "jnp", "pallas", "separable"])
+    p.add_argument("--compression", default="none",
+                   choices=["none", "deflate", "packbits"])
+    p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument("--progress", action="store_true")
+    p.set_defaults(fn=_cmd_correct)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -331,6 +331,7 @@ class JaxBackend:
                 out["flow"] = res.flow
             else:
                 out["corrected"] = warp_frame_flow(frame, res.flow)
+                out["warp_ok"] = jnp.bool_(True)  # gather warp: unbounded
             return out
 
         return per_frame
@@ -380,6 +381,7 @@ class JaxBackend:
             return {
                 "transform": res.transform,
                 "corrected": corrected,
+                "warp_ok": jnp.bool_(True),  # gather warp: unbounded
                 "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
                 "n_matches": jnp.sum(m.valid).astype(jnp.int32),
                 "n_inliers": res.n_inliers,

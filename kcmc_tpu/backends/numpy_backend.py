@@ -59,7 +59,10 @@ class NumpyBackend:
         return {k: np.stack(v) for k, v in out.items()}
 
     def _keys(self):
-        base = ["corrected", "n_keypoints", "n_matches", "n_inliers", "rms_residual"]
+        base = [
+            "corrected", "warp_ok", "n_keypoints", "n_matches",
+            "n_inliers", "rms_residual",
+        ]
         return base + (["field"] if self.config.model == "piecewise" else ["transform"])
 
     def _process_one(self, frame, gidx, ref, out):
@@ -89,6 +92,7 @@ class NumpyBackend:
         rng = np.random.default_rng([cfg.seed, gidx])
         out["n_keypoints"].append(np.int32(valid.sum()))
         out["n_matches"].append(np.int32(ok.sum()))
+        out["warp_ok"].append(np.bool_(True))  # gather warp: unbounded
 
         if cfg.model == "piecewise":
             field, flow, n_in, rms = self._estimate_field(src, dst, ok, rng, frame.shape)

@@ -58,7 +58,8 @@ class CorrectorConfig:
     # `warp_ok` diagnostic instead of being silently mis-resampled.
     warp: str = "auto"
     # Static bound on the separable warp's shear magnitude, pixels
-    # (covers |tan(rotation/2)| * frame_side/2; 8 px ~ 3.6 deg at 512).
+    # (covers ~|tan(rotation)| * frame_side/2; 8 px ~ 1.8 deg at 512 —
+    # raise it for larger rotations at a linear cost in the shear pass).
     max_shear_px: int = 8
     # Static bound on the field warp's residual displacement after the
     # mean translation is factored out (piecewise-rigid local motion).

@@ -134,38 +134,20 @@ class MotionCorrector:
         B = cfg.batch_size
         outs = []
         indices = np.arange(start_frame, T)
-        # Pipelined dispatch: keep a window of batches in flight so the
-        # host->device upload of batch i+1, the compute of batch i, and
-        # the device->host download of batch i-1 all overlap (the
-        # process_batch_async seam; backends without it run synchronously).
-        dispatch = getattr(self.backend, "process_batch_async", None)
-        inflight: list[tuple[int, dict]] = []
-        depth = 3
 
         def drain(entry):
             n, out = entry
             outs.append({k: np.asarray(v)[:n] for k, v in out.items()})
 
-        with timer.stage("register_batches"):
+        def batches():
             for lo in range(start_frame, T, B):
                 hi = min(lo + B, T)
-                batch = stack[lo:hi]
-                idx = np.arange(lo, hi)
-                if len(batch) < B:  # pad tail to the compiled batch size
-                    pad = B - len(batch)
-                    batch = np.concatenate([batch, np.repeat(batch[-1:], pad, axis=0)])
-                    idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
-                if dispatch is not None:
-                    inflight.append((hi - lo, dispatch(batch, ref, idx)))
-                    if len(inflight) >= depth:
-                        drain(inflight.pop(0))
-                else:
-                    out = self.backend.process_batch(batch, ref, idx)
-                    outs.append({k: v[: hi - lo] for k, v in out.items()})
+                yield self._pad_batch(stack[lo:hi], np.arange(lo, hi), B)
                 if progress:
                     print(f"[kcmc] frames {hi}/{T}", flush=True)
-            for entry in inflight:
-                drain(entry)
+
+        with timer.stage("register_batches"):
+            self._dispatch_batches(batches(), ref, drain)
 
         merged = {
             k: np.concatenate([o[k] for o in outs]) for k in outs[0]
@@ -179,4 +161,130 @@ class MotionCorrector:
             fields=fields,
             diagnostics=merged,
             timing=timer.report(n_frames=len(indices)),
+        )
+
+    @staticmethod
+    def _pad_batch(batch, idx, B):
+        """Pad a tail batch to the compiled batch size; returns
+        (n_valid, frames (B, ...), indices (B,))."""
+        n = len(batch)
+        if n < B:
+            pad = B - n
+            batch = np.concatenate([batch, np.repeat(batch[-1:], pad, axis=0)])
+            idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
+        return n, batch, idx
+
+    def _dispatch_batches(self, batches, ref, drain, depth: int = 3):
+        """Pipelined dispatch: keep `depth` batches in flight so the
+        host->device upload of batch i+1, the compute of batch i, and
+        the device->host download of batch i-1 all overlap (the
+        process_batch_async seam; backends without it run synchronously).
+
+        batches yields (n_valid, frames, indices); drain receives
+        (n_valid, output dict) in order.
+        """
+        dispatch = getattr(self.backend, "process_batch_async", None)
+        inflight: list[tuple[int, dict]] = []
+        for n, batch, idx in batches:
+            if dispatch is not None:
+                inflight.append((n, dispatch(batch, ref, idx)))
+                if len(inflight) >= depth:
+                    drain(inflight.pop(0))
+            else:
+                drain((n, self.backend.process_batch(batch, ref, idx)))
+        for entry in inflight:
+            drain(entry)
+
+    def correct_file(
+        self,
+        path,
+        output: str | None = None,
+        chunk_size: int | None = None,
+        compression: str = "none",
+        progress: bool = False,
+        n_threads: int = 0,
+    ) -> CorrectionResult:
+        """Stream-correct a multi-page TIFF stack.
+
+        Chunks are decoded by a background prefetch thread (the native
+        threaded TIFF decoder when available) while the device registers
+        the previous chunk, and — when `output` is given — corrected
+        frames stream to a new TIFF incrementally, so stacks far larger
+        than host memory process at steady state. Returns the transforms
+        and diagnostics; `corrected` is empty when writing to `output`
+        (the frames are on disk).
+        """
+        from kcmc_tpu.io import ChunkedStackLoader, TiffStack
+        from kcmc_tpu.io.tiff import TiffWriter
+
+        timer = StageTimer()
+        cfg = self.config
+        B = cfg.batch_size
+        chunk = chunk_size or max(B, 64)
+        chunk = ((chunk + B - 1) // B) * B  # multiple of the batch size
+
+        with TiffStack(path, n_threads=n_threads) as ts:
+            with timer.stage("prepare_reference"):
+                if isinstance(self.reference, (int, np.integer)):
+                    idx = int(self.reference)
+                    if not -len(ts) <= idx < len(ts):
+                        raise ValueError(
+                            f"reference index {idx} out of range for "
+                            f"{len(ts)} frames"
+                        )
+                    if idx < 0:
+                        idx += len(ts)
+                    ref_frame = np.asarray(ts.read(idx, idx + 1)[0], np.float32)
+                else:
+                    head = ts.read(0, self.reference_window)
+                    ref_frame = self._select_reference(
+                        np.asarray(head, np.float32)
+                    )
+                ref = self.backend.prepare_reference(ref_frame)
+
+            writer = TiffWriter(output, compression=compression) if output else None
+            outs = []
+
+            def drain(entry):
+                n, out = entry
+                host = {k: np.asarray(v)[:n] for k, v in out.items()}
+                corrected = host.pop("corrected", None)
+                if writer is not None and corrected is not None:
+                    for fr in corrected:
+                        writer.append(fr)
+                elif corrected is not None:
+                    host["corrected"] = corrected
+                outs.append(host)
+
+            def batches():
+                loader = ChunkedStackLoader(ts, chunk_size=chunk)
+                for lo, hi, frames in loader:
+                    frames = np.asarray(frames, np.float32)
+                    for blo in range(lo, hi, B):
+                        bhi = min(blo + B, hi)
+                        yield self._pad_batch(
+                            frames[blo - lo : bhi - lo], np.arange(blo, bhi), B
+                        )
+                    if progress:
+                        print(f"[kcmc] frames {hi}/{len(ts)}", flush=True)
+
+            try:
+                with timer.stage("register_batches"):
+                    self._dispatch_batches(batches(), ref, drain)
+            finally:
+                if writer is not None:
+                    writer.close()
+
+        merged = {
+            k: np.concatenate([o[k] for o in outs]) for k in outs[0]
+        } if outs else {}
+        corrected = merged.pop(
+            "corrected", np.empty((0,) + ts.frame_shape, np.float32)
+        )
+        return CorrectionResult(
+            corrected=corrected,
+            transforms=merged.pop("transform", None),
+            fields=merged.pop("field", None),
+            diagnostics=merged,
+            timing=timer.report(n_frames=sum(len(o.get("n_inliers", [])) for o in outs)),
         )

@@ -1,0 +1,6 @@
+"""Stack I/O: TIFF read/write (native threaded decoder) + chunked loading."""
+
+from kcmc_tpu.io.reader import ChunkedStackLoader
+from kcmc_tpu.io.tiff import TiffStack, read_stack, write_stack
+
+__all__ = ["ChunkedStackLoader", "TiffStack", "read_stack", "write_stack"]

@@ -1,0 +1,101 @@
+"""Background-prefetching chunked stack loader.
+
+Overlaps host-side decode (the native threaded TIFF decoder, or any
+array-like source) with device compute: a reader thread keeps a small
+queue of decoded (lo, hi, ndarray) chunks ahead of the consumer, so the
+TPU never waits on disk or decompression. This is the host half of the
+streaming pipeline; the device half is the orchestrator's dispatch-ahead
+window (corrector.py).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+import numpy as np
+
+from kcmc_tpu.io.tiff import TiffStack
+
+
+class ChunkedStackLoader:
+    """Iterate (lo, hi, frames) chunks of a stack with background prefetch.
+
+    source: a TiffStack, a path to one, or any array-like with
+    numpy-style slicing along axis 0 (ndarray, memmap, zarr-ish).
+    """
+
+    def __init__(
+        self,
+        source,
+        chunk_size: int = 64,
+        start: int = 0,
+        stop: int | None = None,
+        prefetch: int = 2,
+        n_threads: int = 0,
+    ):
+        self._own = False
+        if isinstance(source, (str, os.PathLike)):
+            source = TiffStack(source, n_threads=n_threads)
+            self._own = True
+        self.source = source
+        self.n_total = len(source)
+        self.start = start
+        self.stop = self.n_total if stop is None else min(stop, self.n_total)
+        self.chunk_size = chunk_size
+        self.prefetch = max(1, prefetch)
+
+    def _read(self, lo: int, hi: int) -> np.ndarray:
+        if isinstance(self.source, TiffStack):
+            return self.source.read(lo, hi)
+        return np.asarray(self.source[lo:hi])
+
+    def __len__(self) -> int:
+        return self.stop - self.start
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop_flag = threading.Event()
+
+        def producer():
+            try:
+                for lo in range(self.start, self.stop, self.chunk_size):
+                    if stop_flag.is_set():
+                        return
+                    hi = min(lo + self.chunk_size, self.stop)
+                    q.put((lo, hi, self._read(lo, hi)))
+            except BaseException as e:  # surface decode errors to consumer
+                q.put(e)
+                return
+            q.put(None)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop_flag.set()
+            # drain so the producer's blocked put() can finish
+            while t.is_alive():
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
+
+    def close(self):
+        if self._own and isinstance(self.source, TiffStack):
+            self.source.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -15,10 +15,10 @@ mapped onto what the TPU does well:
   `y + beta*(x - cx)`): per-row constant fractional shifts. They are
   computed as a short statically-bounded loop of shifted views blended
   by per-row bilinear coefficients — pure VPU elementwise work. The
-  static bound `shear_px` covers |alpha| * H/2 pixels; drift-correction
-  rotations are small (tan(theta/2) * H/2; ~2.3 px at 1 deg for
-  H=512), and frames whose shear exceeds the bound are zeroed and
-  flagged rather than silently mis-resampled.
+  static bound `shear_px` covers |alpha| * H/2 pixels with
+  alpha ~ tan(theta); drift-correction rotations are small (~4.5 px
+  at 1 deg for H=512), and frames whose shear exceeds the bound are
+  zeroed and flagged rather than silently mis-resampled.
 * The two SCALE passes sample `u*x + c` (uniform stride per row, same
   for all rows) and absorb the WHOLE translation: each is a banded
   bilinear-interpolation matrix built on the fly from iota comparisons
@@ -133,6 +133,8 @@ def warp_batch_affine(
     B, H, W = frames.shape
     cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
     hi = jnp.asarray(frames, jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)[None, :]
+    ys = jnp.arange(H, dtype=jnp.float32)[:, None]
 
     def per_frame(img, M):
         p = decompose_affine(M)
@@ -159,7 +161,14 @@ def warp_batch_affine(
         x3 = jnp.matmul(x2, Kx.T, precision=lax.Precision.HIGHEST)
         # y-scale: out[i, w] = sum_h x3[h, w] Ky[i, h]
         x4 = jnp.matmul(Ky, x3, precision=lax.Precision.HIGHEST)
-        return jnp.where(shear_ok, x4, 0.0), shear_ok
+        # Coverage from the TRUE 2D source positions (the per-axis masks
+        # inside the passes cannot see the other axis, and the shear
+        # passes edge-replicate): zero out-of-frame output pixels exactly
+        # like the gather warp does.
+        sx = M[0, 0] * xs + M[0, 1] * ys + M[0, 2]
+        sy = M[1, 0] * xs + M[1, 1] * ys + M[1, 2]
+        inb = (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+        return jnp.where(shear_ok & inb, x4, 0.0), shear_ok
 
     out, ok = jax.vmap(per_frame)(hi, jnp.asarray(transforms, jnp.float32))
     return (out, ok) if with_ok else out
