@@ -125,7 +125,10 @@ class JaxBackend:
                 shape, emit_flow=self._flow_warp is not None
             )
         elif is_3d:
-            per_frame = self._make_matrix_per_frame_3d(shape)
+            self._vol_warp = self._resolve_volume_warp()
+            per_frame = self._make_matrix_per_frame_3d(
+                shape, emit_transform_only=self._vol_warp is not None
+            )
         else:
             per_frame = self._make_matrix_per_frame(shape)
 
@@ -152,7 +155,18 @@ class JaxBackend:
             else:
                 batch_post = None
         elif is_3d:
-            batch_post = None
+            vol_warp = self._vol_warp
+            if vol_warp is not None:
+
+                def batch_post(frames, out):
+                    out = dict(out)
+                    out["corrected"], out["warp_ok"] = vol_warp(
+                        frames, out["transform"]
+                    )
+                    return out
+
+            else:
+                batch_post = None
         else:
             batch_warp = self._resolve_batch_warp()
 
@@ -268,6 +282,18 @@ class JaxBackend:
             )
         return None
 
+    def _resolve_volume_warp(self):
+        """Batched gather-free 3D rigid warp, or None for the per-frame
+        trilinear gather path (default off-TPU)."""
+        cfg = self.config
+        if cfg.warp == "auto" and self._on_accelerator():
+            from kcmc_tpu.ops.warp_field import warp_batch_rigid3d
+
+            return functools.partial(
+                warp_batch_rigid3d, max_px=cfg.max_flow_px, with_ok=True
+            )
+        return None
+
     def _make_matrix_per_frame(self, shape):
         cfg = self.config
         model = get_model(cfg.model)
@@ -336,7 +362,10 @@ class JaxBackend:
 
         return per_frame
 
-    def _make_matrix_per_frame_3d(self, shape):
+    def _make_matrix_per_frame_3d(self, shape, emit_transform_only: bool = False):
+        """With emit_transform_only the batch-level gather-free volume
+        warp (batch_post) produces `corrected`; otherwise the per-frame
+        trilinear gather warp runs inline."""
         cfg = self.config
         from kcmc_tpu.ops.detect3d import detect_keypoints_3d
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d
@@ -377,15 +406,16 @@ class JaxBackend:
                 threshold=cfg.inlier_threshold,
                 refine_iters=cfg.refine_iters,
             )
-            corrected = warp_volume(frame, res.transform)
-            return {
+            out = {
                 "transform": res.transform,
-                "corrected": corrected,
-                "warp_ok": jnp.bool_(True),  # gather warp: unbounded
                 "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
                 "n_matches": jnp.sum(m.valid).astype(jnp.int32),
                 "n_inliers": res.n_inliers,
                 "rms_residual": res.rms_residual,
             }
+            if not emit_transform_only:
+                out["corrected"] = warp_volume(frame, res.transform)
+                out["warp_ok"] = jnp.bool_(True)  # gather warp: unbounded
+            return out
 
         return per_frame

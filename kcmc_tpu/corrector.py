@@ -236,7 +236,8 @@ class MotionCorrector:
                         idx += len(ts)
                     ref_frame = np.asarray(ts.read(idx, idx + 1)[0], np.float32)
                 else:
-                    head = ts.read(0, self.reference_window)
+                    n_head = 1 if self.reference == "first" else self.reference_window
+                    head = ts.read(0, n_head)
                     ref_frame = self._select_reference(
                         np.asarray(head, np.float32)
                     )
@@ -256,22 +257,33 @@ class MotionCorrector:
                     host["corrected"] = corrected
                 outs.append(host)
 
-            def batches():
-                loader = ChunkedStackLoader(ts, chunk_size=chunk)
-                for lo, hi, frames in loader:
-                    frames = np.asarray(frames, np.float32)
-                    for blo in range(lo, hi, B):
-                        bhi = min(blo + B, hi)
-                        yield self._pad_batch(
-                            frames[blo - lo : bhi - lo], np.arange(blo, bhi), B
-                        )
-                    if progress:
-                        print(f"[kcmc] frames {hi}/{len(ts)}", flush=True)
+            loader = ChunkedStackLoader(ts, chunk_size=chunk)
 
+            def batches():
+                chunks = iter(loader)
+                try:
+                    for lo, hi, frames in chunks:
+                        frames = np.asarray(frames, np.float32)
+                        for blo in range(lo, hi, B):
+                            bhi = min(blo + B, hi)
+                            yield self._pad_batch(
+                                frames[blo - lo : bhi - lo], np.arange(blo, bhi), B
+                            )
+                        if progress:
+                            print(f"[kcmc] frames {hi}/{len(ts)}", flush=True)
+                finally:
+                    chunks.close()  # stop + join the prefetch thread
+
+            batch_gen = batches()
             try:
                 with timer.stage("register_batches"):
-                    self._dispatch_batches(batches(), ref, drain)
+                    self._dispatch_batches(batch_gen, ref, drain)
             finally:
+                # Shut the prefetch thread down BEFORE the TiffStack
+                # context closes the native handle it reads through
+                # (closing the generator triggers the loader iterator's
+                # stop/join cleanup even when an exception unwinds).
+                batch_gen.close()
                 if writer is not None:
                     writer.close()
 

@@ -64,7 +64,9 @@ def _build_native() -> ctypes.CDLL | None:
     if not so_path.exists() or so_path.stat().st_mtime < src_mtime:
         build_dir = _NATIVE_SRC.parent
         if not os.access(build_dir, os.W_OK):
-            build_dir = Path(tempfile.gettempdir())
+            # Private, unpredictable dir: a fixed world-shared /tmp name
+            # would let another local user plant or swap the library.
+            build_dir = Path(tempfile.mkdtemp(prefix="kcmc_native_"))
             so_path = build_dir / "kcmc_stackio.so"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
@@ -484,6 +486,13 @@ class TiffWriter:
 
         f = self._f
         strip_off = f.tell()
+        # Classic TIFF carries 32-bit offsets; refuse to stream past them
+        # with a clear error instead of corrupting the file mid-write.
+        if strip_off + len(data) + 256 >= 2**32:
+            raise ValueError(
+                "classic TIFF output would exceed 4 GiB; write compressed "
+                "(compression='deflate') or split the stack across files"
+            )
         f.write(data)
         if f.tell() % 2:
             f.write(b"\0")  # word-align the IFD

@@ -94,10 +94,13 @@ const uint32_t kTypeSize[14] = {0, 1, 1, 2, 4, 8, 1, 1, 2, 4, 8, 4, 8, 8};
 bool read_entry_values(Reader& r, uint16_t type, uint64_t count,
                        uint64_t offset, std::vector<uint64_t>* out) {
   out->clear();
-  out->reserve(count);
-  std::vector<unsigned char> buf;
   uint32_t tsz = type < 14 ? kTypeSize[type] : 0;
   if (tsz == 0) return false;
+  // count comes straight from the file: bound it (largest legitimate
+  // arrays are strip tables — one entry per image row at most)
+  if (count == 0 || count > (1u << 24)) return false;
+  out->reserve(count);
+  std::vector<unsigned char> buf;
   buf.resize((size_t)tsz * count);
   off_t keep = ftello(r.f);
   uint64_t value_or_offset = offset;
@@ -231,9 +234,12 @@ bool decode_page(const Stack& st, int fd, const Page& page, unsigned char* out) 
   for (const Strip& s : page.strips) {
     size_t want = row_bytes * s.rows;
     if (st.compression == 1) {
-      if (pread(fd, out + out_off, s.nbytes, (off_t)s.offset) != (ssize_t)s.nbytes)
+      // clamp to the expected strip size: StripByteCounts comes from the
+      // file and must never size a write into the caller's buffer
+      size_t take = s.nbytes < want ? s.nbytes : want;
+      if (pread(fd, out + out_off, take, (off_t)s.offset) != (ssize_t)take)
         return false;
-      if (s.nbytes < want) memset(out + out_off + s.nbytes, 0, want - s.nbytes);
+      if (take < want) memset(out + out_off + take, 0, want - take);
     } else {
       comp.resize(s.nbytes);
       if (pread(fd, comp.data(), s.nbytes, (off_t)s.offset) != (ssize_t)s.nbytes)
@@ -355,7 +361,7 @@ int kcmc_open(const char* path, void** handle, KcmcStackInfo* info) {
       size_t field = big_tiff ? 8 : 4;
       if (!r.read(raw, field)) { st->error = "bad entry"; return 1; }
       uint32_t tsz = type < 14 ? kTypeSize[type] : 0;
-      if (tsz == 0) continue;  // unknown type: skip tag
+      if (tsz == 0 || count == 0) continue;  // unknown type / empty: skip
       std::vector<uint64_t> vals;
       if (tsz * count <= field) {
         // inline values (endianness per file)
@@ -379,6 +385,7 @@ int kcmc_open(const char* path, void** handle, KcmcStackInfo* info) {
           return 1;
         }
       }
+      if (vals.empty()) continue;
       switch (tag) {
         case 256: width = (uint32_t)vals[0]; break;
         case 257: height = (uint32_t)vals[0]; break;

@@ -68,13 +68,15 @@ def moment_offsets(radius: int = MOMENT_RADIUS) -> np.ndarray:
 
 
 def make_pattern_3d(seed: int = 11) -> np.ndarray:
-    """(N_BITS, 2, 3) float32 (pair, endpoint, (x, y, z)) offsets."""
+    """(N_BITS, 2, 3) float32 (pair, endpoint, (x, y, z)) INTEGER offsets
+    (same integer-quantization rationale as make_pattern: sampling becomes
+    a constant one-hot selection on TPU)."""
     rng = np.random.default_rng(seed)
     xy = rng.normal(0.0, RADIUS_XY / 2.0, size=(N_BITS, 2, 2))
     z = rng.normal(0.0, RADIUS_Z / 2.0, size=(N_BITS, 2, 1))
     pts = np.concatenate([xy, z], axis=-1)
     lim = np.array([RADIUS_XY, RADIUS_XY, RADIUS_Z])
-    return np.clip(pts, -lim, lim).astype(np.float32)
+    return np.rint(np.clip(pts, -lim, lim)).astype(np.float32)
 
 
 PATTERN = make_pattern()

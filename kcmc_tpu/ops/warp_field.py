@@ -123,6 +123,112 @@ def warp_batch_flow(
     return (res, ok) if with_ok else res
 
 
+@functools.partial(jax.jit, static_argnames=("max_px", "with_ok"))
+def warp_batch_rigid3d(
+    vols: jnp.ndarray,
+    transforms: jnp.ndarray,
+    max_px: int = 6,
+    with_ok: bool = False,
+) -> jnp.ndarray:
+    """Correct (B, D, H, W) volumes through (B, 4, 4) rigid transforms
+    with zero gathers: integer translation via per-axis clamped shift
+    matmuls onto a haloed canvas, then THREE sequential per-axis
+    1D resamples of the bounded residual displacement u(p) = M p - p - t.
+
+    The sequential-axis split evaluates each displacement component at
+    the ORIGINAL voxel position, an O(|u|*rotation) approximation —
+    ~0.03 px at 1 degree of drift rotation, far below the registration
+    noise floor. Residuals beyond the static `max_px` bound (or
+    non-affine transforms) zero the volume and clear the ok flag.
+    """
+    B, D, H, W = vols.shape
+    vols = jnp.asarray(vols, jnp.float32)
+    Ms = jnp.asarray(transforms, jnp.float32)
+    P = max_px + 1
+
+    zs = jnp.arange(D, dtype=jnp.float32)[:, None, None]
+    ys = jnp.arange(H, dtype=jnp.float32)[None, :, None]
+    xs = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+    cz, cy, cx = (D - 1) / 2.0, (H - 1) / 2.0, (W - 1) / 2.0
+
+    def per_vol(vol, M):
+        ok = (
+            (jnp.abs(M[3, 0]) < 1e-12) & (jnp.abs(M[3, 1]) < 1e-12)
+            & (jnp.abs(M[3, 2]) < 1e-12) & (jnp.abs(M[3, 3] - 1.0) < 1e-6)
+        )
+        # Sample positions: p_src = M p (acting on (x, y, z) points).
+        sx = M[0, 0] * xs + M[0, 1] * ys + M[0, 2] * zs + M[0, 3]
+        sy = M[1, 0] * xs + M[1, 1] * ys + M[1, 2] * zs + M[1, 3]
+        sz = M[2, 0] * xs + M[2, 1] * ys + M[2, 2] * zs + M[2, 3]
+        # Integer translation = rounded displacement at the center.
+        tc = jnp.round(
+            jnp.stack(
+                [
+                    M[0, 0] * cx + M[0, 1] * cy + M[0, 2] * cz + M[0, 3] - cx,
+                    M[1, 0] * cx + M[1, 1] * cy + M[1, 2] * cz + M[1, 3] - cy,
+                    M[2, 0] * cx + M[2, 1] * cy + M[2, 2] * cz + M[2, 3] - cz,
+                ]
+            )
+        )
+        ux = sx - xs - tc[0]
+        uy = sy - ys - tc[1]
+        uz = sz - zs - tc[2]
+        ok = ok & (
+            jnp.maximum(
+                jnp.max(jnp.abs(ux)),
+                jnp.maximum(jnp.max(jnp.abs(uy)), jnp.max(jnp.abs(uz))),
+            )
+            <= max_px
+        )
+
+        # Integer-translate onto a haloed canvas (clamped taps).
+        Kz = _clamped_shift_matrix(D, D + 2 * P, tc[2] - P)
+        Ky = _clamped_shift_matrix(H, H + 2 * P, tc[1] - P)
+        Kx = _clamped_shift_matrix(W, W + 2 * P, tc[0] - P)
+        hp = jnp.einsum(
+            "zd,dhw->zhw", Kz, vol, precision=jax.lax.Precision.HIGHEST
+        )
+        hp = jnp.einsum(
+            "yh,zhw->zyw", Ky, hp, precision=jax.lax.Precision.HIGHEST
+        )
+        hp = jnp.einsum(
+            "xw,zyw->zyx", Kx, hp, precision=jax.lax.Precision.HIGHEST
+        )  # (D+2P, H+2P, W+2P)
+
+        # Residual per-axis resamples; each pass consumes one halo axis.
+        # u must be given on the (partially haloed) grid of that pass.
+        def pass_axis(arr, u, axis, out_len):
+            m = jnp.floor(u)
+            f = u - m
+            mi = m.astype(jnp.int32)
+            out = jnp.zeros(u.shape, jnp.float32)
+            for k in range(-max_px, max_px + 2):
+                w = jnp.where(mi == k, 1.0 - f, 0.0) + jnp.where(
+                    mi == k - 1, f, 0.0
+                )
+                start = [0, 0, 0]
+                start[axis] = P + k
+                size = list(arr.shape)
+                size[axis] = out_len
+                out = out + w * jax.lax.dynamic_slice(arr, start, size)
+            return out
+
+        uxh = jnp.pad(ux, ((P, P), (P, P), (0, 0)), mode="edge")
+        r1 = pass_axis(hp, uxh, 2, W)  # (D+2P, H+2P, W)
+        uyh = jnp.pad(uy, ((P, P), (0, 0), (0, 0)), mode="edge")
+        r2 = pass_axis(r1, uyh, 1, H)  # (D+2P, H, W)
+        r3 = pass_axis(r2, uz, 0, D)  # (D, H, W)
+
+        inb = (
+            (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+            & (sz >= 0) & (sz <= D - 1)
+        )
+        return jnp.where(ok & inb, r3, 0.0), ok
+
+    out, oks = jax.vmap(per_vol)(vols, Ms)
+    return (out, oks) if with_ok else out
+
+
 def _affine_about_center(M: jnp.ndarray, cx: float, cy: float):
     """First-order Taylor expansion of the projective map at the center:
     returns (A (3,3) affine, ok) with A(p) ~ M(p) near (cx, cy)."""

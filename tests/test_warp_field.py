@@ -112,3 +112,34 @@ def test_homography_pipeline_auto_matches_jnp(img):
     )
     d = np.abs(fast - res.corrected)[:, 16:-16, 16:-16]
     assert d.mean() < 5e-3
+
+
+def test_rigid3d_warp_close_to_gather():
+    from kcmc_tpu.ops.warp import warp_volume
+    from kcmc_tpu.ops.warp_field import warp_batch_rigid3d
+    from kcmc_tpu.utils.synthetic import make_drift_stack_3d
+
+    data = make_drift_stack_3d(n_frames=3, shape=(16, 64, 64), seed=5)
+    vols = jnp.asarray(data.stack)
+    Ms = jnp.asarray(data.transforms)
+    fast, ok = warp_batch_rigid3d(vols, Ms, max_px=6, with_ok=True)
+    assert np.all(np.asarray(ok))
+    ref = np.stack([np.asarray(warp_volume(vols[i], Ms[i])) for i in range(3)])
+    d = np.abs(np.asarray(fast) - ref)[:, 2:-2, 8:-8, 8:-8]
+    assert d.mean() < 5e-3, f"mean interior diff {d.mean():.4f}"
+    assert d.max() < 0.2, f"max interior diff {d.max():.4f}"
+
+
+def test_rigid3d_warp_out_of_bounds_zeroes():
+    from kcmc_tpu.ops.warp_field import warp_batch_rigid3d
+
+    rng = np.random.default_rng(0)
+    vol = jnp.asarray(rng.random((8, 32, 32), dtype=np.float32)[None])
+    M = np.eye(4, dtype=np.float32)
+    th = 0.6  # ~34 deg: residual far beyond bound
+    M[0, 0] = M[1, 1] = np.cos(th)
+    M[0, 1] = -np.sin(th)
+    M[1, 0] = np.sin(th)
+    out, ok = warp_batch_rigid3d(vol, jnp.asarray(M[None]), max_px=2, with_ok=True)
+    assert not bool(np.asarray(ok)[0])
+    assert np.all(np.asarray(out) == 0.0)
