@@ -26,7 +26,7 @@ from kcmc_tpu.backends import register_backend
 from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.models import get_model
 from kcmc_tpu.ops import piecewise as pw
-from kcmc_tpu.ops.describe import describe_keypoints
+from kcmc_tpu.ops.describe import describe_keypoints, describe_keypoints_batch
 from kcmc_tpu.ops.detect import detect_keypoints
 from kcmc_tpu.ops.match import knn_match
 from kcmc_tpu.ops.ransac import ransac_estimate
@@ -117,88 +117,36 @@ class JaxBackend:
         return self._batch_fns[key]
 
     def _build_batch_fn(self, shape):
-        cfg = self.config
+        """Assemble the LOCAL batch program: stage-wise over the batch —
+        vmapped detection, batched descriptor extraction (Pallas patch
+        kernel on accelerators), vmapped match + consensus, then the
+        batch-level gather-free warp. Batch-level is where the Pallas
+        kernels live (their batch axis is a grid axis, which cannot sit
+        inside a vmap); the jnp fallbacks fuse identically. Multi-device
+        execution wraps the same local program in shard_map.
+        """
         is_3d = len(shape) == 3
-        if cfg.model == "piecewise":
-            self._flow_warp = self._resolve_flow_warp()
-            per_frame = self._make_piecewise_per_frame(
-                shape, emit_flow=self._flow_warp is not None
-            )
-        elif is_3d:
-            self._vol_warp = self._resolve_volume_warp()
-            per_frame = self._make_matrix_per_frame_3d(
-                shape, emit_transform_only=self._vol_warp is not None
-            )
-        else:
-            per_frame = self._make_matrix_per_frame(shape)
-
-        base_key = jax.random.key(cfg.seed)
-
-        # The warp runs once over the whole batch *after* the vmapped
-        # estimation — batch-level is where the gather-free kernels live
-        # (the Pallas kernel's batch axis is a grid axis, which cannot sit
-        # inside a vmap), and the jnp path fuses identically. Every batch
-        # warp returns (corrected, ok); frames a bounded gather-free
-        # kernel could not resample are zeroed and flagged via the
-        # per-frame `warp_ok` diagnostic.
-        if cfg.model == "piecewise":
-            flow_warp = self._flow_warp  # resolved above (emit_flow)
-            if flow_warp is not None:
-
-                def batch_post(frames, out):
-                    out = dict(out)
-                    out["corrected"], out["warp_ok"] = flow_warp(
-                        frames, out.pop("flow")
-                    )
-                    return out
-
-            else:
-                batch_post = None
-        elif is_3d:
-            vol_warp = self._vol_warp
-            if vol_warp is not None:
-
-                def batch_post(frames, out):
-                    out = dict(out)
-                    out["corrected"], out["warp_ok"] = vol_warp(
-                        frames, out["transform"]
-                    )
-                    return out
-
-            else:
-                batch_post = None
-        else:
-            batch_warp = self._resolve_batch_warp()
-
-            def batch_post(frames, out):
-                out = dict(out)
-                out["corrected"], out["warp_ok"] = batch_warp(
-                    frames, out["transform"]
-                )
-                return out
-
+        local = self._build_local_3d(shape) if is_3d else self._build_local_2d(shape)
         if self.mesh is not None:
             from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
 
-            return make_sharded_batch_fn(
-                per_frame, self.mesh, base_key, batch_post=batch_post
-            )
+            return make_sharded_batch_fn(local, self.mesh)
+        return jax.jit(local)
 
-        @jax.jit
-        def batch_fn(frames, ref_xy, ref_desc, ref_valid, frame_indices):
-            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(frame_indices)
-            out = jax.vmap(
-                lambda f, k: per_frame(f, ref_xy, ref_desc, ref_valid, k)
-            )(frames, keys)
-            if batch_post is not None:
-                out = batch_post(frames, out)
-            return out
+    def _build_local_2d(self, shape):
+        cfg = self.config
+        oriented = cfg.resolved_oriented()
+        use_pallas_patches = self._on_accelerator()
+        base_key = jax.random.key(cfg.seed)
+        is_pw = cfg.model == "piecewise"
+        if is_pw:
+            flow_warp = self._resolve_flow_warp()
+        else:
+            model = get_model(cfg.model)
+            batch_warp = self._resolve_batch_warp()
 
-        return batch_fn
-
-    def _detect_describe_match(self, cfg):
-        def stage(frame, ref_xy, ref_desc, ref_valid):
-            kps = detect_keypoints(
+        def detect(frame):
+            return detect_keypoints(
                 frame,
                 max_keypoints=cfg.max_keypoints,
                 threshold=cfg.detect_threshold,
@@ -206,24 +154,112 @@ class JaxBackend:
                 border=cfg.border,
                 harris_k=cfg.harris_k,
             )
-            desc = describe_keypoints(
-                frame, kps, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
-            )
-            m = knn_match(
-                desc,
-                ref_desc,
-                kps.valid,
-                ref_valid,
-                ratio=cfg.ratio,
-                max_dist=cfg.max_hamming,
-                mutual=cfg.mutual,
-            )
-            # Correspondences: reference keypoint position -> frame position.
-            src = ref_xy[m.idx]
-            dst = kps.xy
-            return src, dst, m.valid, kps
 
-        return stage
+        def local(frames, ref_xy, ref_desc, ref_valid, indices):
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
+            kps = jax.vmap(detect)(frames)
+            desc = describe_keypoints_batch(
+                frames,
+                kps,
+                oriented=oriented,
+                blur_sigma=cfg.blur_sigma,
+                use_pallas=use_pallas_patches,
+            )
+
+            def tail(frame, kp, d, key):
+                m = knn_match(
+                    d,
+                    ref_desc,
+                    kp.valid,
+                    ref_valid,
+                    ratio=cfg.ratio,
+                    max_dist=cfg.max_hamming,
+                    mutual=cfg.mutual,
+                )
+                # Correspondences: reference keypoint -> frame position.
+                src = ref_xy[m.idx]
+                dst = kp.xy
+                out = {
+                    "n_keypoints": jnp.sum(kp.valid).astype(jnp.int32),
+                    "n_matches": jnp.sum(m.valid).astype(jnp.int32),
+                }
+                if is_pw:
+                    res = pw.estimate_field(
+                        src,
+                        dst,
+                        m.valid,
+                        key,
+                        grid=cfg.patch_grid,
+                        shape=shape,
+                        n_global_hyps=cfg.n_hypotheses,
+                        patch_hyps=cfg.patch_hypotheses,
+                        global_threshold=cfg.global_threshold,
+                        patch_threshold=cfg.inlier_threshold,
+                        prior=cfg.patch_prior,
+                        smooth_sigma=cfg.field_smooth_sigma,
+                    )
+                    out["field"] = res.field
+                    if flow_warp is not None:
+                        out["flow"] = res.flow
+                    else:
+                        out["corrected"] = warp_frame_flow(frame, res.flow)
+                        out["warp_ok"] = jnp.bool_(True)  # gather: unbounded
+                else:
+                    res = ransac_estimate(
+                        model,
+                        src,
+                        dst,
+                        m.valid,
+                        key,
+                        n_hypotheses=cfg.n_hypotheses,
+                        threshold=cfg.inlier_threshold,
+                        refine_iters=cfg.refine_iters,
+                    )
+                    out["transform"] = res.transform
+                out["n_inliers"] = res.n_inliers
+                out["rms_residual"] = res.rms_residual
+                return out
+
+            out = jax.vmap(tail)(frames, kps, desc, keys)
+            # Batch-level warp: (corrected, ok) — frames a bounded
+            # gather-free kernel could not resample are zeroed and
+            # flagged via the per-frame `warp_ok` diagnostic.
+            if is_pw:
+                if flow_warp is not None:
+                    out = dict(out)
+                    out["corrected"], out["warp_ok"] = flow_warp(
+                        frames, out.pop("flow")
+                    )
+            else:
+                out = dict(out)
+                out["corrected"], out["warp_ok"] = batch_warp(
+                    frames, out["transform"]
+                )
+            return out
+
+        return local
+
+    def _build_local_3d(self, shape):
+        cfg = self.config
+        base_key = jax.random.key(cfg.seed)
+        vol_warp = self._resolve_volume_warp()
+        per_frame = self._make_matrix_per_frame_3d(
+            shape, emit_transform_only=vol_warp is not None
+        )
+
+        def local(frames, ref_xy, ref_desc, ref_valid, indices):
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
+            out = jax.vmap(
+                lambda f, k: per_frame(f, ref_xy, ref_desc, ref_valid, k)
+            )(frames, keys)
+            if vol_warp is not None:
+                out = dict(out)
+                out["corrected"], out["warp_ok"] = vol_warp(
+                    frames, out["transform"]
+                )
+            return out
+
+        return local
 
     @staticmethod
     def _on_accelerator() -> bool:
@@ -293,74 +329,6 @@ class JaxBackend:
                 warp_batch_rigid3d, max_px=cfg.max_flow_px, with_ok=True
             )
         return None
-
-    def _make_matrix_per_frame(self, shape):
-        cfg = self.config
-        model = get_model(cfg.model)
-        stage = self._detect_describe_match(cfg)
-
-        def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
-            src, dst, valid, kps = stage(frame, ref_xy, ref_desc, ref_valid)
-            res = ransac_estimate(
-                model,
-                src,
-                dst,
-                valid,
-                key,
-                n_hypotheses=cfg.n_hypotheses,
-                threshold=cfg.inlier_threshold,
-                refine_iters=cfg.refine_iters,
-            )
-            # NOTE: no warp here — the batch program warps the whole batch
-            # at once after the vmap (see _build_batch_fn / batch_post).
-            return {
-                "transform": res.transform,
-                "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
-                "n_matches": jnp.sum(valid).astype(jnp.int32),
-                "n_inliers": res.n_inliers,
-                "rms_residual": res.rms_residual,
-            }
-
-        return per_frame
-
-    def _make_piecewise_per_frame(self, shape, emit_flow: bool = False):
-        """With emit_flow the per-frame fn returns the dense flow for the
-        batch-level gather-free warp (batch_post consumes it); otherwise
-        it warps inline with the jnp gather flow warp."""
-        cfg = self.config
-        stage = self._detect_describe_match(cfg)
-
-        def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
-            src, dst, valid, kps = stage(frame, ref_xy, ref_desc, ref_valid)
-            res = pw.estimate_field(
-                src,
-                dst,
-                valid,
-                key,
-                grid=cfg.patch_grid,
-                shape=shape,
-                n_global_hyps=cfg.n_hypotheses,
-                patch_hyps=cfg.patch_hypotheses,
-                global_threshold=cfg.global_threshold,
-                patch_threshold=cfg.inlier_threshold,
-                prior=cfg.patch_prior,
-                smooth_sigma=cfg.field_smooth_sigma,
-            )
-            out = {
-                "field": res.field,
-                "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
-                "n_matches": jnp.sum(valid).astype(jnp.int32),
-                "n_inliers": res.n_inliers,
-                "rms_residual": res.rms_residual,
-            }
-            if emit_flow:
-                out["flow"] = res.flow
-            else:
-                out["corrected"] = warp_frame_flow(frame, res.flow)
-                out["warp_ok"] = jnp.bool_(True)  # gather warp: unbounded
-            return out
-
-        return per_frame
 
     def _make_matrix_per_frame_3d(self, shape, emit_transform_only: bool = False):
         """With emit_transform_only the batch-level gather-free volume

@@ -236,7 +236,11 @@ class MotionCorrector:
                         idx += len(ts)
                     ref_frame = np.asarray(ts.read(idx, idx + 1)[0], np.float32)
                 else:
-                    n_head = 1 if self.reference == "first" else self.reference_window
+                    is_first = (
+                        isinstance(self.reference, str)
+                        and self.reference == "first"
+                    )
+                    n_head = 1 if is_first else self.reference_window
                     head = ts.read(0, n_head)
                     ref_frame = self._select_reference(
                         np.asarray(head, np.float32)

@@ -61,13 +61,18 @@ def _build_native() -> ctypes.CDLL | None:
     """Compile and load the native decoder; None if no toolchain."""
     so_path = _NATIVE_SRC.parent / "_stackio.so"
     src_mtime = _NATIVE_SRC.stat().st_mtime
+    if not os.access(_NATIVE_SRC.parent, os.W_OK):
+        # Per-user private cache dir (0700, ownership-checked): a fixed
+        # world-shared /tmp name would let another local user plant or
+        # swap the library, and a fresh mkdtemp per process would
+        # recompile on every import and leak directories.
+        build_dir = Path(tempfile.gettempdir()) / f"kcmc_native_{os.getuid()}"
+        build_dir.mkdir(mode=0o700, exist_ok=True)
+        st = build_dir.stat()
+        if st.st_uid != os.getuid() or st.st_mode & 0o077:
+            return None
+        so_path = build_dir / "kcmc_stackio.so"
     if not so_path.exists() or so_path.stat().st_mtime < src_mtime:
-        build_dir = _NATIVE_SRC.parent
-        if not os.access(build_dir, os.W_OK):
-            # Private, unpredictable dir: a fixed world-shared /tmp name
-            # would let another local user plant or swap the library.
-            build_dir = Path(tempfile.mkdtemp(prefix="kcmc_native_"))
-            so_path = build_dir / "kcmc_stackio.so"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
             str(_NATIVE_SRC), "-o", str(so_path), "-lz", "-pthread",

@@ -72,31 +72,41 @@ def _extract_patches(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Per-keypoint patches around each (subpixel) keypoint.
 
-    Returns (raw, blended): raw is the (K, 2r+2, 2r+2) integer-grid patch
-    with origin floor(xy) - r; blended is the (K, 2r+1, 2r+1) bilinear
-    resample at the keypoint's subpixel fraction, i.e.
-    blended[k, i, j] = smooth sampled at xy[k] + (j - r, i - r),
-    edge-clamped."""
+    Returns (raw, blended) in KEYPOINT-LAST layout: raw is the
+    (2r+2, 2r+2, K) integer-grid patch stack with origin floor(xy) - r;
+    blended is the (2r+1, 2r+1, K) bilinear resample at each keypoint's
+    subpixel fraction, i.e. blended[i, j, k] = smooth sampled at
+    xy[k] + (j - r, i - r), edge-clamped.
+
+    Keypoint-last matters on TPU: K (512) fills whole 128-lane tiles, so
+    the blend's shifted views move only sublanes, whereas a (K, P, P)
+    layout leaves P=2r+1 (~27) of 128 lanes occupied and forces a
+    relayout per shifted view (~10x slower end to end, measured).
+    """
     r = radius
     P = 2 * r + 2  # +1 row/col for the bilinear blend
     padded = jnp.pad(smooth, r + 1, mode="edge")
-    x0 = jnp.floor(xy[:, 0])
-    y0 = jnp.floor(xy[:, 1])
-    fx = (xy[:, 0] - x0)[:, None, None]
-    fy = (xy[:, 1] - y0)[:, None, None]
     # patch origin in padded coords: floor(kp) - r + (r + 1) = floor(kp) + 1
-    oy = y0.astype(jnp.int32) + 1
-    ox = x0.astype(jnp.int32) + 1
+    oy = jnp.floor(xy[:, 1]).astype(jnp.int32) + 1
+    ox = jnp.floor(xy[:, 0]).astype(jnp.int32) + 1
     raw = jax.vmap(
         lambda y, x: lax.dynamic_slice(padded, (y, x), (P, P))
     )(oy, ox)  # (K, P, P)
-    blended = (
-        (1.0 - fy) * (1.0 - fx) * raw[:, :-1, :-1]
-        + (1.0 - fy) * fx * raw[:, :-1, 1:]
-        + fy * (1.0 - fx) * raw[:, 1:, :-1]
-        + fy * fx * raw[:, 1:, 1:]
+    raw = jnp.transpose(raw, (1, 2, 0))  # (P, P, K): one relayout
+    return raw, _bilinear_blend(raw, xy)
+
+
+def _bilinear_blend(raw: jnp.ndarray, xy: jnp.ndarray) -> jnp.ndarray:
+    """(P, P, K) keypoint-last raw patches -> (P-1, P-1, K) bilinear
+    resample at each keypoint's subpixel fraction."""
+    fx = (xy[:, 0] - jnp.floor(xy[:, 0]))[None, None, :]
+    fy = (xy[:, 1] - jnp.floor(xy[:, 1]))[None, None, :]
+    return (
+        (1.0 - fy) * (1.0 - fx) * raw[:-1, :-1]
+        + (1.0 - fy) * fx * raw[:-1, 1:]
+        + fy * (1.0 - fx) * raw[1:, :-1]
+        + fy * fx * raw[1:, 1:]
     )
-    return raw, blended
 
 
 def _moment_angles(patches: jnp.ndarray, xy: jnp.ndarray, radius: int) -> jnp.ndarray:
@@ -104,28 +114,29 @@ def _moment_angles(patches: jnp.ndarray, xy: jnp.ndarray, radius: int) -> jnp.nd
 
     The moment disc (radius MOMENT_RADIUS) is centered on round(xy) —
     patch index radius + round(frac) — so it matches the integer-centered
-    definition of the CPU oracle. patches: (K, P, P) RAW samples (the
-    blended patch would shift the centroid by the subpixel fraction).
+    definition of the CPU oracle. patches: (P, P, K) RAW samples in
+    keypoint-last layout (the blended patch would shift the centroid by
+    the subpixel fraction).
     """
     r = _MOMENT_RADIUS
     c = radius  # patch center index for offset 0
 
     def disc(dy, dx):
-        return patches[:, c + dy - r : c + dy + r + 1, c + dx - r : c + dx + r + 1]
+        return patches[c + dy - r : c + dy + r + 1, c + dx - r : c + dx + r + 1]
 
     fx = xy[:, 0] - jnp.floor(xy[:, 0])
     fy = xy[:, 1] - jnp.floor(xy[:, 1])
-    rx = (fx >= 0.5)[:, None, None]
-    ry = (fy >= 0.5)[:, None, None]
+    rx = (fx >= 0.5)[None, None, :]
+    ry = (fy >= 0.5)[None, None, :]
     patch = jnp.where(
         ry,
         jnp.where(rx, disc(1, 1), disc(1, 0)),
         jnp.where(rx, disc(0, 1), disc(0, 0)),
-    )  # (K, 2r+1, 2r+1)
+    )  # (2r+1, 2r+1, K)
     moms = jnp.asarray(_MOMENTS)
-    w = patch * moms[..., 2]
-    m10 = jnp.sum(w * moms[..., 0], axis=(-2, -1))
-    m01 = jnp.sum(w * moms[..., 1], axis=(-2, -1))
+    w = patch * moms[..., 2][..., None]
+    m10 = jnp.sum(w * moms[..., 0][..., None], axis=(0, 1))
+    m01 = jnp.sum(w * moms[..., 1][..., None], axis=(0, 1))
     return jnp.arctan2(m01, m10)
 
 
@@ -134,6 +145,42 @@ def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
     b = bits.reshape(bits.shape[0], N_WORDS, 32).astype(jnp.uint32)
     shifts = jnp.arange(32, dtype=jnp.uint32)
     return jnp.sum(b << shifts[None, None, :], axis=-1, dtype=jnp.uint32)
+
+
+def _describe_from_patches(raw, pb, kps, oriented: bool):
+    """Descriptor bits from extracted patches.
+
+    raw/pb: (P, P, K) keypoint-last raw and blended patches (see
+    _extract_patches); returns (K, N_WORDS) uint32 descriptors.
+    """
+    K = kps.xy.shape[0]
+
+    # Precision.HIGHEST: the default TPU matmul truncates inputs to bf16,
+    # which would quantize the selected sample values and flip comparison
+    # bits relative to the f32 CPU oracle — the selection must stay exact.
+    dot = functools.partial(jnp.matmul, precision=lax.Precision.HIGHEST)
+
+    if oriented:
+        angles = _moment_angles(raw, kps.xy, ROT_RADIUS)
+        nb = N_ORIENT_BINS
+        bins = jnp.mod(
+            jnp.rint(angles * (nb / (2.0 * jnp.pi))).astype(jnp.int32), nb
+        )
+        flat = pb.reshape(-1, K)  # (L, K), keypoint-last
+        # One constant 0/1 matmul per orientation bin, masked-accumulated:
+        # MXU work, small (K, 512) accumulator, no (K, NB, 512) blow-up.
+        vals = jnp.zeros((K, PATTERN.shape[0] * 2), jnp.float32)
+        for b in range(nb):
+            sel = jnp.asarray(_SEL_ROT[b])  # (L, 512)
+            mask = (bins == b).astype(jnp.float32)[:, None]
+            vals = vals + mask * dot(flat.T, sel)
+    else:
+        vals = dot(pb.reshape(-1, K).T, jnp.asarray(_SEL_UPRIGHT))  # (K, 512)
+
+    vals = vals.reshape(K, N_BITS, 2)
+    bits = vals[..., 0] < vals[..., 1]  # (K, N_BITS)
+    desc = _pack_bits(bits)
+    return jnp.where(kps.valid[:, None], desc, jnp.zeros_like(desc))
 
 
 @functools.partial(jax.jit, static_argnames=("oriented", "blur_sigma"))
@@ -151,33 +198,50 @@ def describe_keypoints(
     has no rotation (the translation-only config).
     """
     smooth = gaussian_blur(img, blur_sigma)
-    K = kps.xy.shape[0]
+    r = ROT_RADIUS if oriented else PATCH_RADIUS
+    raw, pb = _extract_patches(smooth, kps.xy, r)
+    return _describe_from_patches(raw, pb, kps, oriented)
 
-    # Precision.HIGHEST: the default TPU matmul truncates inputs to bf16,
-    # which would quantize the selected sample values and flip comparison
-    # bits relative to the f32 CPU oracle — the selection must stay exact.
-    dot = functools.partial(jnp.matmul, precision=lax.Precision.HIGHEST)
 
-    if oriented:
-        raw, pb = _extract_patches(smooth, kps.xy, ROT_RADIUS)
-        angles = _moment_angles(raw, kps.xy, ROT_RADIUS)
-        nb = N_ORIENT_BINS
-        bins = jnp.mod(
-            jnp.rint(angles * (nb / (2.0 * jnp.pi))).astype(jnp.int32), nb
-        )
-        flat = pb.reshape(K, -1)
-        # One constant 0/1 matmul per orientation bin, masked-accumulated:
-        # MXU work, small (K, 512) accumulator, no (K, NB, 512) blow-up.
-        vals = jnp.zeros((K, PATTERN.shape[0] * 2), jnp.float32)
-        for b in range(nb):
-            sel = jnp.asarray(_SEL_ROT[b])
-            mask = (bins == b).astype(jnp.float32)[:, None]
-            vals = vals + mask * dot(flat, sel)
-    else:
-        _, pb = _extract_patches(smooth, kps.xy, PATCH_RADIUS)
-        vals = dot(pb.reshape(K, -1), jnp.asarray(_SEL_UPRIGHT))  # (K, 512)
+@functools.partial(
+    jax.jit, static_argnames=("oriented", "blur_sigma", "use_pallas", "interpret")
+)
+def describe_keypoints_batch(
+    frames: jnp.ndarray,
+    kps: Keypoints,
+    oriented: bool = True,
+    blur_sigma: float = 2.0,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(B, K, N_WORDS) descriptors for a (B, H, W) batch of frames.
 
-    vals = vals.reshape(K, N_BITS, 2)
-    bits = vals[..., 0] < vals[..., 1]  # (K, N_BITS)
-    desc = _pack_bits(bits)
-    return jnp.where(kps.valid[:, None], desc, jnp.zeros_like(desc))
+    With `use_pallas` the per-keypoint patch cut runs through the Pallas
+    extraction kernel (ops/pallas_patch.py) — XLA lowers the batched
+    data-dependent dynamic_slice to a ~1 GB/s gather, which made
+    extraction the single largest cost of the whole pipeline; the kernel
+    does it at memory speed. kps fields carry a leading batch axis.
+    """
+    if not use_pallas:
+        return jax.vmap(
+            lambda f, k: describe_keypoints(
+                f, k, oriented=oriented, blur_sigma=blur_sigma
+            )
+        )(frames, kps)
+
+    from kcmc_tpu.ops.pallas_patch import extract_patches
+
+    r = ROT_RADIUS if oriented else PATCH_RADIUS
+    P = 2 * r + 2
+    smooth = jax.vmap(lambda f: gaussian_blur(f, blur_sigma))(frames)
+    padded = jnp.pad(smooth, ((0, 0), (r + 1, r + 1), (r + 1, r + 1)), mode="edge")
+    oy = jnp.floor(kps.xy[..., 1]).astype(jnp.int32) + 1
+    ox = jnp.floor(kps.xy[..., 0]).astype(jnp.int32) + 1
+    patches = extract_patches(padded, oy, ox, P, interpret=interpret)
+
+    def per_frame(raw_kfirst, k):
+        raw = jnp.transpose(raw_kfirst, (1, 2, 0))  # (P, P, K)
+        pb = _bilinear_blend(raw, k.xy)
+        return _describe_from_patches(raw, pb, k, oriented)
+
+    return jax.vmap(per_frame)(patches, kps)

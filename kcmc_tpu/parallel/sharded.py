@@ -30,19 +30,17 @@ from jax import shard_map
 from kcmc_tpu.parallel.mesh import FRAME_AXIS
 
 
-def make_sharded_batch_fn(
-    per_frame_fn, mesh: Mesh, base_key, axis: str = FRAME_AXIS, batch_post=None
-):
-    """Wrap a per-frame pipeline fn into a sharded batch program.
+def make_sharded_batch_fn(local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS):
+    """Wrap a local batch program into a sharded one.
 
-    per_frame_fn(frame, ref_xy, ref_desc, ref_valid, key) -> dict of arrays.
-    batch_post(frames, out) -> out, if given, runs once on each chip's
-    *local* batch after the vmap (e.g. the batched Pallas warp, whose
-    batch axis is a Pallas grid axis and so must sit outside the vmap).
+    local_batch_fn(frames, ref_xy, ref_desc, ref_valid, indices) -> dict
+    is the backend's full single-chip batch program (vmapped stages +
+    batch-level Pallas kernels); indices are GLOBAL frame indices, so
+    per-frame RANSAC keys stay device-count-independent.
 
-    Returns a jitted fn(frames, ref_xy, ref_desc, ref_valid, indices) whose
-    frame-axis inputs/outputs are sharded over `mesh`; ref_* inputs are
-    sharded over the *keypoint* axis and all-gathered on device.
+    Returns a jitted fn whose frame-axis inputs/outputs are sharded over
+    `mesh`; ref_* inputs are sharded over the *keypoint* axis and
+    all-gathered on device.
     """
 
     def local_block(frames, ref_xy, ref_desc, ref_valid, indices):
@@ -50,13 +48,7 @@ def make_sharded_batch_fn(
         ref_xy = lax.all_gather(ref_xy, axis, tiled=True)
         ref_desc = lax.all_gather(ref_desc, axis, tiled=True)
         ref_valid = lax.all_gather(ref_valid, axis, tiled=True)
-        keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
-        out = jax.vmap(
-            lambda f, k: per_frame_fn(f, ref_xy, ref_desc, ref_valid, k)
-        )(frames, keys)
-        if batch_post is not None:
-            out = batch_post(frames, out)
-        return out
+        return local_batch_fn(frames, ref_xy, ref_desc, ref_valid, indices)
 
     sharded = shard_map(
         local_block,
