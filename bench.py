@@ -39,11 +39,19 @@ import numpy as np
 def _build_stack(n_frames: int, size: int, model: str):
     """Synthetic drift stack; generation is host-side and excluded from
     the timed region. For speed, generate `base` frames and tile."""
-    from kcmc_tpu.utils.synthetic import make_drift_stack, make_piecewise_stack
+    from kcmc_tpu.utils.synthetic import (
+        make_drift_stack,
+        make_drift_stack_3d,
+        make_piecewise_stack,
+    )
 
     base = min(n_frames, 64)
     if model == "piecewise":
         data = make_piecewise_stack(n_frames=base, shape=(size, size), seed=0)
+    elif model == "rigid3d":
+        data = make_drift_stack_3d(
+            n_frames=min(base, 16), shape=(32, size // 2, size // 2), seed=0
+        )
     else:
         data = make_drift_stack(
             n_frames=base, shape=(size, size), model=model, max_drift=10.0, seed=0
@@ -59,8 +67,9 @@ def _rmse(data, model, transforms, fields, size):
         return field_rmse(fields[:base], data.fields - data.fields[0])
     from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
 
+    shape = data.stack.shape[1:]
     return transform_rmse(
-        transforms[:base], relative_transforms(data.transforms), (size, size)
+        transforms[:base], relative_transforms(data.transforms), shape
     )
 
 
@@ -82,7 +91,8 @@ def run_bench_device(n_frames: int, size: int, model: str, batch: int) -> dict:
     # Upload the base frames once; tile to n_frames on device.
     base_dev = jax.device_put(np.asarray(data.stack, np.float32))
     reps = (n_frames + base - 1) // base
-    stack_dev = jnp.tile(base_dev, (reps, 1, 1))[:n_frames]
+    tile_dims = (reps,) + (1,) * (base_dev.ndim - 1)
+    stack_dev = jnp.tile(base_dev, tile_dims)[:n_frames]
     stack_dev.block_until_ready()
 
     idx_all = np.arange(n_frames, dtype=np.uint32)
@@ -126,7 +136,8 @@ def run_bench_host(n_frames: int, size: int, model: str, batch: int) -> dict:
     data = _build_stack(n_frames, size, model)
     base = len(data.stack)
     reps = (n_frames + base - 1) // base
-    stack = np.tile(data.stack, (reps, 1, 1))[:n_frames]
+    tile_dims = (reps,) + (1,) * (data.stack.ndim - 1)
+    stack = np.tile(data.stack, tile_dims)[:n_frames]
     mc = MotionCorrector(model=model, backend="jax", batch_size=batch)
     mc.correct(stack[: batch * 2])  # warmup/compile
 
@@ -167,6 +178,12 @@ def main() -> None:
                 f"[bench] {model}: {rr['fps']:.1f} fps, rmse {rr['rmse_px']:.3f} px",
                 file=sys.stderr,
             )
+        rr = run(64, args.size, "rigid3d", min(args.batch, 8))
+        print(
+            f"[bench] rigid3d (32x{args.size // 2}x{args.size // 2}): "
+            f"{rr['fps']:.1f} vol/s, rmse {rr['rmse_px']:.3f} px",
+            file=sys.stderr,
+        )
 
     target = 200.0  # frames/sec/chip — BASELINE.json north-star target
     print(

@@ -67,9 +67,18 @@ def _build_native() -> ctypes.CDLL | None:
         # swap the library, and a fresh mkdtemp per process would
         # recompile on every import and leak directories.
         build_dir = Path(tempfile.gettempdir()) / f"kcmc_native_{os.getuid()}"
-        build_dir.mkdir(mode=0o700, exist_ok=True)
-        st = build_dir.stat()
-        if st.st_uid != os.getuid() or st.st_mode & 0o077:
+        try:
+            build_dir.mkdir(mode=0o700, exist_ok=True)
+            st = build_dir.lstat()
+        except OSError:  # e.g. planted file/symlink at the path
+            return None
+        import stat as stat_mod
+
+        if (
+            not stat_mod.S_ISDIR(st.st_mode)
+            or st.st_uid != os.getuid()
+            or st.st_mode & 0o077
+        ):
             return None
         so_path = build_dir / "kcmc_stackio.so"
     if not so_path.exists() or so_path.stat().st_mtime < src_mtime:

@@ -20,17 +20,25 @@ from kcmc_tpu.ops.detect import Keypoints
 
 
 def _conv3d_axis(vol: jnp.ndarray, k: jnp.ndarray, axis: int) -> jnp.ndarray:
-    """1D convolution along one axis of a (D, H, W) volume."""
-    shape = [1, 1, 1]
-    shape[axis] = k.shape[0]
-    kernel = k.reshape(shape)
-    out = lax.conv_general_dilated(
-        vol[None, None],
-        kernel[None, None],
-        window_strides=(1, 1, 1),
-        padding="SAME",
-    )
-    return out[0, 0]
+    """1D convolution along one axis of a (D, H, W) volume, SAME padding.
+
+    Implemented as a statically unrolled shift-and-add (a handful of
+    fused elementwise FMAs): XLA's 3D `conv_general_dilated` on a
+    single-channel volume picks a layout with a 128x lane-padding
+    blow-up on TPU and OOMs at production sizes.
+    """
+    taps = int(k.shape[0])
+    R = taps // 2
+    pad = [(R, taps - 1 - R) if a == axis else (0, 0) for a in range(3)]
+    padded = jnp.pad(vol, pad)
+    size = list(vol.shape)
+    out = jnp.zeros_like(vol)
+    for i in range(taps):
+        start = [0, 0, 0]
+        start[axis] = i
+        limits = [s + sz for s, sz in zip(start, size)]
+        out = out + k[i] * lax.slice(padded, start, limits)
+    return out
 
 
 def _gauss1d(sigma: float) -> jnp.ndarray:
