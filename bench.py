@@ -59,7 +59,7 @@ def _build_stack(n_frames: int, size: int, model: str):
     return data
 
 
-def _rmse(data, model, transforms, fields, size):
+def _rmse(data, model, transforms, fields):
     base = len(data.stack)
     if model == "piecewise":
         from kcmc_tpu.utils.metrics import field_rmse
@@ -124,7 +124,7 @@ def run_bench_device(n_frames: int, size: int, model: str, batch: int) -> dict:
     got = np.concatenate([np.asarray(c) for c in checks])
     rmse = _rmse(
         data, model, got if key == "transform" else None,
-        got if key == "field" else None, size,
+        got if key == "field" else None,
     )
     return {"fps": fps, "seconds": dt, "rmse_px": rmse, "n_frames": done}
 
@@ -144,7 +144,7 @@ def run_bench_host(n_frames: int, size: int, model: str, batch: int) -> dict:
     t0 = time.perf_counter()
     res = mc.correct(stack)
     dt = time.perf_counter() - t0
-    rmse = _rmse(data, model, res.transforms, res.fields, size)
+    rmse = _rmse(data, model, res.transforms, res.fields)
     return {"fps": n_frames / dt, "seconds": dt, "rmse_px": rmse, "n_frames": n_frames}
 
 

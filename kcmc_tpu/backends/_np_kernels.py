@@ -395,6 +395,18 @@ def ransac_estimate(
         if n2 >= n_in:
             M, n_in = M2, n2
 
+    # Final polish on the consensus set, bounded rollback — mirrors
+    # ops/ransac.py (this backend's f64 solvers are already the
+    # "accurate" variant for every model).
+    r = ((apply_np(M, src) - dst) ** 2).sum(-1)
+    wf = ((r < thr2) & valid).astype(np.float32)
+    nf = int(wf.sum())
+    Mp = solve(src, dst, wf)
+    rp = ((apply_np(Mp, src) - dst) ** 2).sum(-1)
+    np_ = int(((rp < thr2) & valid).sum())
+    if np_ >= max(m, int(np.ceil(0.8 * nf))):
+        M = Mp
+
     r = ((apply_np(M, src) - dst) ** 2).sum(-1)
     inl = (r < thr2) & valid
     n = int(inl.sum())

@@ -91,7 +91,7 @@ def ransac_estimate(
         M, n_in = carry
         r = model.residual(M, src, dst)
         w = ((r < thresh_sq) & valid).astype(jnp.float32)
-        M2 = model.solve(src, dst, w)
+        M2 = model.resolved_refine_solve(src, dst, w)
         r2 = model.residual(M2, src, dst)
         n2 = jnp.sum((r2 < thresh_sq) & valid)
         # Keep the refinement only if it doesn't lose consensus.
@@ -100,6 +100,21 @@ def ransac_estimate(
         return (M_out, jnp.maximum(n2, n_in)), None
 
     (Mf, _), _ = lax.scan(refine_step, (M0, n0), None, length=refine_iters)
+
+    # Final polish: one least-squares solve (the accurate solver, where a
+    # model provides one) on the final consensus set. The in-scan
+    # rollback can otherwise pin the result to a minimal-sample
+    # hypothesis solve whose inlier count happens to tie the refined
+    # one. Accepted while it keeps (almost all of) the consensus — a
+    # slight inlier-count dip at the threshold boundary is the expected
+    # signature of a better LS fit, but a polish that sheds consensus
+    # wholesale (degenerate weighted solve) is rolled back.
+    nf = jnp.sum(((model.residual(Mf, src, dst) < thresh_sq) & valid))
+    wf = ((model.residual(Mf, src, dst) < thresh_sq) & valid).astype(jnp.float32)
+    Mp = model.resolved_refine_solve(src, dst, wf)
+    np_ = jnp.sum((model.residual(Mp, src, dst) < thresh_sq) & valid)
+    keep = np_.astype(jnp.float32) >= 0.8 * nf.astype(jnp.float32)
+    Mf = jnp.where(keep & (np_ >= model.min_samples), Mp, Mf)
 
     r = model.residual(Mf, src, dst)
     inl = (r < thresh_sq) & valid

@@ -45,11 +45,20 @@ def _clamped_shift_matrix(n_in: int, n_out: int, offset) -> jnp.ndarray:
     return jnp.maximum(1.0 - jnp.abs(pos[:, None] - src[None, :]), 0.0)
 
 
-def _field_resample_small(padded: jnp.ndarray, flow: jnp.ndarray, R: int) -> jnp.ndarray:
-    """out[p] = padded[p + R+1 + flow[p]] for |flow| <= R: a masked-shift
-    sum over a (H+2R+2, W+2R+2) source whose halo carries the border
-    content (edge-replicated or real). flow: (H, W, 2) of (ux, uy).
-    Bilinear; the caller masks out-of-frame sample positions.
+def _field_resample_small(
+    padded: jnp.ndarray, flow: jnp.ndarray, R: int, joint: bool = False
+) -> jnp.ndarray:
+    """out[p] = padded[p + R+1 + flow[p]] for |flow| <= R over a
+    (H+2R+2, W+2R+2) source whose halo carries the border content
+    (edge-replicated or real). flow: (H, W, 2) of (ux, uy). The caller
+    masks out-of-frame sample positions.
+
+    Default is TWO sequential 1D passes (x then y): 2*(2R+2) shifted
+    views instead of the joint form's (2R+2)^2, with each displacement
+    component read at the ORIGINAL pixel — an O(|u| * |grad u|)
+    approximation, negligible for the smooth patch-grid fields this
+    resamples (piecewise flows and projective residuals). `joint=True`
+    computes exact 2D bilinear instead.
     """
     H, W = flow.shape[:2]
     ux, uy = flow[..., 0], flow[..., 1]
@@ -59,23 +68,49 @@ def _field_resample_small(padded: jnp.ndarray, flow: jnp.ndarray, R: int) -> jnp
     fy = uy - my
     mxi = mx.astype(jnp.int32)
     myi = my.astype(jnp.int32)
+
+    if joint:
+        out = jnp.zeros((H, W), padded.dtype)
+        for ky in range(-R, R + 2):
+            wy = jnp.where(myi == ky, 1.0 - fy, 0.0) + jnp.where(
+                myi == ky - 1, fy, 0.0
+            )
+            for kx in range(-R, R + 2):
+                wx = jnp.where(mxi == kx, 1.0 - fx, 0.0) + jnp.where(
+                    mxi == kx - 1, fx, 0.0
+                )
+                view = jax.lax.dynamic_slice(
+                    padded, (R + 1 + ky, R + 1 + kx), (H, W)
+                )
+                out = out + (wy * wx) * view
+        return out
+
+    # x-pass over the still-y-haloed rows, then y-pass.
+    Hh = H + 2 * (R + 1)
+    mxi_h = jnp.pad(mxi, ((R + 1, R + 1), (0, 0)), mode="edge")
+    fx_h = jnp.pad(fx, ((R + 1, R + 1), (0, 0)), mode="edge")
+    r1 = jnp.zeros((Hh, W), padded.dtype)
+    for kx in range(-R, R + 2):
+        wx = jnp.where(mxi_h == kx, 1.0 - fx_h, 0.0) + jnp.where(
+            mxi_h == kx - 1, fx_h, 0.0
+        )
+        r1 = r1 + wx * jax.lax.dynamic_slice(padded, (0, R + 1 + kx), (Hh, W))
     out = jnp.zeros((H, W), padded.dtype)
     for ky in range(-R, R + 2):
-        wy = jnp.where(myi == ky, 1.0 - fy, 0.0) + jnp.where(myi == ky - 1, fy, 0.0)
-        for kx in range(-R, R + 2):
-            wx = jnp.where(mxi == kx, 1.0 - fx, 0.0) + jnp.where(
-                mxi == kx - 1, fx, 0.0
-            )
-            view = jax.lax.dynamic_slice(
-                padded, (R + 1 + ky, R + 1 + kx), (H, W)
-            )
-            out = out + (wy * wx) * view
+        wy = jnp.where(myi == ky, 1.0 - fy, 0.0) + jnp.where(
+            myi == ky - 1, fy, 0.0
+        )
+        out = out + wy * jax.lax.dynamic_slice(r1, (R + 1 + ky, 0), (H, W))
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("max_px", "with_ok"))
+@functools.partial(jax.jit, static_argnames=("max_px", "with_ok", "joint"))
 def warp_batch_flow(
-    frames: jnp.ndarray, flows: jnp.ndarray, max_px: int = 6, with_ok: bool = False
+    frames: jnp.ndarray,
+    flows: jnp.ndarray,
+    max_px: int = 6,
+    with_ok: bool = False,
+    joint: bool = False,
 ) -> jnp.ndarray:
     """Correct (B, H, W) frames through (B, H, W, 2) forward displacement
     fields (corrected(p) = frame(p + u(p))) with zero gathers.
@@ -109,10 +144,11 @@ def warp_batch_flow(
     ok = jnp.max(jnp.abs(resid), axis=(1, 2, 3)) <= max_px  # (B,)
 
     # Residual resample of the translated image: corrected(p) =
-    # frame(p + t + r(p)) = shifted(p + r(p)) exactly (r evaluated at p).
-    out = jax.vmap(lambda ha, fl: _field_resample_small(ha, fl, max_px))(
-        halos, resid
-    )
+    # frame(p + t + r(p)) = shifted(p + r(p)) (r evaluated at p; the
+    # default two-pass split is exact up to O(|r| * |grad r|)).
+    out = jax.vmap(
+        lambda ha, fl: _field_resample_small(ha, fl, max_px, joint=joint)
+    )(halos, resid)
     # Coverage: zero where the TRUE sample position leaves the frame.
     xs = jnp.arange(W, dtype=jnp.float32)[None, None, :]
     ys = jnp.arange(H, dtype=jnp.float32)[None, :, None]
