@@ -109,8 +109,9 @@ def ransac_estimate(
     # slight inlier-count dip at the threshold boundary is the expected
     # signature of a better LS fit, but a polish that sheds consensus
     # wholesale (degenerate weighted solve) is rolled back.
-    nf = jnp.sum(((model.residual(Mf, src, dst) < thresh_sq) & valid))
-    wf = ((model.residual(Mf, src, dst) < thresh_sq) & valid).astype(jnp.float32)
+    mask_f = (model.residual(Mf, src, dst) < thresh_sq) & valid
+    nf = jnp.sum(mask_f)
+    wf = mask_f.astype(jnp.float32)
     Mp = model.resolved_refine_solve(src, dst, wf)
     np_ = jnp.sum((model.residual(Mp, src, dst) < thresh_sq) & valid)
     keep = np_.astype(jnp.float32) >= 0.8 * nf.astype(jnp.float32)

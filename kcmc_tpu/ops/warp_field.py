@@ -291,13 +291,16 @@ def _affine_about_center(M: jnp.ndarray, cx: float, cy: float):
     return A, ok
 
 
-@functools.partial(jax.jit, static_argnames=("shear_px", "max_px", "with_ok"))
+@functools.partial(
+    jax.jit, static_argnames=("shear_px", "max_px", "with_ok", "joint")
+)
 def warp_batch_homography(
     frames: jnp.ndarray,
     transforms: jnp.ndarray,
     shear_px: int = 8,
     max_px: int = 4,
     with_ok: bool = False,
+    joint: bool = False,
 ) -> jnp.ndarray:
     """Correct (B, H, W) frames through (B, 3, 3) homographies with zero
     gathers: separable affine passes for the first-order part, the
@@ -333,9 +336,9 @@ def warp_batch_homography(
         base, ((0, 0), (max_px + 1, max_px + 1), (max_px + 1, max_px + 1)),
         mode="edge",
     )
-    out = jax.vmap(lambda im, fl: _field_resample_small(im, fl, max_px))(
-        padded, flows
-    )
+    out = jax.vmap(
+        lambda im, fl: _field_resample_small(im, fl, max_px, joint=joint)
+    )(padded, flows)
 
     # Coverage from the TRUE homography sample positions.
     def inb_mask(M):

@@ -14,7 +14,13 @@ FRAME_AXIS = "frames"
 
 
 def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
-    """A 1-D mesh over the first `n_devices` (default: all) devices."""
+    """A 1-D mesh over the first `n_devices` (default: all) devices.
+
+    After `initialize_multihost`, `jax.devices()` is the GLOBAL device
+    list, so the same call builds the cross-host mesh: the frame axis
+    spans every chip, the reference all-gather rides ICI within a host
+    and DCN across hosts, and the batch program is unchanged.
+    """
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
@@ -22,3 +28,36 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
             raise ValueError(f"requested {n_devices} devices, have {len(devices)}")
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (FRAME_AXIS,))
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join this host to a multi-host run (jax.distributed).
+
+    On managed TPU pods (GKE/queued resources) all arguments
+    auto-detect; pass them explicitly for hand-rolled clusters. Call
+    before any other JAX API, then `make_mesh()` for the global mesh.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def shard_host_local_frames(frames: np.ndarray, mesh: Mesh):
+    """Assemble a GLOBAL sharded frame batch from this host's local shard.
+
+    Each host passes only the frames it loaded (e.g. its slice of the
+    stack from the chunked reader); the returned jax.Array is the
+    concatenated global batch, frame-sharded over the mesh, with no
+    cross-host data movement (each chip receives its host's frames).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(FRAME_AXIS)), np.asarray(frames)
+    )

@@ -1,0 +1,179 @@
+"""Honest device profiling: forced-value timing + XLA trace capture.
+
+The tunneled/async nature of accelerator runtimes makes naive timing
+lie in BOTH directions: `jax.block_until_ready` can return before the
+device has actually executed (measured on this image's TPU tunnel —
+dispatch-only loops report physically impossible throughput), and
+forcing a value per iteration pays a full host round-trip per call.
+The honest protocol, used by bench.py and exposed here for users:
+
+1. Warm up AND force a real value (np.asarray), so the runtime leaves
+   any deferred-execution mode before timing starts.
+2. Dispatch N iterations back to back (the device stream is in-order),
+   then force ONE tiny value from the LAST iteration's output — total
+   time = N * steady-state cost + one round trip, amortized away by N.
+
+`stage_breakdown` times cumulative prefixes of the registration
+pipeline (detect / +describe / +match / +consensus / +warp) with this
+protocol, giving true incremental per-stage costs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import numpy as np
+
+
+def honest_time(fn, *args, iters: int = 24, warmup: int = 1) -> float:
+    """Seconds per call of jitted `fn(*args)`, forced-value protocol."""
+    import jax
+    import jax.numpy as jnp
+
+    for _ in range(max(1, warmup)):
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]  # force real exec
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(jnp.sum(jax.tree.leaves(out)[0]))
+    return (time.perf_counter() - t0) / iters
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA profiler trace viewable in TensorBoard/Perfetto."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def stage_breakdown(
+    model: str = "translation",
+    shape: tuple[int, int] = (512, 512),
+    batch_size: int = 64,
+    iters: int = 16,
+    **config_overrides,
+) -> dict[str, dict[str, float] | float]:
+    """True incremental cost (ms/batch) of each 2D pipeline stage.
+
+    Builds cumulative prefix programs of the registration pipeline and
+    times each with the forced-value protocol; the difference between
+    consecutive prefixes is the stage's incremental cost inside the
+    fused program (stages fuse across boundaries, so isolated timings
+    mislead).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kcmc_tpu.backends.jax_backend import JaxBackend
+    from kcmc_tpu.config import CorrectorConfig
+    from kcmc_tpu.ops.describe import describe_keypoints_batch
+    from kcmc_tpu.ops.detect import detect_keypoints
+    from kcmc_tpu.ops.match import knn_match
+    from kcmc_tpu.ops.ransac import ransac_estimate
+    from kcmc_tpu.models import get_model
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    if model in ("piecewise", "rigid3d"):
+        raise ValueError(
+            "stage_breakdown covers the 2D matrix-model pipeline; "
+            f"got model={model!r}"
+        )
+    cfg = CorrectorConfig(model=model, batch_size=batch_size, **config_overrides)
+    backend = JaxBackend(cfg)
+    data = make_drift_stack(n_frames=8, shape=shape, model=model, seed=0)
+    reps = (batch_size + 7) // 8
+    frames = jnp.asarray(
+        np.tile(data.stack, (reps, 1, 1))[:batch_size], jnp.float32
+    )
+    ref = backend.prepare_reference(np.asarray(data.stack[0], np.float32))
+    ref = {k: jnp.asarray(v) for k, v in ref.items()}
+    tmodel = get_model(cfg.model)
+    oriented = cfg.resolved_oriented()
+    use_pallas = backend._on_accelerator()
+
+    def detect(f):
+        return detect_keypoints(
+            f,
+            max_keypoints=cfg.max_keypoints,
+            threshold=cfg.detect_threshold,
+            nms_size=cfg.nms_size,
+            border=cfg.border,
+            harris_k=cfg.harris_k,
+        )
+
+    def p_detect(frames):
+        k = jax.vmap(detect)(frames)
+        return k.xy.sum() + k.score.sum()
+
+    def p_describe(frames):
+        k = jax.vmap(detect)(frames)
+        d = describe_keypoints_batch(
+            frames, k, oriented=oriented, blur_sigma=cfg.blur_sigma,
+            use_pallas=use_pallas,
+        )
+        return d.sum()
+
+    def _match(frames):
+        k = jax.vmap(detect)(frames)
+        d = describe_keypoints_batch(
+            frames, k, oriented=oriented, blur_sigma=cfg.blur_sigma,
+            use_pallas=use_pallas,
+        )
+        m = jax.vmap(
+            lambda dd, vv: knn_match(
+                dd, ref["desc"], vv, ref["valid"],
+                ratio=cfg.ratio, max_dist=cfg.max_hamming, mutual=cfg.mutual,
+            )
+        )(d, k.valid)
+        return k, m
+
+    def p_match(frames):
+        _, m = _match(frames)
+        return m.dist.sum() + m.idx.sum()
+
+    def p_consensus(frames):
+        k, m = _match(frames)
+        key = jax.random.key(cfg.seed)
+        keys = jax.vmap(
+            lambda i: jax.random.fold_in(key, i)
+        )(jnp.arange(frames.shape[0], dtype=jnp.uint32))
+        res = jax.vmap(
+            lambda s, dd, vv, kk: ransac_estimate(
+                tmodel, s, dd, vv, kk,
+                n_hypotheses=cfg.n_hypotheses,
+                threshold=cfg.inlier_threshold,
+                refine_iters=cfg.refine_iters,
+            )
+        )(ref["xy"][m.idx], k.xy, m.valid, keys)
+        return res.transform
+
+    fn_full = backend._get_batch_fn(shape)
+
+    def p_full(frames):
+        return fn_full(
+            frames, ref["xy"], ref["desc"], ref["valid"],
+            jnp.arange(frames.shape[0], dtype=jnp.uint32),
+        )
+
+    stages = [
+        ("detect", p_detect),
+        ("describe", p_describe),
+        ("match", p_match),
+        ("consensus", p_consensus),
+        ("full (+warp)", p_full),
+    ]
+    report: dict = {}
+    prev = 0.0
+    for name, fn in stages:
+        t = honest_time(jax.jit(fn), frames, iters=iters) * 1000.0
+        report[name] = {"cumulative_ms": round(t, 2), "incremental_ms": round(t - prev, 2)}
+        prev = t
+    report["frames_per_sec"] = round(batch_size / (prev / 1000.0), 1)
+    return report
