@@ -156,12 +156,28 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--host-io", action="store_true")
     ap.add_argument("--all", action="store_true")
+    ap.add_argument(
+        "--stages", action="store_true",
+        help="also print the per-stage incremental cost breakdown (stderr)",
+    )
     args = ap.parse_args()
 
     import jax
 
     dev = jax.devices()[0]
     print(f"[bench] device: {dev}", file=sys.stderr)
+
+    if args.stages:
+        from kcmc_tpu.utils.profiling import stage_breakdown
+
+        try:
+            rep = stage_breakdown(
+                model=args.model, shape=(args.size, args.size),
+                batch_size=args.batch,
+            )
+            print(f"[bench] stage breakdown: {json.dumps(rep)}", file=sys.stderr)
+        except ValueError as e:
+            print(f"[bench] --stages unavailable: {e}", file=sys.stderr)
 
     run = run_bench_host if args.host_io else run_bench_device
     r = run(args.frames, args.size, args.model, args.batch)

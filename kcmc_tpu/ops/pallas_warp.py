@@ -111,7 +111,17 @@ def warp_batch_translation(
     tx = transforms[:, 0, 2]
     ty = transforms[:, 1, 2]
     # Edge-pad so interior blends clamp exactly like the gather version.
-    padded = jnp.pad(frames, ((0, 0), (PAD, PAD), (PAD, PAD)), mode="edge")
+    # The padded dims are additionally rounded up to TPU tile alignment
+    # (8 sublanes x 128 lanes — Mosaic's dynamic rotate rejects unaligned
+    # shapes); the extra edge rows/cols sit beyond every reachable window
+    # (max read row = oy + H <= H + 2*PAD - 1 < the aligned height).
+    Hp = -(-(H + 2 * PAD) // 8) * 8
+    Wp = -(-(W + 2 * PAD) // 128) * 128
+    padded = jnp.pad(
+        frames,
+        ((0, 0), (PAD, Hp - H - PAD), (PAD, Wp - W - PAD)),
+        mode="edge",
+    )
     y0 = jnp.floor(ty)
     x0 = jnp.floor(tx)
     fy = ty - y0
@@ -131,7 +141,6 @@ def warp_batch_translation(
         [fy, fx, ty, tx, exact, zeros, zeros, zeros], axis=-1
     )  # (B, 8) float32
 
-    Hp, Wp = H + 2 * PAD, W + 2 * PAD
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B,),

@@ -6,7 +6,18 @@ one collective — the all-gather of reference-frame descriptors. Built on
 TPU-native equivalent of the reference's multi-device backend).
 """
 
-from kcmc_tpu.parallel.mesh import make_mesh, FRAME_AXIS
+from kcmc_tpu.parallel.mesh import (
+    FRAME_AXIS,
+    initialize_multihost,
+    make_mesh,
+    shard_host_local_frames,
+)
 from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
 
-__all__ = ["make_mesh", "make_sharded_batch_fn", "FRAME_AXIS"]
+__all__ = [
+    "FRAME_AXIS",
+    "initialize_multihost",
+    "make_mesh",
+    "make_sharded_batch_fn",
+    "shard_host_local_frames",
+]

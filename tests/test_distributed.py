@@ -67,7 +67,7 @@ def test_shard_host_local_frames_single_process():
     """Single-process degenerate case: local frames == global batch."""
     import numpy as np
     from kcmc_tpu.parallel import make_mesh
-    from kcmc_tpu.parallel.mesh import shard_host_local_frames
+    from kcmc_tpu.parallel import shard_host_local_frames
 
     mesh = make_mesh(4)
     frames = np.random.default_rng(0).random((8, 16, 16)).astype(np.float32)
@@ -75,16 +75,3 @@ def test_shard_host_local_frames_single_process():
     assert arr.shape == (8, 16, 16)
     np.testing.assert_allclose(np.asarray(arr), frames)
 
-
-def test_profiling_stage_breakdown_cpu():
-    from kcmc_tpu.utils.profiling import honest_time, stage_breakdown
-
-    import jax.numpy as jnp
-    import jax
-
-    t = honest_time(jax.jit(lambda x: (x * 2).sum()), jnp.ones((64, 64)), iters=3)
-    assert t >= 0.0
-    rep = stage_breakdown(shape=(96, 96), batch_size=4, iters=2, max_keypoints=64)
-    assert set(rep) == {"detect", "describe", "match", "consensus", "full (+warp)",
-                        "frames_per_sec"}
-    assert rep["frames_per_sec"] > 0

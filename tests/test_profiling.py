@@ -1,0 +1,15 @@
+"""Profiling utilities (no mesh needed)."""
+
+
+def test_profiling_stage_breakdown_cpu():
+    from kcmc_tpu.utils.profiling import honest_time, stage_breakdown
+
+    import jax.numpy as jnp
+    import jax
+
+    t = honest_time(jax.jit(lambda x: (x * 2).sum()), jnp.ones((64, 64)), iters=3)
+    assert t >= 0.0
+    rep = stage_breakdown(shape=(96, 96), batch_size=4, iters=2, max_keypoints=64)
+    assert set(rep) == {"detect", "describe", "match", "consensus", "full (+warp)",
+                        "frames_per_sec"}
+    assert rep["frames_per_sec"] > 0

@@ -1,0 +1,94 @@
+"""Edge cases: degenerate inputs must degrade gracefully, never NaN/crash."""
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.utils import synthetic
+
+
+def test_blank_frames_yield_identity():
+    """Featureless frames have no matches: identity transform, finite
+    outputs, zero inliers."""
+    stack = np.zeros((4, 96, 96), np.float32)
+    res = MotionCorrector(model="translation", backend="jax", batch_size=2).correct(stack)
+    assert np.isfinite(res.transforms).all()
+    np.testing.assert_allclose(res.transforms, np.eye(3)[None].repeat(4, 0), atol=1e-5)
+    assert np.isfinite(res.corrected).all()
+
+
+def test_noise_only_frames_finite():
+    """Pure noise: matches are garbage but everything stays finite."""
+    rng = np.random.default_rng(0)
+    stack = rng.random((4, 96, 96), dtype=np.float32)
+    res = MotionCorrector(model="affine", backend="jax", batch_size=2).correct(stack)
+    assert np.isfinite(res.transforms).all()
+    assert np.isfinite(res.corrected).all()
+    assert np.isfinite(res.diagnostics["rms_residual"]).all()
+
+
+def test_non_multiple_of_eight_frame_size():
+    data = synthetic.make_drift_stack(
+        n_frames=4, shape=(107, 93), model="translation", max_drift=3.0, seed=1
+    )
+    res = MotionCorrector(model="translation", backend="jax", batch_size=2).correct(
+        data.stack
+    )
+    assert res.corrected.shape == data.stack.shape
+    assert np.isfinite(res.transforms).all()
+
+
+def test_uint16_input_stack():
+    data = synthetic.make_drift_stack(
+        n_frames=4, shape=(128, 128), model="translation", max_drift=4.0, seed=2
+    )
+    u16 = (data.stack * 60000).astype(np.uint16)
+    res = MotionCorrector(model="translation", backend="jax", batch_size=2).correct(u16)
+    from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+    rmse = transform_rmse(
+        res.transforms, relative_transforms(data.transforms), (128, 128)
+    )
+    assert rmse < 0.75
+
+
+def test_batch_size_larger_than_stack():
+    data = synthetic.make_drift_stack(
+        n_frames=3, shape=(96, 96), model="translation", max_drift=2.0, seed=3
+    )
+    res = MotionCorrector(model="translation", backend="jax", batch_size=16).correct(
+        data.stack
+    )
+    assert res.corrected.shape[0] == 3
+
+
+def test_small_max_keypoints():
+    data = synthetic.make_drift_stack(
+        n_frames=3, shape=(96, 96), model="translation", max_drift=2.0, seed=4
+    )
+    res = MotionCorrector(
+        model="translation", backend="jax", batch_size=3, max_keypoints=24
+    ).correct(data.stack)
+    assert np.isfinite(res.transforms).all()
+
+
+def test_single_frame_stack():
+    data = synthetic.make_drift_stack(
+        n_frames=1, shape=(96, 96), model="translation", seed=5
+    )
+    res = MotionCorrector(model="translation", backend="jax", batch_size=4).correct(
+        data.stack
+    )
+    assert res.corrected.shape[0] == 1
+    np.testing.assert_allclose(res.transforms[0], np.eye(3), atol=1e-4)
+
+
+def test_bad_reference_index_raises():
+    stack = np.zeros((3, 64, 64), np.float32)
+    with pytest.raises(ValueError, match="out of range"):
+        MotionCorrector(model="translation", reference=7).correct(stack)
+
+
+def test_wrong_rank_stack_raises():
+    with pytest.raises(ValueError, match="stack must be"):
+        MotionCorrector(model="translation").correct(np.zeros((64, 64), np.float32))
