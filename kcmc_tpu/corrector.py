@@ -103,15 +103,24 @@ class MotionCorrector:
         start_frame: int = 0,
         end_frame: int | None = None,
         progress: bool = False,
+        device_outputs: bool = False,
     ) -> CorrectionResult:
         """Correct a (T, H, W) or (T, D, H, W) stack.
+
+        `stack` may be a NumPy array (host-fed; uploads overlap compute)
+        or a jax.Array already resident on the accelerator — device
+        stacks are sliced on-device, never round-tripped through the
+        host. With `device_outputs` the result arrays stay on device
+        (jax.Arrays), for pipelines that keep post-processing on-chip.
 
         `start_frame`/`end_frame` bound the processed range while keeping
         *global* frame indices (RANSAC keys fold in the global index, so
         chunked and one-shot runs produce identical transforms) — this is
         what utils/checkpoint.py's resume manager builds on.
         """
-        stack = np.asarray(stack)
+        on_device = hasattr(stack, "devices")  # jax.Array (any backend)
+        if not on_device:
+            stack = np.asarray(stack)
         if stack.ndim not in (3, 4):
             raise ValueError(
                 f"stack must be (T, H, W) or (T, D, H, W), got shape {stack.shape}"
@@ -128,6 +137,8 @@ class MotionCorrector:
         T = len(stack) if end_frame is None else min(end_frame, len(stack))
 
         with timer.stage("prepare_reference"):
+            # _select_reference works for device stacks too: its branches
+            # slice first, so only the needed frames transfer to host.
             ref_frame = self._select_reference(stack)
             ref = self.backend.prepare_reference(ref_frame)
 
@@ -135,24 +146,40 @@ class MotionCorrector:
         outs = []
         indices = np.arange(start_frame, T)
 
+        if on_device:
+            import jax.numpy as xp
+        else:
+            xp = np
+        convert = (lambda v: v) if device_outputs else np.asarray
+
         def drain(entry):
             n, out = entry
-            outs.append({k: np.asarray(v)[:n] for k, v in out.items()})
+            outs.append({k: convert(v)[:n] for k, v in out.items()})
 
         def batches():
             for lo in range(start_frame, T, B):
                 hi = min(lo + B, T)
-                yield self._pad_batch(stack[lo:hi], np.arange(lo, hi), B)
+                yield self._pad_batch(stack[lo:hi], np.arange(lo, hi), B, xp=xp)
                 if progress:
                     print(f"[kcmc] frames {hi}/{T}", flush=True)
 
         with timer.stage("register_batches"):
-            self._dispatch_batches(batches(), ref, drain)
+            self._dispatch_batches(
+                batches(), ref, drain, to_host=not device_outputs
+            )
 
+        if device_outputs:
+            import jax.numpy as jnp
+
+            cat = jnp.concatenate
+            empty = jnp.empty((0,) + tuple(stack.shape[1:]), jnp.float32)
+        else:
+            cat = np.concatenate
+            empty = np.empty((0,) + tuple(stack.shape[1:]), np.float32)
         merged = {
-            k: np.concatenate([o[k] for o in outs]) for k in outs[0]
+            k: cat([o[k] for o in outs]) for k in outs[0]
         } if outs else {}
-        corrected = merged.pop("corrected", np.empty((0,) + stack.shape[1:], np.float32))
+        corrected = merged.pop("corrected", empty)
         transforms = merged.pop("transform", None)
         fields = merged.pop("field", None)
         return CorrectionResult(
@@ -164,30 +191,40 @@ class MotionCorrector:
         )
 
     @staticmethod
-    def _pad_batch(batch, idx, B):
+    def _pad_batch(batch, idx, B, xp=np):
         """Pad a tail batch to the compiled batch size; returns
-        (n_valid, frames (B, ...), indices (B,))."""
+        (n_valid, frames (B, ...), indices (B,)). `xp` is the array
+        module matching where `batch` lives (numpy or jax.numpy)."""
         n = len(batch)
         if n < B:
             pad = B - n
-            batch = np.concatenate([batch, np.repeat(batch[-1:], pad, axis=0)])
+            batch = xp.concatenate([batch, xp.repeat(batch[-1:], pad, axis=0)])
             idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
         return n, batch, idx
 
-    def _dispatch_batches(self, batches, ref, drain, depth: int = 3):
+    def _dispatch_batches(self, batches, ref, drain, depth: int = 3, to_host=True):
         """Pipelined dispatch: keep `depth` batches in flight so the
         host->device upload of batch i+1, the compute of batch i, and
         the device->host download of batch i-1 all overlap (the
         process_batch_async seam; backends without it run synchronously).
 
         batches yields (n_valid, frames, indices); drain receives
-        (n_valid, output dict) in order.
+        (n_valid, output dict) in order. `to_host=False` skips the
+        eager device->host copies (device-resident output pipelines).
         """
         dispatch = getattr(self.backend, "process_batch_async", None)
         inflight: list[tuple[int, dict]] = []
         for n, batch, idx in batches:
             if dispatch is not None:
-                inflight.append((n, dispatch(batch, ref, idx)))
+                # Only pass to_host when overriding its default: plugin
+                # backends implementing the original 3-arg seam keep
+                # working for the (default) host-output path.
+                out = (
+                    dispatch(batch, ref, idx, to_host=False)
+                    if not to_host
+                    else dispatch(batch, ref, idx)
+                )
+                inflight.append((n, out))
                 if len(inflight) >= depth:
                     drain(inflight.pop(0))
             else:

@@ -92,3 +92,20 @@ def test_bad_reference_index_raises():
 def test_wrong_rank_stack_raises():
     with pytest.raises(ValueError, match="stack must be"):
         MotionCorrector(model="translation").correct(np.zeros((64, 64), np.float32))
+
+
+def test_device_stack_and_device_outputs_match_host_path():
+    import jax.numpy as jnp
+
+    data = synthetic.make_drift_stack(
+        n_frames=5, shape=(96, 96), model="translation", max_drift=3.0, seed=6
+    )
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=2)
+    host = mc.correct(data.stack)
+    dev = mc.correct(jnp.asarray(data.stack), device_outputs=True)
+    assert not isinstance(dev.corrected, np.ndarray)  # stayed on device
+    np.testing.assert_allclose(np.asarray(dev.transforms), host.transforms, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dev.corrected), host.corrected, atol=1e-5)
+    np.testing.assert_array_equal(
+        np.asarray(dev.diagnostics["n_inliers"]), host.diagnostics["n_inliers"]
+    )
