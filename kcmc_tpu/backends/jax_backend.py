@@ -27,7 +27,7 @@ from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.models import get_model
 from kcmc_tpu.ops import piecewise as pw
 from kcmc_tpu.ops.describe import describe_keypoints, describe_keypoints_batch
-from kcmc_tpu.ops.detect import detect_keypoints
+from kcmc_tpu.ops.detect import detect_keypoints, detect_keypoints_batch
 from kcmc_tpu.ops.match import knn_match
 from kcmc_tpu.ops.ransac import ransac_estimate
 from kcmc_tpu.ops.warp import warp_batch_with_ok, warp_frame_flow, warp_volume
@@ -145,25 +145,27 @@ class JaxBackend:
             model = get_model(cfg.model)
             batch_warp = self._resolve_batch_warp()
 
-        def detect(frame):
-            return detect_keypoints(
-                frame,
+        def local(frames, ref_xy, ref_desc, ref_valid, indices):
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
+            # smooth (the descriptor-stage blur) rides along with the
+            # fused Pallas detection kernel's resident slab.
+            kps, smooth = detect_keypoints_batch(
+                frames,
                 max_keypoints=cfg.max_keypoints,
                 threshold=cfg.detect_threshold,
                 nms_size=cfg.nms_size,
                 border=cfg.border,
                 harris_k=cfg.harris_k,
+                use_pallas=use_pallas_patches,
+                smooth_sigma=cfg.blur_sigma,
             )
-
-        def local(frames, ref_xy, ref_desc, ref_valid, indices):
-            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
-            kps = jax.vmap(detect)(frames)
             desc = describe_keypoints_batch(
                 frames,
                 kps,
                 oriented=oriented,
                 blur_sigma=cfg.blur_sigma,
                 use_pallas=use_pallas_patches,
+                smooth=smooth,
             )
 
             def tail(frame, kp, d, key):

@@ -69,6 +69,10 @@ class CorrectorConfig:
     max_projective_px: int = 4
 
     def __post_init__(self):
+        if self.blur_sigma <= 0.0:
+            raise ValueError(
+                f"blur_sigma must be positive, got {self.blur_sigma}"
+            )
         if self.warp not in ("auto", "jnp", "pallas", "separable"):
             raise ValueError(
                 "warp must be 'auto', 'jnp', 'pallas', or 'separable', "

@@ -204,7 +204,8 @@ def describe_keypoints(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("oriented", "blur_sigma", "use_pallas", "interpret")
+    jax.jit,
+    static_argnames=("oriented", "blur_sigma", "use_pallas", "interpret"),
 )
 def describe_keypoints_batch(
     frames: jnp.ndarray,
@@ -213,6 +214,7 @@ def describe_keypoints_batch(
     blur_sigma: float = 2.0,
     use_pallas: bool = False,
     interpret: bool = False,
+    smooth: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """(B, K, N_WORDS) descriptors for a (B, H, W) batch of frames.
 
@@ -221,19 +223,28 @@ def describe_keypoints_batch(
     data-dependent dynamic_slice to a ~1 GB/s gather, which made
     extraction the single largest cost of the whole pipeline; the kernel
     does it at memory speed. kps fields carry a leading batch axis.
+
+    `smooth` optionally supplies the blur_sigma-blurred batch (e.g. the
+    fused detection kernel's free-ride output) so the blur isn't
+    recomputed here.
     """
     if not use_pallas:
-        return jax.vmap(
-            lambda f, k: describe_keypoints(
-                f, k, oriented=oriented, blur_sigma=blur_sigma
-            )
-        )(frames, kps)
+        def one(f, k, s):
+            sm = gaussian_blur(f, blur_sigma) if s is None else s
+            r = ROT_RADIUS if oriented else PATCH_RADIUS
+            raw, pb = _extract_patches(sm, k.xy, r)
+            return _describe_from_patches(raw, pb, k, oriented)
+
+        if smooth is None:
+            return jax.vmap(lambda f, k: one(f, k, None))(frames, kps)
+        return jax.vmap(one)(frames, kps, smooth)
 
     from kcmc_tpu.ops.pallas_patch import extract_patches
 
     r = ROT_RADIUS if oriented else PATCH_RADIUS
     P = 2 * r + 2
-    smooth = jax.vmap(lambda f: gaussian_blur(f, blur_sigma))(frames)
+    if smooth is None:
+        smooth = jax.vmap(lambda f: gaussian_blur(f, blur_sigma))(frames)
     padded = jnp.pad(smooth, ((0, 0), (r + 1, r + 1), (r + 1, r + 1)), mode="edge")
     oy = jnp.floor(kps.xy[..., 1]).astype(jnp.int32) + 1
     ox = jnp.floor(kps.xy[..., 0]).astype(jnp.int32) + 1

@@ -45,12 +45,21 @@ class Keypoints(NamedTuple):
 
 
 def _conv2d(img: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
-    """Same-padding 2D convolution of a (H, W) image with a small kernel."""
+    """Same-padding 2D convolution of a (H, W) image with a small kernel.
+
+    Precision.HIGHEST: the default conv precision truncates f32 inputs
+    (~bf16 — measured 0.4% relative response error vs a float64 oracle,
+    on both the TPU and CPU backends), which is enough to flip NMS
+    comparisons between near-equal corner responses. The fused Pallas
+    detection kernel (ops/pallas_detect.py) computes the same math in
+    true f32; the two paths agree only with exact convs here.
+    """
     out = lax.conv_general_dilated(
         img[None, None, :, :],
         kernel[None, None, :, :],
         window_strides=(1, 1),
         padding="SAME",
+        precision=lax.Precision.HIGHEST,
     )
     return out[0, 0]
 
@@ -127,35 +136,31 @@ def _subpixel_fields(resp: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return jnp.clip(ox, -0.5, 0.5), jnp.clip(oy, -0.5, 0.5)
 
 
-@functools.partial(jax.jit, static_argnames=("max_keypoints", "nms_size", "border"))
-def detect_keypoints(
-    img: jnp.ndarray,
-    max_keypoints: int = 512,
-    threshold: float = 1e-6,
-    nms_size: int = 5,
-    border: int = 16,
-    harris_k: float = 0.04,
+def _select_keypoints(
+    nms_resp: jnp.ndarray,
+    ox_f: jnp.ndarray,
+    oy_f: jnp.ndarray,
+    max_keypoints: int,
+    threshold: float,
+    border: int,
 ) -> Keypoints:
-    """Detect up to `max_keypoints` Harris corners in a (H, W) frame.
+    """Fixed-K keypoint selection from dense detection fields.
 
-    Returns fixed-K arrays; `valid[i]` is False for slots whose response
-    fell at/below `threshold` (relative to the frame's peak response).
-    Dense corner clusters are thinned to at most one keypoint per
-    CAND_TILE x CAND_TILE tile (in addition to `nms_size` suppression) —
-    the candidate-reduction grid both backends share.
+    nms_resp holds the Harris response at NMS local maxima and -inf
+    elsewhere; ox_f/oy_f are the dense subpixel offset fields. Shared by
+    the jnp path (`detect_keypoints`) and the fused Pallas path
+    (ops/pallas_detect.py), which produce the same field triple.
     """
-    H, W = img.shape
-    resp = harris_response(img, k=harris_k)
-    # NMS: keep strict local maxima of the response.
-    is_max = resp >= _maxpool_same(resp, nms_size)
+    H, W = nms_resp.shape
     # Exclude a border so descriptor patches stay in bounds.
     ys = jnp.arange(H)[:, None]
     xs = jnp.arange(W)[None, :]
     inb = (ys >= border) & (ys < H - border) & (xs >= border) & (xs < W - border)
     # Threshold is relative to the frame's max response: robust to
-    # global contrast changes across frames.
-    peak = jnp.maximum(jnp.max(resp), 1e-12)
-    masked = jnp.where(is_max & inb & (resp > threshold * peak), resp, -jnp.inf)
+    # global contrast changes across frames. (The global max of the
+    # response is itself an NMS local max, so max(nms_resp) == max(resp).)
+    peak = jnp.maximum(jnp.max(nms_resp), 1e-12)
+    masked = jnp.where(inb & (nms_resp > threshold * peak), nms_resp, -jnp.inf)
 
     # Candidate reduction: strongest surviving pixel per TILE x TILE tile
     # (reshape + argmax — no gathers), then an exact top-k over the tile
@@ -184,7 +189,6 @@ def detect_keypoints(
     valid = jnp.isfinite(scores)
 
     # Subpixel: sample the dense quadratic-fit offset fields at the peaks.
-    ox_f, oy_f = _subpixel_fields(resp)
     flat = jnp.clip(iy, 0, H - 1) * W + jnp.clip(ix, 0, W - 1)
     offsets = jnp.stack(
         [ox_f.reshape(-1)[flat], oy_f.reshape(-1)[flat]], axis=-1
@@ -195,3 +199,91 @@ def detect_keypoints(
     scores = jnp.where(valid, scores, 0.0)
     xy = jnp.where(valid[:, None], xy, 0.0)
     return Keypoints(xy=xy, score=scores, valid=valid)
+
+
+@functools.partial(jax.jit, static_argnames=("max_keypoints", "nms_size", "border"))
+def detect_keypoints(
+    img: jnp.ndarray,
+    max_keypoints: int = 512,
+    threshold: float = 1e-6,
+    nms_size: int = 5,
+    border: int = 16,
+    harris_k: float = 0.04,
+) -> Keypoints:
+    """Detect up to `max_keypoints` Harris corners in a (H, W) frame.
+
+    Returns fixed-K arrays; `valid[i]` is False for slots whose response
+    fell at/below `threshold` (relative to the frame's peak response).
+    Dense corner clusters are thinned to at most one keypoint per
+    CAND_TILE x CAND_TILE tile (in addition to `nms_size` suppression) —
+    the candidate-reduction grid both backends share.
+    """
+    resp = harris_response(img, k=harris_k)
+    # NMS: keep strict local maxima of the response.
+    is_max = resp >= _maxpool_same(resp, nms_size)
+    nms_resp = jnp.where(is_max, resp, -jnp.inf)
+    ox_f, oy_f = _subpixel_fields(resp)
+    return _select_keypoints(
+        nms_resp, ox_f, oy_f, max_keypoints, threshold, border
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_keypoints", "threshold", "nms_size", "border", "harris_k",
+        "use_pallas", "smooth_sigma", "interpret",
+    ),
+)
+def detect_keypoints_batch(
+    frames: jnp.ndarray,
+    max_keypoints: int = 512,
+    threshold: float = 1e-6,
+    nms_size: int = 5,
+    border: int = 16,
+    harris_k: float = 0.04,
+    use_pallas: bool = False,
+    smooth_sigma: float | None = None,
+    interpret: bool = False,
+):
+    """Detect keypoints over a (B, H, W) batch; fields carry a batch axis.
+
+    With `use_pallas` (and a frame size the whole-frame kernel supports)
+    the dense detection fields come from the fused Pallas kernel
+    (ops/pallas_detect.py) — one VMEM-resident pass instead of ~12
+    HBM-round-tripping conv/reduce passes; selection stays in XLA.
+
+    With `smooth_sigma` returns (keypoints, smooth) where smooth is the
+    sigma-blurred batch for the descriptor stage (`gaussian_blur`
+    semantics) — a free ride on the fused kernel's resident slab when
+    the Pallas path runs, two separate conv passes otherwise.
+    """
+    B, H, W = frames.shape
+    if use_pallas:
+        from kcmc_tpu.ops.pallas_detect import response_fields, supports
+
+        if supports((H, W), nms_size, 1.5, smooth_sigma):
+            out = response_fields(
+                frames, harris_k=harris_k, nms_size=nms_size,
+                smooth_sigma=smooth_sigma, interpret=interpret,
+            )
+            kps = jax.vmap(
+                lambda nr, ox, oy: _select_keypoints(
+                    nr, ox, oy, max_keypoints, threshold, border
+                )
+            )(*out[:3])
+            return (kps, out[3]) if smooth_sigma is not None else kps
+    kps = jax.vmap(
+        lambda f: detect_keypoints(
+            f,
+            max_keypoints=max_keypoints,
+            threshold=threshold,
+            nms_size=nms_size,
+            border=border,
+            harris_k=harris_k,
+        )
+    )(frames)
+    if smooth_sigma is not None:
+        smooth = jax.vmap(lambda f: gaussian_blur(f, smooth_sigma))(frames)
+        return kps, smooth
+    return kps

@@ -1,0 +1,303 @@
+"""Pallas TPU kernel: fused Harris response + NMS + subpixel fields.
+
+The jnp detection path (ops/detect.py) is ~12 separate 1D convolution /
+reduce_window passes, each round-tripping the (B, H, W) batch through
+HBM — measured ~15 ms of the ~20 ms detect stage on a 64-frame 512x512
+batch, making detection ~2/3 of the whole translation pipeline. This
+kernel computes the entire dense part of detection — Sobel gradients,
+structure tensor, Gaussian windowing, Harris response, separable NMS,
+and the quadratic-fit subpixel offset fields — in ONE fused pass with
+every intermediate resident in VMEM.
+
+Memory structure (the part that took iteration to get right): a
+whole-frame program does NOT fit — Mosaic stack-allocates ~25 live
+frame-sized f32 temporaries (~34 MB at 512x512) against ~16 MB of
+physical VMEM. So the grid is (batch, row-strips): each program
+computes one `_STRIP`-row output band from a (strip + 2*halo)-row
+extended slab, shrinking every buffer ~8x. The slab is assembled from
+three adjacent input strip blocks (prev/cur/next) of a frame that is
+host-padded with one full zero strip above and below — boundary strips
+then read genuine zeros with no special cases. Convolutions accumulate
+tap-by-tap into explicit VMEM scratch refs, bounding live temporaries.
+
+Semantics notes:
+
+* All convolutions are correlation-form shift-and-add chains over
+  statically shifted views. Shifts use `pltpu.roll` with non-negative
+  amounts (Mosaic mis-wraps negative dynamic amounts; static negative
+  shifts are `(-d) % dim`).
+* Zero-padding matches the XLA path's SAME convolutions exactly: the
+  real-frame region is re-masked between stages so lane-dim roll
+  wrap-around and out-of-frame rows pull only zeros; the NMS max-pool
+  compares against -inf outside the frame (reduce_window's SAME
+  padding). The subpixel fields use a zero-extended response, which
+  differs from the jnp path's edge-replicated padding only on the
+  1-pixel frame boundary — excluded by the detector's `border` margin
+  (>= conv halo) before any keypoint can reference it.
+* Rows of the slab within `halo` of its top/bottom hold partially
+  convolved garbage; the output band [halo, halo+STRIP) never reads
+  them (NMS reach + subpixel reach < halo by construction).
+* Outputs are the same (nms_resp, ox_field, oy_field) triple the jnp
+  path produces; keypoint selection (threshold, tile bucketing, top-k)
+  stays in XLA where it is cheap (ops/detect.py::_select_keypoints).
+
+Counterpart of the reference `KeypointExtractor` detect stage
+(SURVEY.md §2 — reference source unavailable; contract from
+BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_STRIP = 64  # output rows per program
+_HALO = 16  # slab margin; must be >= conv+nms+subpixel reach (10) and 8-aligned
+
+# Sobel taps in correlation form (the XLA path uses conv — flipped —
+# semantics, so the antisymmetric difference taps are reversed here;
+# smoothing taps are symmetric).
+_SM = (0.25, 0.5, 0.25)
+_DF = (0.5, 0.0, -0.5)
+
+
+def supports(
+    shape: tuple[int, int],
+    nms_size: int = 5,
+    window_sigma: float = 1.5,
+    smooth_sigma: float | None = None,
+) -> bool:
+    """Whether the strip kernel can run this configuration.
+
+    Two gates, both of which the caller must respect by falling back to
+    the jnp path: (a) VMEM — the per-lane budget of six (96, Wp)
+    scratch slabs plus double-buffered in/out strips is ~6 KB, so Wp
+    beyond ~2048 lanes overflows ~16 MB of physical VMEM at compile
+    time; (b) halo — the conv + NMS + subpixel (and optional smooth)
+    reach must fit the slab's `_HALO` margin.
+    """
+    Wp = -(-max(shape[1] + _HALO, 128) // 128) * 128
+    if Wp > 2048:
+        return False
+    blur_r = max(1, int(3.0 * window_sigma + 0.5))
+    reach = 2 + blur_r + nms_size // 2 + 1
+    if smooth_sigma is not None:
+        if smooth_sigma <= 0.0:
+            return False
+        reach = max(reach, max(1, int(3.0 * smooth_sigma + 0.5)))
+    return reach <= _HALO
+
+
+def _roll(a, dy: int, dx: int):
+    """Statically shifted view: _roll(a, dy, dx)[i, j] = a[i+dy, j+dx],
+    with wrap-around — callers guarantee the wrap region holds the
+    values SAME padding would supply (zeros / -inf via masking)."""
+    Hs, Wp = a.shape
+    if dy:
+        a = pltpu.roll(a, (-dy) % Hs, 0)
+    if dx:
+        a = pltpu.roll(a, (-dx) % Wp, 1)
+    return a
+
+
+def _acc_corr(dst_ref, src_ref, taps, axis: int):
+    """dst <- correlation of src with `taps` along `axis`, accumulated
+    tap-by-tap in place (bounds the live temporaries to one roll)."""
+    r = len(taps) // 2
+    for i, w in enumerate(taps):
+        d = i - r
+        term = w * _roll(src_ref[:, :], d if axis == 0 else 0, d if axis == 1 else 0)
+        if i == 0:
+            dst_ref[:, :] = term
+        else:
+            dst_ref[:, :] = dst_ref[:, :] + term
+
+
+def _detect_kernel(
+    prev_ref, cur_ref, next_ref,
+    nms_ref, ox_ref, oy_ref,
+    f_ref, a_ref, b_ref, c_ref, d_ref, e_ref,
+    *, H: int, W: int, harris_k: float, nms_size: int,
+    gauss: tuple[float, ...],
+    smooth: tuple[float, ...] = (),
+    smooth_ref=None,
+):
+    s = pl.program_id(1)
+    S, h = _STRIP, _HALO
+    # Assemble the extended slab: rows [s*S - h, s*S + S + h) of the
+    # frame, in frame coordinates (the padded input offsets by one full
+    # zero strip, so strip j of the input holds frame rows [j*S - S, ...)).
+    f_ref[0:h, :] = prev_ref[S - h :, :]
+    f_ref[h : h + S, :] = cur_ref[:, :]
+    f_ref[h + S :, :] = next_ref[0:h, :]
+
+    shape = f_ref.shape
+    rows = lax.broadcasted_iota(jnp.int32, shape, 0) + (s * S - h)
+    cols = lax.broadcasted_iota(jnp.int32, shape, 1)
+    real = (rows >= 0) & (rows < H) & (cols < W)
+    realf = real.astype(jnp.float32)
+
+    # Free-ride output: the descriptor-stage Gaussian blur of the frame
+    # (ops/describe.py needs it; the slab is already resident, so the
+    # two 1D passes here replace two full HBM-round-trip convolutions).
+    if smooth_ref is not None:
+        _acc_corr(a_ref, f_ref, smooth, 0)
+        _acc_corr(b_ref, a_ref, smooth, 1)
+        smooth_ref[:, :] = b_ref[h : h + S, :W]
+
+    # Gradients: smooth along one axis, difference along the other.
+    _acc_corr(a_ref, f_ref, _SM, 0)
+    _acc_corr(b_ref, a_ref, _DF, 1)  # gx
+    _acc_corr(a_ref, f_ref, _SM, 1)
+    _acc_corr(c_ref, a_ref, _DF, 0)  # gy
+    # Re-mask: the pad ring picked up conv spill; the window sums below
+    # must pull zeros there (SAME semantics).
+    b_ref[:, :] = b_ref[:, :] * realf
+    c_ref[:, :] = c_ref[:, :] * realf
+    # Structure tensor under the Gaussian window.
+    a_ref[:, :] = b_ref[:, :] * b_ref[:, :]
+    _acc_corr(e_ref, a_ref, gauss, 0)
+    _acc_corr(d_ref, e_ref, gauss, 1)  # ixx
+    a_ref[:, :] = b_ref[:, :] * c_ref[:, :]
+    _acc_corr(e_ref, a_ref, gauss, 0)
+    _acc_corr(b_ref, e_ref, gauss, 1)  # ixy (gx dead)
+    a_ref[:, :] = c_ref[:, :] * c_ref[:, :]
+    _acc_corr(e_ref, a_ref, gauss, 0)
+    _acc_corr(c_ref, e_ref, gauss, 1)  # iyy (gy dead)
+    det = d_ref[:, :] * c_ref[:, :] - b_ref[:, :] * b_ref[:, :]
+    tr = d_ref[:, :] + c_ref[:, :]
+    a_ref[:, :] = det - harris_k * tr * tr  # resp
+
+    # NMS: separable max-pool, -inf outside the frame (SAME padding).
+    lo, hi = -((nms_size - 1) // 2), nms_size // 2
+    b_ref[:, :] = jnp.where(real, a_ref[:, :], -jnp.inf)  # neg
+    c_ref[:, :] = b_ref[:, :]
+    for d in range(lo, hi + 1):
+        if d:
+            c_ref[:, :] = jnp.maximum(c_ref[:, :], _roll(b_ref[:, :], d, 0))
+    d_ref[:, :] = c_ref[:, :]
+    for d in range(lo, hi + 1):
+        if d:
+            d_ref[:, :] = jnp.maximum(d_ref[:, :], _roll(c_ref[:, :], 0, d))
+    neg = b_ref[:, :]
+    nms = jnp.where(neg >= d_ref[:, :], neg, -jnp.inf)
+    nms_ref[:, :] = nms[h : h + S, :W]
+
+    # Subpixel quadratic fits from the zero-extended response
+    # (interior-identical to the jnp path's edge padding).
+    c_ref[:, :] = a_ref[:, :] * realf  # rc
+    rc = c_ref[:, :]
+    right = _roll(rc, 0, 1)
+    left = _roll(rc, 0, -1)
+    dx = 0.5 * (right - left)
+    dxx = right - 2.0 * rc + left
+    ox = jnp.where(jnp.abs(dxx) > 1e-8, -dx / dxx, 0.0)
+    ox_ref[:, :] = jnp.clip(ox, -0.5, 0.5)[h : h + S, :W]
+    down = _roll(rc, 1, 0)
+    up = _roll(rc, -1, 0)
+    dy = 0.5 * (down - up)
+    dyy = down - 2.0 * rc + up
+    oy = jnp.where(jnp.abs(dyy) > 1e-8, -dy / dyy, 0.0)
+    oy_ref[:, :] = jnp.clip(oy, -0.5, 0.5)[h : h + S, :W]
+
+
+def _gauss_taps(sigma: float) -> tuple[float, ...]:
+    # Host-side numpy mirror of detect._gaussian_kernel1d (f32 math);
+    # can't call the jnp version under jit — it would trace.
+    r = max(1, int(3.0 * sigma + 0.5))
+    xs = np.arange(-r, r + 1, dtype=np.float32)
+    g = np.exp(np.float32(-0.5) * (xs / np.float32(sigma)) ** 2)
+    return tuple(float(v) for v in (g / g.sum()).astype(np.float32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "harris_k", "nms_size", "window_sigma", "smooth_sigma", "interpret"
+    ),
+)
+def response_fields(
+    frames: jnp.ndarray,
+    harris_k: float = 0.04,
+    nms_size: int = 5,
+    window_sigma: float = 1.5,
+    smooth_sigma: float | None = None,
+    interpret: bool = False,
+):
+    """Fused dense detection fields for a (B, H, W) batch.
+
+    Returns (nms_resp, ox_field, oy_field), each (B, H, W) f32:
+    nms_resp holds the Harris response at local NMS maxima and -inf
+    elsewhere; ox/oy are the clipped quadratic-fit subpixel offsets.
+    Matches the jnp path (`harris_response` + `_maxpool_same` +
+    `_subpixel_fields`) up to float summation order everywhere a
+    keypoint can legally land (interior pixels).
+
+    With `smooth_sigma` a fourth array is returned: the sigma-blurred
+    frame (SAME zero padding — identical semantics to
+    `detect.gaussian_blur`), computed as a free ride on the resident
+    slab for the descriptor stage.
+    """
+    B, H, W = frames.shape
+    if not supports((H, W), nms_size, window_sigma, smooth_sigma):
+        raise ValueError(
+            f"shape={H}x{W}/window_sigma={window_sigma}/nms_size={nms_size}/"
+            f"smooth_sigma={smooth_sigma} exceed the kernel's VMEM or halo "
+            f"budget ({_HALO}); use the jnp detection path (callers gate "
+            "on pallas_detect.supports)"
+        )
+    gauss = _gauss_taps(window_sigma)
+
+    S, h = _STRIP, _HALO
+    n_out = -(-H // S)
+    # One full zero strip above, content rows padded up to a strip
+    # multiple below plus one more zero strip: strip j of the padded
+    # array holds frame rows [(j-1)*S, j*S), so a program for output
+    # strip s reads input strips (s, s+1, s+2) as prev/cur/next.
+    Wp = -(-max(W + h, 128) // 128) * 128
+    padded = jnp.pad(
+        frames.astype(jnp.float32),
+        ((0, 0), (S, (n_out + 1) * S - H), (0, Wp - W)),
+    )
+    n_in = n_out + 2
+    assert padded.shape[1] == n_in * S
+
+    n_outputs = 3 if smooth_sigma is None else 4
+
+    def kernel(*refs):
+        ins, outs = refs[:3], refs[3 : 3 + n_outputs]
+        scratch = refs[3 + n_outputs :]
+        _detect_kernel(
+            *ins, *outs[:3], *scratch,
+            H=H, W=W, harris_k=harris_k, nms_size=nms_size, gauss=gauss,
+            smooth=_gauss_taps(smooth_sigma) if smooth_sigma is not None else (),
+            smooth_ref=outs[3] if smooth_sigma is not None else None,
+        )
+
+    strip_in = lambda off: pl.BlockSpec(
+        (None, S, Wp), lambda b, s, o=off: (b, s + o, 0)
+    )
+    scratch = [pltpu.VMEM((S + 2 * h, Wp), jnp.float32) for _ in range(6)]
+    out_specs = [
+        pl.BlockSpec((None, S, W), lambda b, s: (b, s, 0))
+        for _ in range(n_outputs)
+    ]
+    # Ragged H: out_shape rows are rounded up to the strip size and
+    # sliced after (the padded tail computes from genuine zeros).
+    Ho = n_out * S
+    outs = pl.pallas_call(
+        kernel,
+        grid=(B, n_out),
+        in_specs=[strip_in(0), strip_in(1), strip_in(2)],
+        out_specs=out_specs,
+        out_shape=[jax.ShapeDtypeStruct((B, Ho, W), jnp.float32)] * n_outputs,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(padded, padded, padded)
+    return tuple(o[:, :H] for o in outs)

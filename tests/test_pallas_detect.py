@@ -1,0 +1,147 @@
+"""Fused Pallas detection kernel vs the jnp conv path (interpret mode
+on CPU): dense field parity, keypoint-level parity through the shared
+selection stage, the free-ride smooth output, and ragged frame sizes."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kcmc_tpu.ops.detect import (
+    _maxpool_same,
+    _subpixel_fields,
+    detect_keypoints_batch,
+    gaussian_blur,
+    harris_response,
+)
+from kcmc_tpu.ops.pallas_detect import response_fields
+from kcmc_tpu.utils import synthetic
+
+
+def _frames(shape, n=2, seed=3):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(
+        np.stack(
+            [synthetic.render_scene(rng, shape, n_blobs=80) for _ in range(n)]
+        ).astype(np.float32)
+    )
+
+
+@pytest.mark.parametrize("shape", [(128, 128), (96, 160), (100, 84)])
+def test_dense_fields_match_jnp_path(shape):
+    frames = _frames(shape)
+    nms_p, ox_p, oy_p = jax.tree.map(
+        np.asarray, response_fields(frames, interpret=True)
+    )
+    resp = np.asarray(jax.vmap(harris_response)(frames))
+    mp = np.asarray(jax.vmap(lambda r: _maxpool_same(r, 5))(resp))
+    nms_j = np.where(resp >= mp, resp, -np.inf)
+    ox_j, oy_j = jax.vmap(_subpixel_fields)(jnp.asarray(resp))
+
+    # Interior: the kernel's zero-extended boundary handling differs
+    # from the jnp path only on the 1-px frame edge (border-excluded).
+    interior = np.s_[:, 2:-2, 2:-2]
+    scale = np.abs(resp).max()
+    fin_p = np.isfinite(nms_p[interior])
+    fin_j = np.isfinite(nms_j[interior])
+    np.testing.assert_array_equal(fin_p, fin_j)
+    both = fin_p & fin_j
+    assert (
+        np.abs(nms_p[interior][both] - nms_j[interior][both]).max()
+        <= 1e-5 * scale
+    )
+    np.testing.assert_allclose(
+        ox_p[interior], np.asarray(ox_j)[interior], atol=1e-3
+    )
+    np.testing.assert_allclose(
+        oy_p[interior], np.asarray(oy_j)[interior], atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("shape", [(128, 128), (150, 108)])
+def test_keypoints_match_jnp_path(shape):
+    frames = _frames(shape)
+    kw = dict(
+        max_keypoints=128, threshold=1e-4, nms_size=5, border=16,
+        harris_k=0.04,
+    )
+    kj = detect_keypoints_batch(frames, **kw, use_pallas=False)
+    kp = detect_keypoints_batch(frames, **kw, use_pallas=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(kj.valid), np.asarray(kp.valid))
+    both = np.asarray(kj.valid & kp.valid)
+    assert np.abs(np.asarray(kj.xy) - np.asarray(kp.xy))[both].max() < 1e-3
+
+
+def test_smooth_output_matches_gaussian_blur():
+    frames = _frames((128, 128))
+    _, smooth = detect_keypoints_batch(
+        frames, max_keypoints=64, use_pallas=True, smooth_sigma=2.0,
+        interpret=True,
+    )
+    ref = jax.vmap(lambda f: gaussian_blur(f, 2.0))(frames)
+    np.testing.assert_allclose(
+        np.asarray(smooth), np.asarray(ref), atol=1e-5
+    )
+
+
+def test_smooth_output_jnp_fallback():
+    """The smooth ride-along also works on the non-Pallas route."""
+    frames = _frames((96, 96))
+    kps_a, smooth = detect_keypoints_batch(
+        frames, max_keypoints=64, use_pallas=False, smooth_sigma=2.0
+    )
+    kps_b = detect_keypoints_batch(frames, max_keypoints=64, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(kps_a.xy), np.asarray(kps_b.xy))
+    ref = jax.vmap(lambda f: gaussian_blur(f, 2.0))(frames)
+    np.testing.assert_allclose(np.asarray(smooth), np.asarray(ref), atol=1e-6)
+
+
+def test_unsupported_configs_fall_back_to_jnp():
+    """Configs beyond the kernel's halo/VMEM budget must take the jnp
+    route (same results as use_pallas=False), not raise."""
+    frames = _frames((96, 96))
+    kw = dict(max_keypoints=64, threshold=1e-4, border=16, harris_k=0.04)
+    # nms_size=19: reach 2+5+9+1 = 17 > halo 16.
+    a = detect_keypoints_batch(frames, **kw, nms_size=19, use_pallas=True)
+    b = detect_keypoints_batch(frames, **kw, nms_size=19, use_pallas=False)
+    np.testing.assert_array_equal(np.asarray(a.xy), np.asarray(b.xy))
+    # smooth_sigma beyond the halo likewise falls back.
+    a2, s2 = detect_keypoints_batch(
+        frames, **kw, nms_size=5, use_pallas=True, smooth_sigma=6.0
+    )
+    ref = jax.vmap(lambda f: gaussian_blur(f, 6.0))(frames)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(ref), atol=1e-6)
+
+
+def test_wide_frames_rejected_by_supports():
+    from kcmc_tpu.ops.pallas_detect import supports
+
+    assert supports((512, 512))
+    assert not supports((8, 8192))  # scratch slabs would overflow VMEM
+    assert not supports((512, 512), nms_size=19)  # halo
+    assert not supports((512, 512), smooth_sigma=0.0)  # degenerate blur
+
+
+def test_describe_accepts_precomputed_smooth():
+    """Threading detect's smooth into describe changes nothing."""
+    from kcmc_tpu.ops.describe import describe_keypoints_batch
+
+    frames = _frames((128, 128))
+    kps, smooth = detect_keypoints_batch(
+        frames, max_keypoints=64, use_pallas=True, smooth_sigma=2.0,
+        interpret=True,
+    )
+    a = describe_keypoints_batch(
+        frames, kps, oriented=False, use_pallas=True, interpret=True,
+        smooth=smooth,
+    )
+    b = describe_keypoints_batch(
+        frames, kps, oriented=False, use_pallas=True, interpret=True
+    )
+    # smooth from the fused kernel differs from gaussian_blur by float
+    # summation order only; descriptor bits compare blurred values with
+    # a strict <, so equal bits everywhere except exact ties.
+    bits = 32 * a.shape[-1] * a.shape[0] * a.shape[1]
+    diff = np.bitwise_count(np.asarray(a) ^ np.asarray(b)).sum()
+    assert diff <= bits * 1e-3
