@@ -62,6 +62,7 @@ def _cmd_correct(args) -> int:
         compression=args.compression,
         progress=args.progress,
         n_threads=args.io_threads,
+        output_dtype=args.output_dtype,
     )
 
     if args.transforms:
@@ -121,6 +122,11 @@ def main(argv=None) -> int:
     p.add_argument("--compression", default="none",
                    choices=["none", "deflate", "packbits"])
     p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument(
+        "--output-dtype", default="input",
+        help="corrected-frame dtype: 'input' (match source, default), "
+        "'float32', or any NumPy dtype (integer targets round + clip)",
+    )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
 

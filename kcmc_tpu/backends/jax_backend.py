@@ -263,6 +263,34 @@ class JaxBackend:
 
         return local
 
+    def rescue_warp(self, frames, out: dict) -> np.ndarray:
+        """Exact unbounded resample for frames a bounded gather-free
+        kernel flagged (`warp_ok` False): the consensus transform/field
+        is correct far beyond the warp kernels' static motion bounds
+        (the KNN matcher is global), so the rare out-of-bound frame is
+        re-warped through the XLA gather path instead of being zeroed.
+
+        frames: (n, H, W) or (n, D, H, W); out: the per-frame outputs
+        (already host/NumPy, sliced to the same n frames). Returns the
+        corrected frames.
+        """
+        cfg = self.config
+        frames = jnp.asarray(frames, jnp.float32)
+        if cfg.model == "piecewise":
+            from kcmc_tpu.ops.piecewise import upsample_field
+
+            shape = tuple(frames.shape[1:])
+            flows = jax.vmap(lambda f: upsample_field(f, shape))(
+                jnp.asarray(out["field"], jnp.float32)
+            )
+            return np.asarray(jax.vmap(warp_frame_flow)(frames, flows))
+        transforms = jnp.asarray(out["transform"], jnp.float32)
+        if frames.ndim == 4:
+            return np.asarray(jax.vmap(warp_volume)(frames, transforms))
+        from kcmc_tpu.ops.warp import warp_frame
+
+        return np.asarray(jax.vmap(warp_frame)(frames, transforms))
+
     @staticmethod
     def _on_accelerator() -> bool:
         # Where the gather-free kernels pay off (and, for Pallas, lower

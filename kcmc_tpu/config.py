@@ -57,6 +57,12 @@ class CorrectorConfig:
     # max_*_px bounds below are zeroed and flagged in the per-frame
     # `warp_ok` diagnostic instead of being silently mis-resampled.
     warp: str = "auto"
+    # Exact-warp rescue: frames whose motion exceeded a gather-free
+    # kernel's static bound (warp_ok False) are re-resampled on the host
+    # path with the unbounded XLA gather warp — rare frames pay the slow
+    # exact path, the batch stays on the fast one. Disable to keep the
+    # zero-and-flag behavior.
+    rescue_warp: bool = True
     # Static bound on the separable warp's shear magnitude, pixels
     # (covers ~|tan(rotation)| * frame_side/2; 8 px ~ 1.8 deg at 512 —
     # raise it for larger rotations at a linear cost in the shear pass).

@@ -21,6 +21,21 @@ from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.utils.metrics import StageTimer
 
 
+def _cast_output(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    """Cast resampled float32 frames to the requested output dtype.
+
+    Integer targets (microscopy uint16 etc.) are rounded and clipped to
+    the dtype's representable range — bilinear blends can land a hair
+    outside the input range at warp boundaries.
+    """
+    if arr.dtype == dtype:
+        return arr
+    if np.issubdtype(dtype, np.integer):
+        info = np.iinfo(dtype)
+        return np.clip(np.rint(arr), info.min, info.max).astype(dtype)
+    return np.asarray(arr, dtype)
+
+
 @dataclasses.dataclass
 class CorrectionResult:
     """Output of MotionCorrector.correct."""
@@ -52,6 +67,14 @@ class MotionCorrector:
         Reference frame selector: an int frame index, "first", "mean"
         (mean of the first `reference_window` frames), or an explicit
         2D/3D array.
+    template_iters:
+        Iterative template refinement (0 = off). Each iteration
+        registers the first `template_window` frames to the current
+        reference, then replaces the reference with the mean of the
+        successfully corrected frames — sqrt(window)-fold less noise
+        than any single frame, so registration against it is more
+        accurate on low-SNR stacks. Standard practice in microscopy
+        motion correction.
     config / **overrides:
         A full CorrectorConfig, or keyword overrides applied on top of
         the defaults (e.g. `MotionCorrector(model="affine", n_hypotheses=256)`).
@@ -64,6 +87,8 @@ class MotionCorrector:
         reference: int | str | np.ndarray = 0,
         config: CorrectorConfig | None = None,
         reference_window: int = 16,
+        template_iters: int = 0,
+        template_window: int | None = None,
         mesh=None,
         **overrides,
     ):
@@ -74,6 +99,12 @@ class MotionCorrector:
         self.backend = get_backend(backend, self.config, **options)
         self.reference = reference
         self.reference_window = reference_window
+        self.template_iters = template_iters
+        self.template_window = (
+            template_window
+            if template_window is not None
+            else max(reference_window, 32)
+        )
 
     # ------------------------------------------------------------------
 
@@ -97,6 +128,38 @@ class MotionCorrector:
             return np.asarray(stack[idx], np.float32)
         raise ValueError(f"bad reference selector: {ref!r}")
 
+    def _refine_reference(self, stack, ref_frame: np.ndarray) -> np.ndarray:
+        """Iterative template refinement (`template_iters` rounds).
+
+        Registers the first `template_window` frames against the current
+        reference and replaces it with the mean of the successfully
+        corrected frames (frames a bounded warp kernel flagged via
+        `warp_ok` are excluded).
+        """
+        W = min(len(stack), self.template_window)
+        B = self.config.batch_size
+        sub = stack[:W]
+        for _ in range(self.template_iters):
+            ref = self.backend.prepare_reference(ref_frame)
+            corrected, ok = [], []
+            for lo in range(0, W, B):
+                hi = min(lo + B, W)
+                n, batch, idx = self._pad_batch(
+                    sub[lo:hi], np.arange(lo, hi), B
+                )
+                out = self.backend.process_batch(batch, ref, idx)
+                corrected.append(out["corrected"][:n])
+                ok.append(
+                    np.asarray(
+                        out.get("warp_ok", np.ones(n, bool))[:n], bool
+                    )
+                )
+            frames = np.concatenate(corrected)[np.concatenate(ok)]
+            if len(frames) == 0:  # every warp out of bounds: keep the ref
+                break
+            ref_frame = np.mean(frames, axis=0, dtype=np.float32)
+        return ref_frame
+
     def correct(
         self,
         stack: np.ndarray,
@@ -104,14 +167,25 @@ class MotionCorrector:
         end_frame: int | None = None,
         progress: bool = False,
         device_outputs: bool = False,
+        output_dtype: str | np.dtype = "float32",
     ) -> CorrectionResult:
         """Correct a (T, H, W) or (T, D, H, W) stack.
 
         `stack` may be a NumPy array (host-fed; uploads overlap compute)
         or a jax.Array already resident on the accelerator — device
         stacks are sliced on-device, never round-tripped through the
-        host. With `device_outputs` the result arrays stay on device
-        (jax.Arrays), for pipelines that keep post-processing on-chip.
+        host. Integer stacks (uint8/uint16/int16 microscopy data) are
+        accepted as-is: registration runs in float32 internally (the
+        detection threshold is contrast-relative, so the raw scale is
+        immaterial). With `device_outputs` the result arrays stay on
+        device (jax.Arrays), for pipelines that keep post-processing
+        on-chip.
+
+        `output_dtype` controls the dtype of `corrected`: "float32"
+        (default, the raw resampled values), "input" (restore the input
+        stack's dtype — integer targets are rounded and clipped to the
+        dtype's range), or any NumPy dtype. Ignored with
+        `device_outputs` (on-device results stay float32).
 
         `start_frame`/`end_frame` bound the processed range while keeping
         *global* frame indices (RANSAC keys fold in the global index, so
@@ -140,6 +214,10 @@ class MotionCorrector:
             # _select_reference works for device stacks too: its branches
             # slice first, so only the needed frames transfer to host.
             ref_frame = self._select_reference(stack)
+        if self.template_iters > 0:
+            with timer.stage("refine_template"):
+                ref_frame = self._refine_reference(stack, ref_frame)
+        with timer.stage("prepare_reference"):
             ref = self.backend.prepare_reference(ref_frame)
 
         B = cfg.batch_size
@@ -151,10 +229,14 @@ class MotionCorrector:
         else:
             xp = np
         convert = (lambda v: v) if device_outputs else np.asarray
+        do_rescue = cfg.rescue_warp and not device_outputs
 
         def drain(entry):
-            n, out = entry
-            outs.append({k: convert(v)[:n] for k, v in out.items()})
+            n, out, batch = entry
+            host = {k: convert(v)[:n] for k, v in out.items()}
+            if do_rescue:
+                self._rescue_flagged(host, batch, n)
+            outs.append(host)
 
         def batches():
             for lo in range(start_frame, T, B):
@@ -180,6 +262,10 @@ class MotionCorrector:
             k: cat([o[k] for o in outs]) for k in outs[0]
         } if outs else {}
         corrected = merged.pop("corrected", empty)
+        if not device_outputs:
+            corrected = _cast_output(
+                corrected, self._resolve_output_dtype(output_dtype, stack.dtype)
+            )
         transforms = merged.pop("transform", None)
         fields = merged.pop("field", None)
         return CorrectionResult(
@@ -189,6 +275,12 @@ class MotionCorrector:
             diagnostics=merged,
             timing=timer.report(n_frames=len(indices)),
         )
+
+    @staticmethod
+    def _resolve_output_dtype(output_dtype, input_dtype) -> np.dtype:
+        if isinstance(output_dtype, str) and output_dtype == "input":
+            return np.dtype(input_dtype)
+        return np.dtype(output_dtype)
 
     @staticmethod
     def _pad_batch(batch, idx, B, xp=np):
@@ -209,11 +301,12 @@ class MotionCorrector:
         process_batch_async seam; backends without it run synchronously).
 
         batches yields (n_valid, frames, indices); drain receives
-        (n_valid, output dict) in order. `to_host=False` skips the
+        (n_valid, output dict, frames) in order (frames kept for the
+        exact-warp rescue of flagged frames). `to_host=False` skips the
         eager device->host copies (device-resident output pipelines).
         """
         dispatch = getattr(self.backend, "process_batch_async", None)
-        inflight: list[tuple[int, dict]] = []
+        inflight: list[tuple[int, dict, Any]] = []
         for n, batch, idx in batches:
             if dispatch is not None:
                 # Only pass to_host when overriding its default: plugin
@@ -224,13 +317,39 @@ class MotionCorrector:
                     if not to_host
                     else dispatch(batch, ref, idx)
                 )
-                inflight.append((n, out))
+                inflight.append((n, out, batch))
                 if len(inflight) >= depth:
                     drain(inflight.pop(0))
             else:
-                drain((n, self.backend.process_batch(batch, ref, idx)))
+                drain((n, self.backend.process_batch(batch, ref, idx), batch))
         for entry in inflight:
             drain(entry)
+
+    def _rescue_flagged(self, host: dict, batch, n: int) -> None:
+        """Re-warp frames a bounded kernel zeroed (`warp_ok` False)
+        through the backend's exact unbounded path, in place. Records
+        which frames took it in the `warp_rescued` diagnostic."""
+        ok = host.get("warp_ok")
+        rescue = getattr(self.backend, "rescue_warp", None)
+        if ok is None or rescue is None:
+            return
+        ok = np.asarray(ok, bool)
+        host["warp_rescued"] = ~ok
+        if ok.all() or "corrected" not in host:
+            return
+        bad = np.nonzero(~ok)[0]
+        # Index before converting: device-resident batches then transfer
+        # only the flagged frames to host.
+        frames = np.asarray(batch[:n][bad], np.float32)
+        sub = {
+            k: np.asarray(v)[bad]
+            for k, v in host.items()
+            if k in ("transform", "field")
+        }
+        corrected = np.array(host["corrected"])
+        corrected[bad] = rescue(frames, sub)
+        host["corrected"] = corrected
+        host["warp_ok"] = np.ones_like(ok)
 
     def correct_file(
         self,
@@ -240,6 +359,7 @@ class MotionCorrector:
         compression: str = "none",
         progress: bool = False,
         n_threads: int = 0,
+        output_dtype: str | np.dtype = "input",
     ) -> CorrectionResult:
         """Stream-correct a multi-page TIFF stack.
 
@@ -250,6 +370,11 @@ class MotionCorrector:
         than host memory process at steady state. Returns the transforms
         and diagnostics; `corrected` is empty when writing to `output`
         (the frames are on disk).
+
+        `output_dtype`: dtype of the corrected frames — "input"
+        (default: match the source file, so a uint16 microscopy stack
+        stays uint16 on disk; integer targets are rounded and clipped),
+        "float32", or any NumPy dtype.
         """
         from kcmc_tpu.io import ChunkedStackLoader, TiffStack
         from kcmc_tpu.io.tiff import TiffWriter
@@ -282,15 +407,26 @@ class MotionCorrector:
                     ref_frame = self._select_reference(
                         np.asarray(head, np.float32)
                     )
+            if self.template_iters > 0:
+                with timer.stage("refine_template"):
+                    W = min(len(ts), self.template_window)
+                    head = np.asarray(ts.read(0, W), np.float32)
+                    ref_frame = self._refine_reference(head, ref_frame)
+            with timer.stage("prepare_reference"):
                 ref = self.backend.prepare_reference(ref_frame)
 
             writer = TiffWriter(output, compression=compression) if output else None
             outs = []
+            out_dt = self._resolve_output_dtype(output_dtype, ts.dtype)
 
             def drain(entry):
-                n, out = entry
+                n, out, batch = entry
                 host = {k: np.asarray(v)[:n] for k, v in out.items()}
+                if cfg.rescue_warp:
+                    self._rescue_flagged(host, batch, n)
                 corrected = host.pop("corrected", None)
+                if corrected is not None:
+                    corrected = _cast_output(corrected, out_dt)
                 if writer is not None and corrected is not None:
                     for fr in corrected:
                         writer.append(fr)

@@ -189,15 +189,18 @@ def describe_keypoints(
     kps: Keypoints,
     oriented: bool = True,
     blur_sigma: float = 2.0,
+    smooth: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Compute (K, N_WORDS) uint32 BRIEF descriptors for one frame.
 
     `oriented=True` steers the pattern by the quantized intensity-
     centroid angle (rotation-invariant, ORB-style); `False` is classic
     upright BRIEF — slightly more discriminative when the motion model
-    has no rotation (the translation-only config).
+    has no rotation (the translation-only config). `smooth` optionally
+    supplies the blur_sigma-blurred frame so the blur isn't recomputed.
     """
-    smooth = gaussian_blur(img, blur_sigma)
+    if smooth is None:
+        smooth = gaussian_blur(img, blur_sigma)
     r = ROT_RADIUS if oriented else PATCH_RADIUS
     raw, pb = _extract_patches(smooth, kps.xy, r)
     return _describe_from_patches(raw, pb, kps, oriented)
@@ -229,14 +232,13 @@ def describe_keypoints_batch(
     recomputed here.
     """
     if not use_pallas:
-        def one(f, k, s):
-            sm = gaussian_blur(f, blur_sigma) if s is None else s
-            r = ROT_RADIUS if oriented else PATCH_RADIUS
-            raw, pb = _extract_patches(sm, k.xy, r)
-            return _describe_from_patches(raw, pb, k, oriented)
+        def one(f, k, s=None):
+            return describe_keypoints(
+                f, k, oriented=oriented, blur_sigma=blur_sigma, smooth=s
+            )
 
         if smooth is None:
-            return jax.vmap(lambda f, k: one(f, k, None))(frames, kps)
+            return jax.vmap(one)(frames, kps)
         return jax.vmap(one)(frames, kps, smooth)
 
     from kcmc_tpu.ops.pallas_patch import extract_patches

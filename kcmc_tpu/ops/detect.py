@@ -259,10 +259,15 @@ def detect_keypoints_batch(
     the Pallas path runs, two separate conv passes otherwise.
     """
     B, H, W = frames.shape
+    if smooth_sigma is not None and smooth_sigma <= 0.0:
+        raise ValueError(f"smooth_sigma must be positive, got {smooth_sigma}")
     if use_pallas:
         from kcmc_tpu.ops.pallas_detect import response_fields, supports
 
-        if supports((H, W), nms_size, 1.5, smooth_sigma):
+        # border >= 1: the kernel's subpixel fields differ from the jnp
+        # path on the 1-px frame boundary (zero- vs edge-extension);
+        # border=0 keypoints could land there, so take the jnp route.
+        if border >= 1 and supports((H, W), nms_size, 1.5, smooth_sigma):
             out = response_fields(
                 frames, harris_k=harris_k, nms_size=nms_size,
                 smooth_sigma=smooth_sigma, interpret=interpret,

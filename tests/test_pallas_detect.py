@@ -143,5 +143,6 @@ def test_describe_accepts_precomputed_smooth():
     # summation order only; descriptor bits compare blurred values with
     # a strict <, so equal bits everywhere except exact ties.
     bits = 32 * a.shape[-1] * a.shape[0] * a.shape[1]
-    diff = np.bitwise_count(np.asarray(a) ^ np.asarray(b)).sum()
+    xor = (np.asarray(a) ^ np.asarray(b)).view(np.uint8)
+    diff = int(np.unpackbits(xor).sum())  # popcount; numpy<2 compatible
     assert diff <= bits * 1e-3

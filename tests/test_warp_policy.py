@@ -42,14 +42,19 @@ def test_auto_on_cpu_uses_jnp():
 
 
 def test_warp_ok_flag_surfaces():
-    """Frames a bounded gather-free kernel zeroes must be flagged."""
+    """Frames a bounded gather-free kernel zeroes must be flagged.
+
+    rescue_warp=False keeps the raw zero-and-flag contract visible (the
+    default rescues flagged frames through the exact warp instead —
+    tests/test_rescue_warp.py).
+    """
     data = synthetic.make_drift_stack(
         n_frames=4, shape=(128, 128), model="rigid", max_drift=4.0, seed=2
     )
     # max_shear_px=0 makes any nonzero rotation exceed the bound.
     res = MotionCorrector(
         model="rigid", backend="jax", batch_size=4, warp="separable",
-        max_shear_px=0,
+        max_shear_px=0, rescue_warp=False,
     ).correct(data.stack)
     ok = res.diagnostics["warp_ok"]
     assert ok.shape == (4,)
