@@ -139,13 +139,17 @@ class MotionCorrector:
         W = min(len(stack), self.template_window)
         B = self.config.batch_size
         sub = stack[:W]
+        if hasattr(stack, "devices"):  # device-resident: slice on device
+            import jax.numpy as xp
+        else:
+            xp = np
         for _ in range(self.template_iters):
             ref = self.backend.prepare_reference(ref_frame)
             corrected, ok = [], []
             for lo in range(0, W, B):
                 hi = min(lo + B, W)
                 n, batch, idx = self._pad_batch(
-                    sub[lo:hi], np.arange(lo, hi), B
+                    sub[lo:hi], np.arange(lo, hi), B, xp=xp
                 )
                 out = self.backend.process_batch(batch, ref, idx)
                 corrected.append(out["corrected"][:n])
@@ -247,7 +251,8 @@ class MotionCorrector:
 
         with timer.stage("register_batches"):
             self._dispatch_batches(
-                batches(), ref, drain, to_host=not device_outputs
+                batches(), ref, drain, to_host=not device_outputs,
+                keep_frames=do_rescue,
             )
 
         if device_outputs:
@@ -294,20 +299,26 @@ class MotionCorrector:
             idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
         return n, batch, idx
 
-    def _dispatch_batches(self, batches, ref, drain, depth: int = 3, to_host=True):
+    def _dispatch_batches(
+        self, batches, ref, drain, depth: int = 3, to_host=True,
+        keep_frames=False,
+    ):
         """Pipelined dispatch: keep `depth` batches in flight so the
         host->device upload of batch i+1, the compute of batch i, and
         the device->host download of batch i-1 all overlap (the
         process_batch_async seam; backends without it run synchronously).
 
         batches yields (n_valid, frames, indices); drain receives
-        (n_valid, output dict, frames) in order (frames kept for the
-        exact-warp rescue of flagged frames). `to_host=False` skips the
+        (n_valid, output dict, frames) in order. `keep_frames` threads
+        the input frames through to drain (the exact-warp rescue needs
+        them); off, drain gets None and in-flight batches don't pin
+        ~depth extra batch arrays alive. `to_host=False` skips the
         eager device->host copies (device-resident output pipelines).
         """
         dispatch = getattr(self.backend, "process_batch_async", None)
         inflight: list[tuple[int, dict, Any]] = []
         for n, batch, idx in batches:
+            kept = batch if keep_frames else None
             if dispatch is not None:
                 # Only pass to_host when overriding its default: plugin
                 # backends implementing the original 3-arg seam keep
@@ -317,11 +328,11 @@ class MotionCorrector:
                     if not to_host
                     else dispatch(batch, ref, idx)
                 )
-                inflight.append((n, out, batch))
+                inflight.append((n, out, kept))
                 if len(inflight) >= depth:
                     drain(inflight.pop(0))
             else:
-                drain((n, self.backend.process_batch(batch, ref, idx), batch))
+                drain((n, self.backend.process_batch(batch, ref, idx), kept))
         for entry in inflight:
             drain(entry)
 
@@ -331,7 +342,7 @@ class MotionCorrector:
         which frames took it in the `warp_rescued` diagnostic."""
         ok = host.get("warp_ok")
         rescue = getattr(self.backend, "rescue_warp", None)
-        if ok is None or rescue is None:
+        if ok is None or rescue is None or batch is None:
             return
         ok = np.asarray(ok, bool)
         host["warp_rescued"] = ~ok
@@ -454,7 +465,9 @@ class MotionCorrector:
             batch_gen = batches()
             try:
                 with timer.stage("register_batches"):
-                    self._dispatch_batches(batch_gen, ref, drain)
+                    self._dispatch_batches(
+                        batch_gen, ref, drain, keep_frames=cfg.rescue_warp
+                    )
             finally:
                 # Shut the prefetch thread down BEFORE the TiffStack
                 # context closes the native handle it reads through

@@ -20,7 +20,13 @@ This kernel does the same extraction at memory speed:
   mis-wraps negative dynamic amounts on multi-tile arrays.
 * Origins arrive via scalar prefetch, so the kernel is fully static.
 
-Returns patches in the (B, K, P, P) layout the describe stages consume.
+Two kernels share the technique: `extract_blended` is the production
+descriptor path — it fuses the per-keypoint bilinear blend and the ORB
+orientation moments into the cut, emitting keypoint-FIRST patches so
+nothing downstream needs the (P, P, K) relayout. `extract_patches` is
+the raw-patch primitive (standalone utility; not on the product path
+since the blend moved in-kernel, kept for raw-patch consumers and as
+the direct oracle check of the slab/roll addressing).
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -50,6 +57,179 @@ def _patch_kernel(oy_ref, ox_ref, src_ref, out_ref, *, P: int, KB: int):
         slab = pltpu.roll(slab, S - (y0 - y0a), 0)
         slab = pltpu.roll(slab, _WIN - (x0 - x0a), 1)
         out_ref[i] = slab[:P, :P]
+
+
+def _moment_maps(P: int) -> np.ndarray:
+    """(2, 2, 2, P, P) constant weight maps turning the ORB intensity-
+    centroid moments into plain masked reductions over the raw patch.
+
+    maps[ry, rx, 0/1] placed so that sum(patch * maps[ry, rx, 0]) equals
+    m10 (and [1] m01) of the MOMENT_RADIUS disc centered on the rounded
+    keypoint (patch index c + (rx, ry)), matching
+    describe._moment_angles' disc selection exactly.
+    """
+    from kcmc_tpu.ops.patterns import MOMENT_RADIUS, MOMENTS
+
+    c = (P - 2) // 2  # patch center index for offset 0 (= the radius)
+    r = MOMENT_RADIUS
+    moms = np.asarray(MOMENTS, np.float32)  # (2r+1, 2r+1, 3): dx, dy, inside
+    out = np.zeros((2, 2, 2, P, P), np.float32)
+    for ry in (0, 1):
+        for rx in (0, 1):
+            rows = slice(c + ry - r, c + ry + r + 1)
+            cols = slice(c + rx - r, c + rx + r + 1)
+            out[ry, rx, 0, rows, cols] = moms[..., 0] * moms[..., 2]
+            out[ry, rx, 1, rows, cols] = moms[..., 1] * moms[..., 2]
+    return out
+
+
+def _blended_kernel(
+    oy_ref, ox_ref, fx_ref, fy_ref, mm_ref, src_ref,
+    pb_ref, m10_ref, m01_ref,
+    *, P: int, KB: int, with_moments: bool,
+):
+    """Patch cut + per-keypoint bilinear blend (+ ORB moments) fused.
+
+    Produces keypoint-FIRST blended patches: with the blend and the
+    moment reductions done here against the resident slab, nothing
+    downstream shifts patch pixels anymore, so the (P, P, K)
+    keypoint-last relayout the XLA path needs (and its ~6 ms/batch
+    transpose) disappears — the descriptor selection matmul consumes
+    (K, L) rows directly.
+    """
+    b = pl.program_id(0)
+    kb = pl.program_id(1)
+    S = ((P + 7) // 8) * 8 + 8
+    # Scalar stores to VMEM are unsupported: accumulate the per-keypoint
+    # moment scalars into (KB, 1) vectors (iota row-select) and store once.
+    row = jax.lax.broadcasted_iota(jnp.int32, (KB, 1), 0)
+    acc_x = jnp.zeros((KB, 1), jnp.float32)
+    acc_y = jnp.zeros((KB, 1), jnp.float32)
+    for i in range(KB):
+        k = kb * KB + i
+        y0 = oy_ref[b, k]
+        x0 = ox_ref[b, k]
+        y0a = (y0 // 8) * 8
+        x0a = (x0 // 128) * 128
+        slab = src_ref[pl.ds(y0a, S), pl.ds(x0a, _WIN)]  # (S, _WIN)
+        slab = pltpu.roll(slab, S - (y0 - y0a), 0)
+        slab = pltpu.roll(slab, _WIN - (x0 - x0a), 1)
+        patch = slab[:P, :P]
+        fx = fx_ref[i, 0]
+        fy = fy_ref[i, 0]
+        w00 = (1.0 - fy) * (1.0 - fx)
+        w01 = (1.0 - fy) * fx
+        w10 = fy * (1.0 - fx)
+        w11 = fy * fx
+        pb_ref[i] = (
+            w00 * patch[: P - 1, : P - 1]
+            + w01 * patch[: P - 1, 1:]
+            + w10 * patch[1:, : P - 1]
+            + w11 * patch[1:, 1:]
+        )
+        if with_moments:
+            # mm_ref rows: [x00, x01, x10, x11, y00, y01, y10, y11]
+            # (yx order: row 2*ry + rx), see _moment_maps.
+            rx = fx >= 0.5
+            ry = fy >= 0.5
+            wx = jnp.where(
+                ry,
+                jnp.where(rx, mm_ref[3], mm_ref[2]),
+                jnp.where(rx, mm_ref[1], mm_ref[0]),
+            )
+            wy = jnp.where(
+                ry,
+                jnp.where(rx, mm_ref[7], mm_ref[6]),
+                jnp.where(rx, mm_ref[5], mm_ref[4]),
+            )
+            acc_x = jnp.where(row == i, jnp.sum(patch * wx), acc_x)
+            acc_y = jnp.where(row == i, jnp.sum(patch * wy), acc_y)
+    # Outputs must not stay unwritten (the wrapper discards them when
+    # moments are off; they hold zeros then).
+    m10_ref[:, :] = acc_x
+    m01_ref[:, :] = acc_y
+
+
+@functools.partial(
+    jax.jit, static_argnames=("P", "with_moments", "interpret")
+)
+def extract_blended(
+    padded: jnp.ndarray,
+    xy: jnp.ndarray,
+    P: int,
+    with_moments: bool = False,
+    interpret: bool = False,
+):
+    """Keypoint-first blended patches straight from the padded frames.
+
+    padded: (B, Hp, Wp) frames edge-padded by (P - 2) // 2 + 1 (the
+    describe convention); xy: (B, K, 2) subpixel keypoint positions.
+    Returns blended (B, K, P-1, P-1) — the bilinear resample of each
+    patch at its keypoint's subpixel fraction, identical to
+    describe._extract_patches' blended output up to float summation
+    order — and, with `with_moments`, the ORB intensity-centroid
+    moments (m10, m01), each (B, K, 1).
+    """
+    B, Hp, Wp = padded.shape
+    K = xy.shape[1]
+    oy = jnp.floor(xy[..., 1]).astype(jnp.int32) + 1
+    ox = jnp.floor(xy[..., 0]).astype(jnp.int32) + 1
+    fx = (xy[..., 0] - jnp.floor(xy[..., 0]))[..., None].astype(jnp.float32)
+    fy = (xy[..., 1] - jnp.floor(xy[..., 1]))[..., None].astype(jnp.float32)
+    KB = _KB
+    if K % KB:
+        pad = KB - K % KB
+        z = jnp.zeros((B, pad), oy.dtype)
+        zf = jnp.zeros((B, pad, 1), jnp.float32)
+        oy = jnp.concatenate([oy, z], axis=1)
+        ox = jnp.concatenate([ox, z], axis=1)
+        fx = jnp.concatenate([fx, zf], axis=1)
+        fy = jnp.concatenate([fy, zf], axis=1)
+    Kp = oy.shape[1]
+    S = ((P + 7) // 8) * 8 + 8
+    Wpp = -(-(Wp + _WIN) // 128) * 128
+    padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
+    Hpp = Hp + S - P
+
+    Pb = P - 1
+    mm = _moment_maps(P)  # constant; tiny even when moments are unused
+    mm_in = jnp.asarray(
+        np.concatenate([mm[:, :, 0].reshape(4, P, P), mm[:, :, 1].reshape(4, P, P)])
+    )  # (8, P, P): rows [x00, x01, x10, x11, y00, y01, y10, y11]
+    kernel = functools.partial(
+        _blended_kernel, P=P, KB=KB, with_moments=with_moments
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Kp // KB),
+        in_specs=[
+            pl.BlockSpec((None, KB, 1), lambda b, kb, oy, ox: (b, kb, 0)),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, oy, ox: (b, kb, 0)),
+            pl.BlockSpec((8, P, P), lambda b, kb, oy, ox: (0, 0, 0)),
+            pl.BlockSpec((None, Hpp, Wpp), lambda b, kb, oy, ox: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, KB, Pb, Pb), lambda b, kb, oy, ox: (b, kb, 0, 0)),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, oy, ox: (b, kb, 0)),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, oy, ox: (b, kb, 0)),
+        ],
+    )
+    pb, m10, m01 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        oy.astype(jnp.int32), ox.astype(jnp.int32),
+        fx, fy, mm_in, padded.astype(jnp.float32),
+    )
+    if with_moments:
+        return pb[:, :K], m10[:, :K], m01[:, :K]
+    return pb[:, :K]
 
 
 @functools.partial(jax.jit, static_argnames=("P", "interpret"))
