@@ -137,3 +137,21 @@ def test_homography_projective_divide():
     pts = jnp.array([[100.0, 50.0]], dtype=jnp.float32)
     out = apply_transform(H, pts)
     np.testing.assert_allclose(np.asarray(out), [[100 / 1.1, 50 / 1.1]], rtol=1e-5)
+
+
+def test_affine_collinear_sample_falls_back_to_identity():
+    """A collinear minimal sample makes the normal matrix singular; the
+    closed-form Cramer solver must report it (identity fallback), not
+    return a finite collapsing map that could win the RANSAC vote."""
+    model = get_model("affine")
+    src = jnp.asarray(
+        [[0.0, 0.0], [1.0, 1.0], [2.0, 2.0]], dtype=jnp.float32
+    )
+    dst = src + 5.0
+    w = jnp.ones(3, dtype=jnp.float32)
+    M = model.solve(src, dst, w)
+    np.testing.assert_allclose(np.asarray(M), np.eye(3), atol=1e-6)
+    # refine path (LU): a singular system yields inf/nan which _guard
+    # replaces with the identity — output is always finite
+    M2 = model.resolved_refine_solve(src, dst, w)
+    assert bool(jnp.all(jnp.isfinite(M2)))
