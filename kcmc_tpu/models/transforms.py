@@ -169,14 +169,16 @@ def solve_rigid(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarr
     return _guard(_embed(2, R, t), ok=ok)
 
 
-def _solve_sym3(M: jnp.ndarray, rhs: jnp.ndarray) -> jnp.ndarray:
+def _solve_sym3(
+    M: jnp.ndarray, rhs: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Closed-form solve of a symmetric 3x3 system (adjugate/Cramer).
 
     `jnp.linalg.solve` lowers to a batched LU that dominates the RANSAC
     stage when vmapped over (frames x hypotheses) — measured ~7 ms of
     the 15 ms consensus cost on a 64x128 batch. The normal equations
     here are Hartley-conditioned (unit-RMS coordinates), so f32 Cramer
-    is well within the solver's accuracy budget.
+    is well within the solver's accuracy budget. Returns (x, ok).
     """
     a, b, c = M[0, 0], M[0, 1], M[0, 2]
     e, f = M[1, 1], M[1, 2]
@@ -195,9 +197,15 @@ def _solve_sym3(M: jnp.ndarray, rhs: jnp.ndarray) -> jnp.ndarray:
     ])
     # det ~ 0 (collinear/duplicated minimal sample): Cramer would return
     # a finite-but-collapsing map where LU returned inf/nan for _guard
-    # to catch — report singularity explicitly instead. Entries are O(1)
-    # after Hartley conditioning, so an absolute tolerance is meaningful.
-    ok = jnp.abs(det) > 1e-9
+    # to catch — report singularity explicitly instead. The threshold is
+    # RELATIVE to the Hadamard bound a*e*i (the f32 cancellation noise
+    # scales with the entry magnitudes, so an absolute tolerance can't
+    # separate): measured over random image-scale triples, collinear
+    # samples land at rel-det <= ~1e-4 (median 1e-7) while generic
+    # healthy ones sit above ~1e-3 — 1e-5 rejects the collapse maps and
+    # only sacrifices near-degenerate hypotheses RANSAC shouldn't trust
+    # anyway.
+    ok = jnp.abs(det) > 1e-5 * jnp.abs(a * e * i)
     return _mm(adj, rhs) / jnp.where(ok, det, 1.0), ok
 
 
@@ -258,27 +266,70 @@ def _homography_normal_system(src, dst, w):
     return ATA, Ts, Td_inv
 
 
-def _homography_from_h(h, Ts, Td_inv, w):
+def _homography_from_h(h, Ts, Td_inv, w, ok=None):
     """Denormalize + fix scale/sign + degeneracy guard (shared tail)."""
     H = _mm(_mm(Td_inv, h.reshape(3, 3)), Ts)
     H = H / jnp.maximum(jnp.linalg.norm(H), _EPS)
     H = H * jnp.where(H[2, 2] < 0, -1.0, 1.0)
     denom = jnp.where(jnp.abs(H[2, 2]) > 1e-6, H[2, 2], 1.0)
-    return _guard(H / denom, ok=jnp.sum(w) > _MIN_MASS)
+    good = jnp.sum(w) > _MIN_MASS
+    if ok is not None:
+        good = good & ok
+    return _guard(H / denom, ok=good)
+
+
+def _cholesky_solve_unrolled(A: jnp.ndarray, b: jnp.ndarray, n: int):
+    """Solve the SPD system A x = b by a fully unrolled scalar Cholesky.
+
+    `jnp.linalg.solve` lowers small batched systems to an LU whose
+    (frames x hypotheses) vmap dominated the homography consensus
+    stage; unrolling the n=8 factorization into scalar arithmetic turns
+    it into pure elementwise work that vmap vectorizes across the whole
+    hypothesis batch. SPD (normal matrix + ridge) needs no pivoting.
+    Returns (x, ok) where ok is False if any pivot collapsed (rank
+    deficiency — degenerate sample); callers feed ok into the identity
+    guard, matching the inf/nan behavior of the LU path.
+    """
+    L = [[None] * n for _ in range(n)]
+    ok = None
+    for j in range(n):
+        s = A[j, j] - sum(L[j][k] * L[j][k] for k in range(j))
+        # Relative pivot check: a rank-deficient pivot bottoms out at
+        # the ridge + f32 cancellation noise, both of which scale with
+        # the (conditioned, O(1)) diagonal — an absolute epsilon never
+        # fires. 1e-5 of the original diagonal entry separates healthy
+        # pivots from collapsed ones on degenerate minimal samples.
+        healthy = s > 1e-5 * A[j, j]
+        ok = healthy if ok is None else (ok & healthy)
+        d = jnp.sqrt(jnp.maximum(s, 1e-12))
+        L[j][j] = d
+        for i in range(j + 1, n):
+            L[i][j] = (
+                A[i, j] - sum(L[i][k] * L[j][k] for k in range(j))
+            ) / d
+    y = [None] * n
+    for i in range(n):
+        y[i] = (b[i] - sum(L[i][k] * y[k] for k in range(i))) / L[i][i]
+    x = [None] * n
+    for i in reversed(range(n)):
+        x[i] = (
+            y[i] - sum(L[k][i] * x[k] for k in range(i + 1, n))
+        ) / L[i][i]
+    return jnp.stack(x), ok
 
 
 def solve_homography(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     """Weighted normalized DLT, inhomogeneous form: fix h33 = 1 (exact
     for the motion-correction regime — after normalization the true
     homography is near identity, so h33 is far from 0) and solve the
-    8x8 normal system. An 8x8 linear solve is dramatically cheaper than
-    the eigh null-vector route when vmapped over frames x hypotheses
-    (thousands of tiny factorizations per batch)."""
+    8x8 normal system with the unrolled Cholesky. Dramatically cheaper
+    than the eigh null-vector route (and than a batched LU) when
+    vmapped over frames x hypotheses."""
     ATA, Ts, Td_inv = _homography_normal_system(src, dst, w)
     A8 = ATA[:8, :8] + 1e-8 * jnp.eye(8, dtype=ATA.dtype)
-    h8 = jnp.linalg.solve(A8, -ATA[:8, 8])
+    h8, ok = _cholesky_solve_unrolled(A8, -ATA[:8, 8], 8)
     h = jnp.concatenate([h8, jnp.ones((1,), ATA.dtype)])
-    return _homography_from_h(h, Ts, Td_inv, w)
+    return _homography_from_h(h, Ts, Td_inv, w, ok=ok)
 
 
 def solve_homography_accurate(
