@@ -245,15 +245,34 @@ class JaxBackend:
         cfg = self.config
         base_key = jax.random.key(cfg.seed)
         vol_warp = self._resolve_volume_warp()
-        per_frame = self._make_matrix_per_frame_3d(
+        # The plane-flattened Pallas describe route is exact (see
+        # tests/test_pallas_patch.py) but needs the whole (Dp*Hp, Wp)
+        # plane resident in VMEM (~28 MB at 32x256x256) — compile-time
+        # OOM on real hardware. Until the kernel grows data-dependent
+        # slice-block indexing, the 3D path keeps the XLA gather route.
+        use_pallas = False
+        tail = self._make_matrix_tail_3d(
             shape, emit_transform_only=vol_warp is not None
         )
+        from kcmc_tpu.ops.detect3d import detect_keypoints_3d
+        from kcmc_tpu.ops.describe3d import describe_keypoints_3d_batch
 
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
+            kps = jax.vmap(
+                lambda f: detect_keypoints_3d(
+                    f,
+                    max_keypoints=cfg.max_keypoints,
+                    threshold=cfg.detect_threshold,
+                    border=min(cfg.border, min(shape) // 4),
+                )
+            )(frames)
+            desc = describe_keypoints_3d_batch(
+                frames, kps, blur_sigma=cfg.blur_sigma, use_pallas=use_pallas
+            )
             out = jax.vmap(
-                lambda f, k: per_frame(f, ref_xy, ref_desc, ref_valid, k)
-            )(frames, keys)
+                lambda f, kp, d, k: tail(f, kp, d, ref_xy, ref_desc, ref_valid, k)
+            )(frames, kps, desc, keys)
             if vol_warp is not None:
                 out = dict(out)
                 out["corrected"], out["warp_ok"] = vol_warp(
@@ -360,13 +379,12 @@ class JaxBackend:
             )
         return None
 
-    def _make_matrix_per_frame_3d(self, shape, emit_transform_only: bool = False):
-        """With emit_transform_only the batch-level gather-free volume
-        warp (batch_post) produces `corrected`; otherwise the per-frame
-        trilinear gather warp runs inline."""
+    def _make_matrix_tail_3d(self, shape, emit_transform_only: bool = False):
+        """Match + consensus (+ optionally the per-frame gather warp)
+        for one 3D frame; detection and description run batched in
+        _build_local_3d (the Pallas describe route batches via its
+        grid, which cannot sit inside a vmap)."""
         cfg = self.config
-        from kcmc_tpu.ops.detect3d import detect_keypoints_3d
-        from kcmc_tpu.ops.describe3d import describe_keypoints_3d
         from kcmc_tpu.ops.match import knn_match as km
 
         model = get_model(cfg.model)
@@ -375,14 +393,7 @@ class JaxBackend:
                 f"3D stacks require a 3D model (rigid3d), got {cfg.model!r}"
             )
 
-        def per_frame(frame, ref_xy, ref_desc, ref_valid, key):
-            kps = detect_keypoints_3d(
-                frame,
-                max_keypoints=cfg.max_keypoints,
-                threshold=cfg.detect_threshold,
-                border=min(cfg.border, min(shape) // 4),
-            )
-            desc = describe_keypoints_3d(frame, kps, blur_sigma=cfg.blur_sigma)
+        def per_frame(frame, kps, desc, ref_xy, ref_desc, ref_valid, key):
             m = km(
                 desc,
                 ref_desc,

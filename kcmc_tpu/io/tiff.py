@@ -60,7 +60,10 @@ class _StackInfo(ctypes.Structure):
 def _build_native() -> ctypes.CDLL | None:
     """Compile and load the native decoder; None if no toolchain."""
     so_path = _NATIVE_SRC.parent / "_stackio.so"
-    src_mtime = _NATIVE_SRC.stat().st_mtime
+    try:
+        src_mtime = _NATIVE_SRC.stat().st_mtime
+    except OSError:  # source not shipped: degrade to the NumPy decoder
+        return None
     if not os.access(_NATIVE_SRC.parent, os.W_OK):
         # Per-user private cache dir (0700, ownership-checked): a fixed
         # world-shared /tmp name would let another local user plant or

@@ -344,13 +344,141 @@ def solve_homography_accurate(
     return _homography_from_h(evecs[:, 0], Ts, Td_inv, w)
 
 
-def solve_rigid3d(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
-    """Weighted Kabsch: optimal 3D rotation + translation via 3x3 SVD."""
+def _cross_covariance3(src, dst, w, with_norms: bool = False):
     cs = _wmean(src, w)
     cd = _wmean(dst, w)
-    s = (src - cs) * w[:, None]
-    d = dst - cd
-    H = _mm(s.T, d)  # (3, 3) cross-covariance
+    sc = src - cs
+    dc = dst - cd
+    H = _mm((sc * w[:, None]).T, dc)  # (3, 3) cross-covariance
+    if not with_norms:
+        return H, cs, cd
+    ga = jnp.sum(w[:, None] * sc * sc)
+    gb = jnp.sum(w[:, None] * dc * dc)
+    return H, cs, cd, ga, gb
+
+
+def _det3(
+    a, b, c, d, e, f, g, h, i
+):  # rows [a b c; d e f; g h i], scalars
+    return a * (e * i - f * h) - b * (d * i - f * g) + c * (d * h - e * g)
+
+
+def _cross4(r0, r1, r2):
+    """4D generalized cross product of three 4-vectors: a vector
+    orthogonal to all three (the null direction of the rank-3 matrix
+    they span with any fourth dependent row)."""
+    comps = []
+    for i in range(4):
+        cols = [j for j in range(4) if j != i]
+        m = _det3(
+            r0[cols[0]], r0[cols[1]], r0[cols[2]],
+            r1[cols[0]], r1[cols[1]], r1[cols[2]],
+            r2[cols[0]], r2[cols[1]], r2[cols[2]],
+        )
+        comps.append(((-1.0) ** i) * m)
+    return jnp.stack(comps)
+
+
+def solve_rigid3d(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted Kabsch via the quaternion characteristic polynomial
+    (QCP / Theobald): the standard fast closed-form path.
+
+    The SVD route (`solve_rigid3d_accurate`) lowers to a batched 3x3
+    SVD whose (frames x hypotheses) vmap dominates the 3D consensus
+    stage. The optimal proper rotation is the dominant eigenvector of
+    Horn's symmetric 4x4 quaternion matrix K; its largest eigenvalue is
+    found by Newton on the quartic characteristic polynomial (quadratic
+    convergence from the (GA+GB)/2 upper bound), and the eigenvector as
+    a generalized cross product of rows of K - lambda*I. Everything is
+    unrolled scalar arithmetic that vmap vectorizes, and quaternions
+    parametrize proper rotations only (no reflection correction).
+    """
+    H, cs, cd, ga, gb = _cross_covariance3(src, dst, w, with_norms=True)
+    xx, xy, xz = H[0, 0], H[0, 1], H[0, 2]
+    yx, yy, yz = H[1, 0], H[1, 1], H[1, 2]
+    zx, zy, zz = H[2, 0], H[2, 1], H[2, 2]
+    K = jnp.array(
+        [
+            [xx + yy + zz, yz - zy, zx - xz, xy - yx],
+            [yz - zy, xx - yy - zz, xy + yx, zx + xz],
+            [zx - xz, xy + yx, -xx + yy - zz, yz + zy],
+            [xy - yx, zx + xz, yz + zy, -xx - yy + zz],
+        ],
+        dtype=src.dtype,
+    )
+    # Characteristic polynomial of the traceless symmetric K:
+    # p(l) = l^4 + c2 l^2 + c1 l + c0.
+    K2 = _mm(K, K)
+    c2 = -0.5 * jnp.trace(K2)  # -tr(K^2)/2
+    c1 = -jnp.sum(K2 * K) / 3.0  # -tr(K^3)/3
+    # det(K) by cofactor expansion along the first row.
+    dets = []
+    for j in range(4):
+        cols = [k for k in range(4) if k != j]
+        m = _det3(
+            K[1, cols[0]], K[1, cols[1]], K[1, cols[2]],
+            K[2, cols[0]], K[2, cols[1]], K[2, cols[2]],
+            K[3, cols[0]], K[3, cols[1]], K[3, cols[2]],
+        )
+        dets.append(((-1.0) ** j) * K[0, j] * m)
+    c0 = dets[0] + dets[1] + dets[2] + dets[3]
+
+    # Newton from the upper bound (GA + GB) / 2 >= lambda_max.
+    lam = 0.5 * (ga + gb)
+    for _ in range(12):
+        p = ((lam * lam + c2) * lam + c1) * lam + c0
+        dp = (4.0 * lam * lam + 2.0 * c2) * lam + c1
+        lam = lam - p / jnp.where(jnp.abs(dp) > _EPS, dp, _EPS)
+
+    A = K - lam * jnp.eye(4, dtype=src.dtype)
+    # Null vector of the rank-3 A: generalized cross product of three
+    # rows; try all four row triples and keep the largest (near-equal
+    # eigenvalues make individual triples degenerate).
+    cands = jnp.stack(
+        [
+            _cross4(A[1], A[2], A[3]),
+            _cross4(A[0], A[2], A[3]),
+            _cross4(A[0], A[1], A[3]),
+            _cross4(A[0], A[1], A[2]),
+        ]
+    )
+    norms = jnp.sum(cands * cands, axis=1)
+    q = cands[jnp.argmax(norms)]
+    qn = jnp.sqrt(jnp.maximum(jnp.max(norms), _EPS))
+    q = q / qn
+    a, b, c, d = q[0], q[1], q[2], q[3]
+    R = jnp.array(
+        [
+            [a * a + b * b - c * c - d * d, 2 * (b * c - a * d), 2 * (b * d + a * c)],
+            [2 * (b * c + a * d), a * a - b * b + c * c - d * d, 2 * (c * d - a * b)],
+            [2 * (b * d - a * c), 2 * (c * d + a * b), a * a - b * b - c * c + d * d],
+        ],
+        dtype=src.dtype,
+    )
+    t = cd - _mm(R, cs)
+    # Degenerate samples (collinear/coincident: the rotation about the
+    # line is unconstrained) cannot be reliably detected here — the
+    # minor-norm distributions of degenerate and healthy samples
+    # overlap (measured: noise-driven root splitting at the double
+    # eigenvalue inflates some degenerate norms to healthy levels). But
+    # unlike the affine/homography Cramer paths, no detection is
+    # needed for safety: ANY unit quaternion maps to a proper isometry,
+    # so a degenerate hypothesis is a valid rigid motion that simply
+    # fits only its own sample and loses the consensus vote — it can
+    # never manufacture spurious inlier mass the way a finite
+    # COLLAPSING map can. The guard keeps only the hard failures:
+    # zero weight mass, non-finite math (NaN lam propagates, _guard
+    # catches), and a numerically-vanishing quaternion (whose
+    # normalization would otherwise emit a non-rotation).
+    ok = (jnp.sum(w) > _MIN_MASS) & (jnp.max(norms) > 1e-30)
+    return _guard(_embed(3, R, t), ok=ok)
+
+
+def solve_rigid3d_accurate(
+    src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray
+) -> jnp.ndarray:
+    """Weighted Kabsch via 3x3 SVD — the refinement/polish solver."""
+    H, cs, cd = _cross_covariance3(src, dst, w)
     U, _, Vt = jnp.linalg.svd(H)
     det = jnp.linalg.det(_mm(Vt.T, U.T))
     D = jnp.diag(jnp.array([1.0, 1.0, 1.0], dtype=src.dtype)).at[2, 2].set(det)
@@ -372,7 +500,10 @@ MODELS: dict[str, TransformModel] = {
             "homography", ndim=2, dof=8, min_samples=4,
             solve=solve_homography, refine_solve=solve_homography_accurate,
         ),
-        TransformModel("rigid3d", ndim=3, dof=6, min_samples=3, solve=solve_rigid3d),
+        TransformModel(
+            "rigid3d", ndim=3, dof=6, min_samples=3,
+            solve=solve_rigid3d, refine_solve=solve_rigid3d_accurate,
+        ),
     ]
 }
 

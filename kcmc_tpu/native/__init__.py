@@ -1,0 +1,8 @@
+"""Native (C++) runtime components, shipped as source.
+
+The TIFF decoder (stackio.cpp) is compiled on first use with the
+system g++ through a ctypes ABI — no Python build dependencies; see
+kcmc_tpu/io/tiff.py. This package marker exists so setuptools package
+discovery includes the directory (and its *.cpp package data) in
+wheels.
+"""

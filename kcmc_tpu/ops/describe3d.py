@@ -94,3 +94,74 @@ def describe_keypoints_3d(
     bits = vals[..., 0] < vals[..., 1]
     desc = _pack_bits(bits)
     return jnp.where(kps.valid[:, None], desc, jnp.zeros_like(desc))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("blur_sigma", "use_pallas", "interpret")
+)
+def describe_keypoints_3d_batch(
+    vols: jnp.ndarray,
+    kps: Keypoints,
+    blur_sigma: float = 1.5,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(B, K, N_WORDS) descriptors for a (B, D, H, W) batch of volumes.
+
+    The Pallas route reuses the 2D blended-patch kernel by flattening
+    (z, y) into plane rows: each keypoint becomes Pz pseudo-keypoints
+    (one per patch z-slice, rows offset by z * Hp), the kernel performs
+    the in-plane bilinear blend, and the trilinear blend completes as a
+    z-lerp of adjacent blended slices — exactly the jnp path's 8-corner
+    blend, decomposed. Selection then runs keypoint-first through the
+    split-precision one-hot matmul (see ops/describe._onehot_select).
+    """
+    if not use_pallas:
+        return jax.vmap(
+            lambda v, k: describe_keypoints_3d(v, k, blur_sigma=blur_sigma)
+        )(vols, kps)
+
+    from kcmc_tpu.ops.describe import _onehot_select
+    from kcmc_tpu.ops.pallas_patch import extract_blended_planes
+
+    B, D, H, W = vols.shape
+    K = kps.xy.shape[1]
+    smooth = jax.vmap(lambda v: gaussian_blur_3d(v, blur_sigma))(vols)
+    pz, pxy = _RZ + 1, _RX + 1
+    padded = jnp.pad(
+        smooth, ((0, 0), (pz, pz), (pxy, pxy), (pxy, pxy)), mode="edge"
+    )
+    Dp, Hp, Wp = padded.shape[1:]
+    plane = padded.reshape(B, Dp * Hp, Wp)
+    Pz, Pxy = 2 * _RZ + 2, 2 * _RX + 2
+
+    x0 = jnp.floor(kps.xy[..., 0])
+    y0 = jnp.floor(kps.xy[..., 1])
+    z0 = jnp.floor(kps.xy[..., 2])
+    oz = z0.astype(jnp.int32) + 1  # (B, K)
+    oy = y0.astype(jnp.int32) + 1
+    ox = x0.astype(jnp.int32) + 1
+    # Pseudo-keypoints: slice i of keypoint k reads plane rows starting
+    # at (oz + i) * Hp + oy.
+    i = jnp.arange(Pz, dtype=jnp.int32)
+    oy_p = ((oz[..., None] + i) * Hp + oy[..., None]).reshape(B, K * Pz)
+    ox_p = jnp.repeat(ox, Pz, axis=1)
+    fx = (kps.xy[..., 0] - x0).astype(jnp.float32)
+    fy = (kps.xy[..., 1] - y0).astype(jnp.float32)
+    fz = (kps.xy[..., 2] - z0).astype(jnp.float32)
+    fx_p = jnp.repeat(fx, Pz, axis=1)[..., None]
+    fy_p = jnp.repeat(fy, Pz, axis=1)[..., None]
+
+    pb2 = extract_blended_planes(
+        plane, oy_p, ox_p, fx_p, fy_p, Pxy, interpret=interpret
+    )  # (B, K*Pz, Pxy-1, Pxy-1) in-plane blended slices
+    pb2 = pb2.reshape(B, K, Pz, Pxy - 1, Pxy - 1)
+    fzb = fz[..., None, None, None]
+    pb = (1.0 - fzb) * pb2[:, :, :-1] + fzb * pb2[:, :, 1:]
+    # (B, K, SIDE_Z, SIDE_XY, SIDE_XY) trilinear-blended patches
+
+    vals = _onehot_select(pb.reshape(B, K, -1), jnp.asarray(_SEL_3D))
+    vals = vals.reshape(B, K, -1, 2)
+    bits = vals[..., 0] < vals[..., 1]
+    desc = _pack_bits(bits)
+    return jnp.where(kps.valid[..., None], desc, jnp.zeros_like(desc))

@@ -170,12 +170,35 @@ def extract_blended(
     order — and, with `with_moments`, the ORB intensity-centroid
     moments (m10, m01), each (B, K, 1).
     """
-    B, Hp, Wp = padded.shape
-    K = xy.shape[1]
     oy = jnp.floor(xy[..., 1]).astype(jnp.int32) + 1
     ox = jnp.floor(xy[..., 0]).astype(jnp.int32) + 1
     fx = (xy[..., 0] - jnp.floor(xy[..., 0]))[..., None].astype(jnp.float32)
     fy = (xy[..., 1] - jnp.floor(xy[..., 1]))[..., None].astype(jnp.float32)
+    return extract_blended_planes(
+        padded, oy, ox, fx, fy, P, with_moments=with_moments,
+        interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("P", "with_moments", "interpret")
+)
+def extract_blended_planes(
+    padded: jnp.ndarray,
+    oy: jnp.ndarray,
+    ox: jnp.ndarray,
+    fx: jnp.ndarray,
+    fy: jnp.ndarray,
+    P: int,
+    with_moments: bool = False,
+    interpret: bool = False,
+):
+    """Core entry on explicit integer origins (B, K) and blend
+    fractions (B, K, 1): the 3D descriptor path flattens (z, y) into
+    plane rows and feeds pseudo-keypoints per z-slice through this.
+    """
+    B, Hp, Wp = padded.shape
+    K = oy.shape[1]
     KB = _KB
     if K % KB:
         pad = KB - K % KB

@@ -180,3 +180,32 @@ def test_degenerate_samples_rejected_statistically():
     # replaces with the identity — output is always finite
     M2 = aff.resolved_refine_solve(lins[0], lins[0] + 5.0, w3[0])
     assert bool(jnp.all(jnp.isfinite(M2)))
+
+
+def test_rigid3d_degenerate_outputs_are_isometries():
+    """Collinear 3D minimal samples leave the rotation about the line
+    unconstrained; the QCP solver may return any consistent rigid
+    motion. The safety property (unlike affine/homography, which gate
+    on singular determinants) is that every output is a PROPER
+    ISOMETRY — it cannot collapse points into spurious inlier mass, so
+    RANSAC's vote disposes of it."""
+    rng = np.random.default_rng(3)
+    r3 = get_model("rigid3d")
+    N = 100
+    lins = []
+    for _ in range(N):
+        q0 = rng.uniform(0, 256, 3)
+        d = rng.uniform(-1, 1, 3)
+        d /= np.linalg.norm(d)
+        lins.append(
+            np.stack(
+                [q0, q0 + rng.uniform(10, 80) * d, q0 + rng.uniform(80, 200) * d]
+            ).astype(np.float32)
+        )
+    lins = jnp.asarray(np.stack(lins))
+    w = jnp.ones((N, 3), jnp.float32)
+    Ms = np.asarray(jax.vmap(lambda s, ww: r3.solve(s, s + 5.0, ww))(lins, w))
+    for M in Ms:
+        R = M[:3, :3]
+        np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-4)
+        assert np.linalg.det(R) > 0.9  # proper (no reflection/collapse)
