@@ -52,6 +52,8 @@ def _cmd_correct(args) -> int:
         overrides["n_hypotheses"] = args.hypotheses
     if args.warp:
         overrides["warp"] = args.warp
+    if args.quality:
+        overrides["quality_metrics"] = True
 
     mc = MotionCorrector(
         model=args.model, backend=args.backend, reference=ref, **overrides
@@ -88,6 +90,10 @@ def _cmd_correct(args) -> int:
         summary["warp_flagged_frames"] = int(
             (~res.diagnostics["warp_ok"]).sum()
         )
+    if "template_corr" in res.diagnostics:
+        corr = res.diagnostics["template_corr"]
+        summary["template_corr_mean"] = round(float(np.mean(corr)), 4)
+        summary["template_corr_min"] = round(float(np.min(corr)), 4)
     print(json.dumps(summary))
     return 0
 
@@ -126,6 +132,10 @@ def main(argv=None) -> int:
         "--output-dtype", default="input",
         help="corrected-frame dtype: 'input' (match source, default), "
         "'float32', or any NumPy dtype (integer targets round + clip)",
+    )
+    p.add_argument(
+        "--quality", action="store_true",
+        help="report per-frame template correlation (registration QC)",
     )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)

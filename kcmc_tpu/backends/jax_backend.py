@@ -33,6 +33,21 @@ from kcmc_tpu.ops.ransac import ransac_estimate
 from kcmc_tpu.ops.warp import warp_batch_with_ok, warp_frame_flow, warp_volume
 
 
+@jax.jit
+def _template_corr(corrected: jnp.ndarray, ref_frame: jnp.ndarray) -> jnp.ndarray:
+    """Per-frame Pearson correlation against the reference — the
+    standard registration-quality diagnostic. Frames a bounded warp
+    zeroed read ~0 here; the corrector recomputes after a rescue."""
+    axes = tuple(range(1, corrected.ndim))
+    c = corrected - jnp.mean(corrected, axis=axes, keepdims=True)
+    r = ref_frame - jnp.mean(ref_frame)
+    num = jnp.sum(c * r, axis=axes)
+    den = jnp.sqrt(
+        jnp.sum(c * c, axis=axes) * jnp.sum(r * r)
+    )
+    return num / jnp.maximum(den, 1e-12)
+
+
 @register_backend("jax")
 class JaxBackend:
     """XLA-compiled pipeline; runs on TPU (or any JAX backend)."""
@@ -61,7 +76,7 @@ class JaxBackend:
             desc = describe_keypoints(
                 frame, kps, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
             )
-            return {"xy": kps.xy, "desc": desc, "valid": kps.valid}
+            return {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
         from kcmc_tpu.ops.detect3d import detect_keypoints_3d
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d
 
@@ -72,7 +87,7 @@ class JaxBackend:
             border=min(cfg.border, min(frame.shape) // 4),
         )
         desc = describe_keypoints_3d(frame, kps, blur_sigma=cfg.blur_sigma)
-        return {"xy": kps.xy, "desc": desc, "valid": kps.valid}
+        return {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
 
     # -- batch processing --------------------------------------------------
 
@@ -104,6 +119,15 @@ class JaxBackend:
             frames_j = shard_frames(frames_j, self.mesh)
             idx_j = shard_frames(idx_j, self.mesh)
         out = fn(frames_j, ref["xy"], ref["desc"], ref["valid"], idx_j)
+        if (
+            self.config.quality_metrics
+            and "corrected" in out
+            and ref.get("frame") is not None
+        ):
+            out = dict(out)
+            out["template_corr"] = _template_corr(
+                out["corrected"], ref["frame"]
+            )
         if to_host:
             for v in out.values():  # start D2H copies in the background
                 if hasattr(v, "copy_to_host_async"):

@@ -16,6 +16,18 @@ from kcmc_tpu.backends import _np_kernels as K
 from kcmc_tpu.config import CorrectorConfig
 
 
+def template_corr_np(corrected: np.ndarray, ref_frame: np.ndarray) -> np.ndarray:
+    """Per-frame Pearson correlation against the reference (NumPy
+    mirror of the jax backend's quality metric; also used by the
+    corrector to refresh rescued frames)."""
+    axes = tuple(range(1, corrected.ndim))
+    c = corrected - corrected.mean(axis=axes, keepdims=True)
+    r = ref_frame - ref_frame.mean()
+    num = (c * r).sum(axis=axes)
+    den = np.sqrt((c * c).sum(axis=axes) * (r * r).sum())
+    return (num / np.maximum(den, 1e-12)).astype(np.float32)
+
+
 @register_backend("numpy")
 class NumpyBackend:
     name = "numpy"
@@ -47,7 +59,10 @@ class NumpyBackend:
             oriented=cfg.resolved_oriented(),
             blur_sigma=cfg.blur_sigma,
         )
-        return {"xy": xy, "desc": desc, "valid": valid}
+        return {
+            "xy": xy, "desc": desc, "valid": valid,
+            "frame": np.asarray(ref_frame, np.float32),
+        }
 
     def process_batch(
         self, frames: np.ndarray, ref: dict, frame_indices: np.ndarray
@@ -56,7 +71,12 @@ class NumpyBackend:
         out: dict[str, list] = {k: [] for k in self._keys()}
         for frame, gidx in zip(frames, frame_indices):
             self._process_one(np.asarray(frame, np.float32), int(gidx), ref, out)
-        return {k: np.stack(v) for k, v in out.items()}
+        merged = {k: np.stack(v) for k, v in out.items()}
+        if cfg.quality_metrics and "corrected" in merged and "frame" in ref:
+            merged["template_corr"] = template_corr_np(
+                merged["corrected"], ref["frame"]
+            )
+        return merged
 
     def _keys(self):
         base = [

@@ -44,6 +44,12 @@ class CorrectorConfig:
     field_smooth_sigma: float = 0.7  # in grid cells
     global_threshold: float = 8.0  # generous inlier px for the global stage
 
+    # -- diagnostics -------------------------------------------------------
+    # Per-frame Pearson correlation between each corrected frame and the
+    # reference (the standard microscopy registration-quality metric);
+    # computed on device, reported as diagnostics["template_corr"].
+    quality_metrics: bool = False
+
     # -- execution ---------------------------------------------------------
     batch_size: int = 32  # frames per jitted device step
     # Warp kernel selection: "jnp" = XLA gather warp (all models, exact,

@@ -145,6 +145,10 @@ class MotionCorrector:
             xp = np
         for _ in range(self.template_iters):
             ref = self.backend.prepare_reference(ref_frame)
+            # Refinement only consumes corrected/warp_ok; dropping the
+            # reference frame from this view disables the per-batch
+            # quality metric (and its D2H transfer) in these passes.
+            ref = {k: v for k, v in ref.items() if k != "frame"}
             corrected, ok = [], []
             for lo in range(0, W, B):
                 hi = min(lo + B, W)
@@ -239,7 +243,7 @@ class MotionCorrector:
             n, out, batch = entry
             host = {k: convert(v)[:n] for k, v in out.items()}
             if do_rescue:
-                self._rescue_flagged(host, batch, n)
+                self._rescue_flagged(host, batch, n, ref)
             outs.append(host)
 
         def batches():
@@ -336,7 +340,7 @@ class MotionCorrector:
         for entry in inflight:
             drain(entry)
 
-    def _rescue_flagged(self, host: dict, batch, n: int) -> None:
+    def _rescue_flagged(self, host: dict, batch, n: int, ref=None) -> None:
         """Re-warp frames a bounded kernel zeroed (`warp_ok` False)
         through the backend's exact unbounded path, in place. Records
         which frames took it in the `warp_rescued` diagnostic."""
@@ -361,6 +365,14 @@ class MotionCorrector:
         corrected[bad] = rescue(frames, sub)
         host["corrected"] = corrected
         host["warp_ok"] = np.ones_like(ok)
+        if "template_corr" in host and ref is not None and "frame" in ref:
+            from kcmc_tpu.backends.numpy_backend import template_corr_np
+
+            corr = np.array(host["template_corr"])
+            corr[bad] = template_corr_np(
+                corrected[bad], np.asarray(ref["frame"], np.float32)
+            )
+            host["template_corr"] = corr
 
     def correct_file(
         self,
@@ -434,7 +446,7 @@ class MotionCorrector:
                 n, out, batch = entry
                 host = {k: np.asarray(v)[:n] for k, v in out.items()}
                 if cfg.rescue_warp:
-                    self._rescue_flagged(host, batch, n)
+                    self._rescue_flagged(host, batch, n, ref)
                 corrected = host.pop("corrected", None)
                 if corrected is not None:
                     corrected = _cast_output(corrected, out_dt)
