@@ -84,7 +84,10 @@ def detect_keypoints(
     is_max = resp >= local_max
     ys, xs = np.mgrid[0:H, 0:W]
     inb = (ys >= border) & (ys < H - border) & (xs >= border) & (xs < W - border)
-    peak = max(resp.max(), 1e-12)
+    # Peak over the selectable region only — mirrors ops/detect.py's
+    # border-excluded peak (background offsets spike the border ring).
+    sel = np.where(is_max & inb, resp, -np.inf)
+    peak = max(sel.max(), 1e-12)
     cand = is_max & inb & (resp > threshold * peak)
     masked = np.where(cand, resp, -np.inf)
     # Tile-bucketed candidate reduction — same rule as ops/detect.py

@@ -278,19 +278,20 @@ class JaxBackend:
         tail = self._make_matrix_tail_3d(
             shape, emit_transform_only=vol_warp is not None
         )
-        from kcmc_tpu.ops.detect3d import detect_keypoints_3d
+        from kcmc_tpu.ops.detect3d import detect_keypoints_3d_batch
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d_batch
+
+        use_pallas_detect = self._on_accelerator()
 
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
-            kps = jax.vmap(
-                lambda f: detect_keypoints_3d(
-                    f,
-                    max_keypoints=cfg.max_keypoints,
-                    threshold=cfg.detect_threshold,
-                    border=min(cfg.border, min(shape) // 4),
-                )
-            )(frames)
+            kps = detect_keypoints_3d_batch(
+                frames,
+                max_keypoints=cfg.max_keypoints,
+                threshold=cfg.detect_threshold,
+                border=min(cfg.border, min(shape) // 4),
+                use_pallas=use_pallas_detect,
+            )
             desc = describe_keypoints_3d_batch(
                 frames, kps, blur_sigma=cfg.blur_sigma, use_pallas=use_pallas
             )

@@ -156,10 +156,15 @@ def _select_keypoints(
     ys = jnp.arange(H)[:, None]
     xs = jnp.arange(W)[None, :]
     inb = (ys >= border) & (ys < H - border) & (xs >= border) & (xs < W - border)
-    # Threshold is relative to the frame's max response: robust to
-    # global contrast changes across frames. (The global max of the
-    # response is itself an NMS local max, so max(nms_resp) == max(resp).)
-    peak = jnp.maximum(jnp.max(nms_resp), 1e-12)
+    # Threshold is relative to the max response over the SELECTABLE
+    # (border-excluded) region: robust to global contrast changes, and
+    # immune to the border-ring response spikes a constant background
+    # offset creates (SAME-conv gradients at the frame edge see the
+    # offset against zero padding — in 3D those face-wide spikes
+    # inflated a full-frame peak ~50x and silently killed every
+    # interior keypoint). The interior global max is itself an NMS
+    # local max, so masking nms_resp loses nothing.
+    peak = jnp.maximum(jnp.max(jnp.where(inb, nms_resp, -jnp.inf)), 1e-12)
     masked = jnp.where(inb & (nms_resp > threshold * peak), nms_resp, -jnp.inf)
 
     # Candidate reduction: strongest surviving pixel per TILE x TILE tile

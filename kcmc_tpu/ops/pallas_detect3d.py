@@ -1,0 +1,206 @@
+"""Pallas TPU kernel: fused 3D Harris response + NMS for z-stacks.
+
+The jnp 3D detection path (ops/detect3d.py) is ~25 shift-and-add
+convolution passes (3 gradients, 6 structure-tensor entries x 3 blur
+axes) plus NMS, each round-tripping the volume batch through HBM —
+measured ~21 ms of the ~28 ms detect stage on an 8-volume 32x256x256
+batch, with XLA fusion recovering almost none of it. This kernel
+computes the whole dense part in one VMEM-resident pass per
+(z-block, y-strip) tile.
+
+Memory structure: grid (batch, z-blocks, y-strips) with 8-voxel blocks
+in z and y. The padded volume carries one full ZERO block before and
+after the content in BOTH z and y (and zero lanes on the right in x),
+so a program assembles its (24, 24, Wp) slab from the 3x3 neighborhood
+of blocks and every out-of-volume read is a genuine zero. One mask IS
+still required: the central difference leaves a nonzero gradient ring
+one voxel outside the content, whose products the Gaussian window
+would blend back inside (the jnp path's products are zero there), so
+gradients are re-masked to the real volume before the products — the
+same lesson as the 2D kernel's conv-spill mask. Within the slab, rolls
+wrap garbage into the outer ring only; each stage's validity shrinks
+by its reach (diff 1 + blur 5 + NMS 1 = 7 < 8 = halo), so the central
+8x8 output block never reads a contaminated voxel.
+
+Outputs are the raw response and the NMS-masked response; subpixel
+fields, thresholding, tile bucketing, and top-k stay in XLA (they are
+elementwise/cheap there). The response at every real voxel matches the
+jnp path exactly up to float summation order; the NMS comparison at
+the volume's 1-voxel border ring is stricter than reduce_window's
+-inf padding (the kernel compares against genuine zero-padding
+responses), which is invisible behind the detector's border margin.
+
+Counterpart of the reference `KeypointExtractor` detect stage for
+config 5 (SURVEY.md §2 — reference source unavailable).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kcmc_tpu.ops.pallas_detect import _gauss_taps
+
+_BZ = 8  # z-block (and z-halo) size
+_BY = 8  # y-strip (and y-halo) size
+_DIFF = (0.5, 0.0, -0.5)  # central difference, correlation form
+
+
+def supports(shape: tuple[int, int, int], window_sigma: float = 1.5) -> bool:
+    """Whether the fused kernel handles this volume configuration."""
+    blur_r = max(1, int(3.0 * window_sigma + 0.5))
+    if 1 + blur_r + 1 > _BZ:  # diff + blur + NMS reach vs halo
+        return False
+    Wp = -(-(shape[2] + 8) // 128) * 128
+    # 10 slab-sized f32 scratch buffers must fit VMEM with headroom.
+    return 10 * 3 * _BZ * 3 * _BY * Wp * 4 <= 11 * 1024 * 1024
+
+
+def _roll(a, d: int, axis: int):
+    if d:
+        a = pltpu.roll(a, (-d) % a.shape[axis], axis)
+    return a
+
+
+def _acc_corr(dst_ref, src_ref, taps, axis: int):
+    """dst <- correlation of src with `taps` along `axis` (tap-by-tap
+    accumulation bounds the live temporaries to one rolled copy)."""
+    r = len(taps) // 2
+    first = True
+    for i, w in enumerate(taps):
+        if w == 0.0:
+            continue
+        term = w * _roll(src_ref[...], i - r, axis)
+        if first:
+            dst_ref[...] = term
+            first = False
+        else:
+            dst_ref[...] = dst_ref[...] + term
+
+
+def _structure_kernel(*refs, D: int, H: int, W: int, gauss):
+    """Gradients + 3-axis Gaussian window for the six structure-tensor
+    entries, written straight to their output blocks. The response /
+    NMS tail runs in XLA — it is a single fused elementwise pass there,
+    and keeping it out of the kernel holds the VMEM footprint to six
+    slab buffers (entry accumulators in VMEM OOM'd at every staging
+    the Mosaic stack allocator was offered)."""
+    ins, outs, scratch = refs[:9], refs[9:15], refs[15:]
+    f, g1, g2, g3, t1, t2 = scratch
+    zi = pl.program_id(1)
+    yi = pl.program_id(2)
+    # Assemble the 3x3-neighborhood slab: (3*BZ, 3*BY, Wp).
+    for dz in range(3):
+        for dy in range(3):
+            f[dz * _BZ : (dz + 1) * _BZ, dy * _BY : (dy + 1) * _BY, :] = (
+                ins[dz * 3 + dy][...]
+            )
+    # Gradients (correlation form of the jnp path's conv taps).
+    _acc_corr(g1, f, _DIFF, 0)  # gz
+    _acc_corr(g2, f, _DIFF, 1)  # gy
+    _acc_corr(g3, f, _DIFF, 2)  # gx
+    # Re-mask to the real volume: the central difference leaves a
+    # NONZERO gradient ring one voxel outside the content (it reads the
+    # edge voxel against a genuine zero), and the Gaussian window would
+    # blend its products back inside — the jnp path's products are
+    # zero there. On zero-background synthetic data this is invisible;
+    # on real data with a camera offset it inflated the border response
+    # ~2x and the detection threshold ~3x before this mask.
+    shape = f.shape
+    zg = jax.lax.broadcasted_iota(jnp.int32, shape, 0) + (zi * _BZ - _BZ)
+    yg = jax.lax.broadcasted_iota(jnp.int32, shape, 1) + (yi * _BY - _BY)
+    xg = jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+    realf = (
+        (zg >= 0) & (zg < D) & (yg >= 0) & (yg < H) & (xg < W)
+    ).astype(jnp.float32)
+    g1[...] = g1[...] * realf
+    g2[...] = g2[...] * realf
+    g3[...] = g3[...] * realf
+    c = slice(_BZ, 2 * _BZ), slice(_BY, 2 * _BY), slice(0, W)
+    # order: sxx, syy, szz, sxy, sxz, syz
+    for out, (a, b) in zip(
+        outs,
+        ((g3, g3), (g2, g2), (g1, g1), (g3, g2), (g3, g1), (g2, g1)),
+    ):
+        t2[...] = a[...] * b[...]
+        _acc_corr(t1, t2, gauss, 0)
+        _acc_corr(t2, t1, gauss, 1)
+        _acc_corr(t1, t2, gauss, 2)
+        out[...] = t1[c[0], c[1], c[2]]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("harris_k", "window_sigma", "interpret")
+)
+def response_fields_3d(
+    vols: jnp.ndarray,
+    harris_k: float = 0.005,
+    window_sigma: float = 1.5,
+    interpret: bool = False,
+):
+    """(resp, nms_resp) for a (B, D, H, W) volume batch, each (B, D, H, W).
+
+    nms_resp holds the response at 3x3x3 local maxima and -inf
+    elsewhere (stricter than the jnp path only on the 1-voxel border
+    ring — see module docstring).
+    """
+    B, D, H, W = vols.shape
+    gauss = _gauss_taps(window_sigma)
+    nz = -(-D // _BZ)
+    ny = -(-H // _BY)
+    Wp = -(-(W + 8) // 128) * 128
+    padded = jnp.pad(
+        vols.astype(jnp.float32),
+        (
+            (0, 0),
+            (_BZ, (nz + 1) * _BZ - D),
+            (_BY, (ny + 1) * _BY - H),
+            (0, Wp - W),
+        ),
+    )
+
+    def strip_in(dz, dy):
+        return pl.BlockSpec(
+            (None, _BZ, _BY, Wp),
+            lambda b, zi, yi, dz=dz, dy=dy: (b, zi + dz, yi + dy, 0),
+        )
+
+    slab = (3 * _BZ, 3 * _BY, Wp)
+    kernel = functools.partial(
+        _structure_kernel, D=D, H=H, W=W, gauss=gauss
+    )
+    Do, Ho = nz * _BZ, ny * _BY
+    sxx, syy, szz, sxy, sxz, syz = pl.pallas_call(
+        kernel,
+        grid=(B, nz, ny),
+        in_specs=[strip_in(dz, dy) for dz in range(3) for dy in range(3)],
+        out_specs=[
+            pl.BlockSpec((None, _BZ, _BY, W), lambda b, zi, yi: (b, zi, yi, 0))
+            for _ in range(6)
+        ],
+        out_shape=[jax.ShapeDtypeStruct((B, Do, Ho, W), jnp.float32)] * 6,
+        scratch_shapes=[pltpu.VMEM(slab, jnp.float32) for _ in range(6)],
+        interpret=interpret,
+    )(*([padded] * 9))
+    sl = np.s_[:, :D, :H]
+    sxx, syy, szz = sxx[sl], syy[sl], szz[sl]
+    sxy, sxz, syz = sxy[sl], sxz[sl], syz[sl]
+    # Response + NMS: one fused elementwise pass in XLA.
+    det = (
+        sxx * (syy * szz - syz * syz)
+        - sxy * (sxy * szz - syz * sxz)
+        + sxz * (sxy * syz - syy * sxz)
+    )
+    tr = sxx + syy + szz
+    resp = det - harris_k * tr * tr * tr
+    from kcmc_tpu.ops.detect3d import _maxpool3_same
+
+    nms = jnp.where(
+        resp >= jax.vmap(_maxpool3_same)(resp), resp, -jnp.inf
+    )
+    return resp, nms
