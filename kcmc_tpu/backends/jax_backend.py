@@ -269,31 +269,28 @@ class JaxBackend:
         cfg = self.config
         base_key = jax.random.key(cfg.seed)
         vol_warp = self._resolve_volume_warp()
-        # The plane-flattened Pallas describe route is exact (see
-        # tests/test_pallas_patch.py) but needs the whole (Dp*Hp, Wp)
-        # plane resident in VMEM (~28 MB at 32x256x256) — compile-time
-        # OOM on real hardware. Until the kernel grows data-dependent
-        # slice-block indexing, the 3D path keeps the XLA gather route.
-        use_pallas = False
+        use_pallas = self._on_accelerator()
         tail = self._make_matrix_tail_3d(
             shape, emit_transform_only=vol_warp is not None
         )
         from kcmc_tpu.ops.detect3d import detect_keypoints_3d_batch
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d_batch
 
-        use_pallas_detect = self._on_accelerator()
-
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
-            kps = detect_keypoints_3d_batch(
+            # smooth (the descriptor-stage blur) rides along with the
+            # fused detection kernel's resident slab, as in 2D.
+            kps, smooth = detect_keypoints_3d_batch(
                 frames,
                 max_keypoints=cfg.max_keypoints,
                 threshold=cfg.detect_threshold,
                 border=min(cfg.border, min(shape) // 4),
-                use_pallas=use_pallas_detect,
+                use_pallas=use_pallas,
+                smooth_sigma=cfg.blur_sigma,
             )
             desc = describe_keypoints_3d_batch(
-                frames, kps, blur_sigma=cfg.blur_sigma, use_pallas=use_pallas
+                frames, kps, blur_sigma=cfg.blur_sigma, use_pallas=use_pallas,
+                smooth=smooth,
             )
             out = jax.vmap(
                 lambda f, kp, d, k: tail(f, kp, d, ref_xy, ref_desc, ref_valid, k)

@@ -51,10 +51,17 @@ _SEL_3D = _selection_matrix_3d(PATTERN_3D)
 
 @functools.partial(jax.jit, static_argnames=("blur_sigma",))
 def describe_keypoints_3d(
-    vol: jnp.ndarray, kps: Keypoints, blur_sigma: float = 1.5
+    vol: jnp.ndarray,
+    kps: Keypoints,
+    blur_sigma: float = 1.5,
+    smooth: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """(K, N_WORDS) uint32 3D-BRIEF descriptors for one volume."""
-    smooth = gaussian_blur_3d(vol, blur_sigma)
+    """(K, N_WORDS) uint32 3D-BRIEF descriptors for one volume.
+
+    `smooth` optionally supplies the pre-blurred volume (the fused
+    detection kernel's free-ride output)."""
+    if smooth is None:
+        smooth = gaussian_blur_3d(vol, blur_sigma)
     K = kps.xy.shape[0]
     # Edge-pad so patches clamp like pointwise trilinear sampling would.
     pz, pxy = _RZ + 1, _RX + 1
@@ -105,59 +112,42 @@ def describe_keypoints_3d_batch(
     blur_sigma: float = 1.5,
     use_pallas: bool = False,
     interpret: bool = False,
+    smooth: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """(B, K, N_WORDS) descriptors for a (B, D, H, W) batch of volumes.
 
-    The Pallas route reuses the 2D blended-patch kernel by flattening
-    (z, y) into plane rows: each keypoint becomes Pz pseudo-keypoints
-    (one per patch z-slice, rows offset by z * Hp), the kernel performs
-    the in-plane bilinear blend, and the trilinear blend completes as a
-    z-lerp of adjacent blended slices — exactly the jnp path's 8-corner
-    blend, decomposed. Selection then runs keypoint-first through the
-    split-precision one-hot matmul (see ops/describe._onehot_select).
+    The Pallas route cuts each keypoint's slab as its own
+    Element-indexed block (dynamic z/y block starts from scalar
+    prefetch — VMEM never holds the volume), blends in-plane per slice,
+    and completes the trilinear blend as a z-lerp of adjacent blended
+    slices — exactly the jnp path's 8-corner blend, decomposed.
+    Selection then runs keypoint-first through the split-precision
+    one-hot matmul (see ops/describe._onehot_select).
     """
     if not use_pallas:
+        if smooth is not None:
+            return jax.vmap(
+                lambda v, k, s: describe_keypoints_3d(
+                    v, k, blur_sigma=blur_sigma, smooth=s
+                )
+            )(vols, kps, smooth)
         return jax.vmap(
             lambda v, k: describe_keypoints_3d(v, k, blur_sigma=blur_sigma)
         )(vols, kps)
 
     from kcmc_tpu.ops.describe import _onehot_select
-    from kcmc_tpu.ops.pallas_patch import extract_blended_planes
+    from kcmc_tpu.ops.pallas_patch import extract_blended_3d
 
     B, D, H, W = vols.shape
     K = kps.xy.shape[1]
-    smooth = jax.vmap(lambda v: gaussian_blur_3d(v, blur_sigma))(vols)
+    if smooth is None:
+        smooth = jax.vmap(lambda v: gaussian_blur_3d(v, blur_sigma))(vols)
     pz, pxy = _RZ + 1, _RX + 1
     padded = jnp.pad(
         smooth, ((0, 0), (pz, pz), (pxy, pxy), (pxy, pxy)), mode="edge"
     )
-    Dp, Hp, Wp = padded.shape[1:]
-    plane = padded.reshape(B, Dp * Hp, Wp)
     Pz, Pxy = 2 * _RZ + 2, 2 * _RX + 2
-
-    x0 = jnp.floor(kps.xy[..., 0])
-    y0 = jnp.floor(kps.xy[..., 1])
-    z0 = jnp.floor(kps.xy[..., 2])
-    oz = z0.astype(jnp.int32) + 1  # (B, K)
-    oy = y0.astype(jnp.int32) + 1
-    ox = x0.astype(jnp.int32) + 1
-    # Pseudo-keypoints: slice i of keypoint k reads plane rows starting
-    # at (oz + i) * Hp + oy.
-    i = jnp.arange(Pz, dtype=jnp.int32)
-    oy_p = ((oz[..., None] + i) * Hp + oy[..., None]).reshape(B, K * Pz)
-    ox_p = jnp.repeat(ox, Pz, axis=1)
-    fx = (kps.xy[..., 0] - x0).astype(jnp.float32)
-    fy = (kps.xy[..., 1] - y0).astype(jnp.float32)
-    fz = (kps.xy[..., 2] - z0).astype(jnp.float32)
-    fx_p = jnp.repeat(fx, Pz, axis=1)[..., None]
-    fy_p = jnp.repeat(fy, Pz, axis=1)[..., None]
-
-    pb2 = extract_blended_planes(
-        plane, oy_p, ox_p, fx_p, fy_p, Pxy, interpret=interpret
-    )  # (B, K*Pz, Pxy-1, Pxy-1) in-plane blended slices
-    pb2 = pb2.reshape(B, K, Pz, Pxy - 1, Pxy - 1)
-    fzb = fz[..., None, None, None]
-    pb = (1.0 - fzb) * pb2[:, :, :-1] + fzb * pb2[:, :, 1:]
+    pb = extract_blended_3d(padded, kps.xy, Pz, Pxy, interpret=interpret)
     # (B, K, SIDE_Z, SIDE_XY, SIDE_XY) trilinear-blended patches
 
     vals = _onehot_select(pb.reshape(B, K, -1), jnp.asarray(_SEL_3D))

@@ -210,7 +210,7 @@ def detect_keypoints_3d(
     jax.jit,
     static_argnames=(
         "max_keypoints", "threshold", "border", "harris_k",
-        "use_pallas", "interpret",
+        "use_pallas", "smooth_sigma", "interpret",
     ),
 )
 def detect_keypoints_3d_batch(
@@ -220,26 +220,35 @@ def detect_keypoints_3d_batch(
     border: int = 6,
     harris_k: float = 0.005,
     use_pallas: bool = False,
+    smooth_sigma: float | None = None,
     interpret: bool = False,
-) -> Keypoints:
+):
     """Detect keypoints over a (B, D, H, W) batch; fields carry a batch
     axis. With `use_pallas` the dense response/NMS fields come from the
     fused kernel (ops/pallas_detect3d.py) — one VMEM-resident pass over
     (z-block, y-strip) tiles instead of ~25 HBM-round-tripping
-    shift-and-add passes; selection stays in XLA."""
-    if use_pallas and border >= 1:
+    shift-and-add passes; selection stays in XLA.
+
+    With `smooth_sigma` returns (keypoints, smooth): the sigma-blurred
+    batch for the descriptor stage (a free ride on the fused kernel's
+    resident slab when the Pallas path runs)."""
+    if smooth_sigma is not None and smooth_sigma <= 0.0:
+        raise ValueError(f"smooth_sigma must be positive, got {smooth_sigma}")
+    if use_pallas:
         from kcmc_tpu.ops.pallas_detect3d import response_fields_3d, supports
 
-        if supports(vols.shape[1:]):
-            resp, nms_resp = response_fields_3d(
-                vols, harris_k=harris_k, interpret=interpret
+        if supports(vols.shape[1:], smooth_sigma=smooth_sigma):
+            out = response_fields_3d(
+                vols, harris_k=harris_k, smooth_sigma=smooth_sigma,
+                interpret=interpret,
             )
-            return jax.vmap(
+            kps = jax.vmap(
                 lambda r, n: _select_keypoints_3d(
                     r, n, max_keypoints, threshold, border
                 )
-            )(resp, nms_resp)
-    return jax.vmap(
+            )(*out[:2])
+            return (kps, out[2]) if smooth_sigma is not None else kps
+    kps = jax.vmap(
         lambda v: detect_keypoints_3d(
             v,
             max_keypoints=max_keypoints,
@@ -248,3 +257,7 @@ def detect_keypoints_3d_batch(
             harris_k=harris_k,
         )
     )(vols)
+    if smooth_sigma is not None:
+        smooth = jax.vmap(lambda v: gaussian_blur_3d(v, smooth_sigma))(vols)
+        return kps, smooth
+    return kps

@@ -19,16 +19,16 @@ would blend back inside (the jnp path's products are zero there), so
 gradients are re-masked to the real volume before the products — the
 same lesson as the 2D kernel's conv-spill mask. Within the slab, rolls
 wrap garbage into the outer ring only; each stage's validity shrinks
-by its reach (diff 1 + blur 5 + NMS 1 = 7 < 8 = halo), so the central
-8x8 output block never reads a contaminated voxel.
+by its reach (diff 1 + window blur <= 6 <= 7 < 8 = halo — the bound
+`supports()` enforces), so the central 8x8 output block never reads a
+contaminated voxel.
 
-Outputs are the raw response and the NMS-masked response; subpixel
-fields, thresholding, tile bucketing, and top-k stay in XLA (they are
-elementwise/cheap there). The response at every real voxel matches the
-jnp path exactly up to float summation order; the NMS comparison at
-the volume's 1-voxel border ring is stricter than reduce_window's
--inf padding (the kernel compares against genuine zero-padding
-responses), which is invisible behind the detector's border margin.
+The kernel outputs the six blurred structure-tensor entries; the
+response, NMS, subpixel fields, thresholding, tile bucketing, and
+top-k all stay in XLA (response+NMS are one fused elementwise pass
+there, and keeping them out of the kernel holds VMEM to six slab
+buffers). Every field therefore matches the jnp path exactly up to
+float summation order — no border-semantics differences.
 
 Counterpart of the reference `KeypointExtractor` detect stage for
 config 5 (SURVEY.md §2 — reference source unavailable).
@@ -51,14 +51,22 @@ _BY = 8  # y-strip (and y-halo) size
 _DIFF = (0.5, 0.0, -0.5)  # central difference, correlation form
 
 
-def supports(shape: tuple[int, int, int], window_sigma: float = 1.5) -> bool:
+def supports(
+    shape: tuple[int, int, int],
+    window_sigma: float = 1.5,
+    smooth_sigma: float | None = None,
+) -> bool:
     """Whether the fused kernel handles this volume configuration."""
     blur_r = max(1, int(3.0 * window_sigma + 0.5))
+    if smooth_sigma is not None:
+        if smooth_sigma <= 0.0:
+            return False
+        blur_r = max(blur_r, max(1, int(3.0 * smooth_sigma + 0.5)))
     if 1 + blur_r + 1 > _BZ:  # diff + blur + NMS reach vs halo
         return False
     Wp = -(-(shape[2] + 8) // 128) * 128
-    # 10 slab-sized f32 scratch buffers must fit VMEM with headroom.
-    return 10 * 3 * _BZ * 3 * _BY * Wp * 4 <= 11 * 1024 * 1024
+    # 6 slab-sized f32 scratch buffers must fit VMEM with headroom.
+    return 6 * 3 * _BZ * 3 * _BY * Wp * 4 <= 11 * 1024 * 1024
 
 
 def _roll(a, d: int, axis: int):
@@ -83,14 +91,15 @@ def _acc_corr(dst_ref, src_ref, taps, axis: int):
             dst_ref[...] = dst_ref[...] + term
 
 
-def _structure_kernel(*refs, D: int, H: int, W: int, gauss):
+def _structure_kernel(*refs, D: int, H: int, W: int, gauss, smooth_taps=None):
     """Gradients + 3-axis Gaussian window for the six structure-tensor
     entries, written straight to their output blocks. The response /
     NMS tail runs in XLA — it is a single fused elementwise pass there,
     and keeping it out of the kernel holds the VMEM footprint to six
     slab buffers (entry accumulators in VMEM OOM'd at every staging
     the Mosaic stack allocator was offered)."""
-    ins, outs, scratch = refs[:9], refs[9:15], refs[15:]
+    n_out = 7 if smooth_taps is not None else 6
+    ins, outs, scratch = refs[:9], refs[9 : 9 + n_out], refs[9 + n_out :]
     f, g1, g2, g3, t1, t2 = scratch
     zi = pl.program_id(1)
     yi = pl.program_id(2)
@@ -132,25 +141,41 @@ def _structure_kernel(*refs, D: int, H: int, W: int, gauss):
         _acc_corr(t2, t1, gauss, 1)
         _acc_corr(t1, t2, gauss, 2)
         out[...] = t1[c[0], c[1], c[2]]
+    if smooth_taps is not None:
+        # Free-ride output: the descriptor-stage blur of the volume
+        # itself (ops/describe3d.py), against the resident slab.
+        _acc_corr(t1, f, smooth_taps, 0)
+        _acc_corr(t2, t1, smooth_taps, 1)
+        _acc_corr(t1, t2, smooth_taps, 2)
+        outs[6][...] = t1[c[0], c[1], c[2]]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("harris_k", "window_sigma", "interpret")
+    jax.jit,
+    static_argnames=("harris_k", "window_sigma", "smooth_sigma", "interpret"),
 )
 def response_fields_3d(
     vols: jnp.ndarray,
     harris_k: float = 0.005,
     window_sigma: float = 1.5,
+    smooth_sigma: float | None = None,
     interpret: bool = False,
 ):
     """(resp, nms_resp) for a (B, D, H, W) volume batch, each (B, D, H, W).
 
     nms_resp holds the response at 3x3x3 local maxima and -inf
-    elsewhere (stricter than the jnp path only on the 1-voxel border
-    ring — see module docstring).
+    elsewhere — identical to the jnp path (the NMS runs through the
+    same `_maxpool3_same` on the kernel's response). With
+    `smooth_sigma` a third array is returned: the sigma-blurred volume
+    for the descriptor stage (`gaussian_blur_3d` semantics), a free
+    ride on the resident slab.
     """
     B, D, H, W = vols.shape
     gauss = _gauss_taps(window_sigma)
+    smooth_taps = (
+        _gauss_taps(smooth_sigma) if smooth_sigma is not None else None
+    )
+    n_out = 7 if smooth_taps is not None else 6
     nz = -(-D // _BZ)
     ny = -(-H // _BY)
     Wp = -(-(W + 8) // 128) * 128
@@ -172,24 +197,24 @@ def response_fields_3d(
 
     slab = (3 * _BZ, 3 * _BY, Wp)
     kernel = functools.partial(
-        _structure_kernel, D=D, H=H, W=W, gauss=gauss
+        _structure_kernel, D=D, H=H, W=W, gauss=gauss,
+        smooth_taps=smooth_taps,
     )
     Do, Ho = nz * _BZ, ny * _BY
-    sxx, syy, szz, sxy, sxz, syz = pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid=(B, nz, ny),
         in_specs=[strip_in(dz, dy) for dz in range(3) for dy in range(3)],
         out_specs=[
             pl.BlockSpec((None, _BZ, _BY, W), lambda b, zi, yi: (b, zi, yi, 0))
-            for _ in range(6)
+            for _ in range(n_out)
         ],
-        out_shape=[jax.ShapeDtypeStruct((B, Do, Ho, W), jnp.float32)] * 6,
+        out_shape=[jax.ShapeDtypeStruct((B, Do, Ho, W), jnp.float32)] * n_out,
         scratch_shapes=[pltpu.VMEM(slab, jnp.float32) for _ in range(6)],
         interpret=interpret,
     )(*([padded] * 9))
     sl = np.s_[:, :D, :H]
-    sxx, syy, szz = sxx[sl], syy[sl], szz[sl]
-    sxy, sxz, syz = sxy[sl], sxz[sl], syz[sl]
+    sxx, syy, szz, sxy, sxz, syz = (o[sl] for o in outs[:6])
     # Response + NMS: one fused elementwise pass in XLA.
     det = (
         sxx * (syy * szz - syz * syz)
@@ -203,4 +228,6 @@ def response_fields_3d(
     nms = jnp.where(
         resp >= jax.vmap(_maxpool3_same)(resp), resp, -jnp.inf
     )
+    if smooth_taps is not None:
+        return resp, nms, outs[6][sl]
     return resp, nms

@@ -255,6 +255,132 @@ def extract_blended_planes(
     return pb[:, :K]
 
 
+def _blended3d_kernel(*refs, Pz: int, Pxy: int, KB: int):
+    """KB keypoints per program; input spec j holds keypoint j's
+    (Pz, SY, Wp) slab, Element-indexed at (oz, 8-aligned oy) — the
+    dynamic block indexing that makes per-keypoint 3D extraction
+    possible without the whole flattened volume in VMEM."""
+    # prefetch: oz, oy8 (index maps), ry, ox (kernel); then KB slabs,
+    # fractions, output.
+    ozr, oy8r, ryr, oxr = refs[:4]
+    slabs = refs[4 : 4 + KB]
+    fx_ref, fy_ref, fz_ref = refs[4 + KB : 7 + KB]
+    out_ref = refs[7 + KB]
+    b = pl.program_id(0)
+    kb = pl.program_id(1)
+    Pb = Pxy - 1
+    for j in range(KB):
+        k = kb * KB + j
+        slab = slabs[j][0]  # (Pz, SY, Wp)
+        SY, Wp = slab.shape[1], slab.shape[2]
+        slab = pltpu.roll(slab, SY - ryr[b, k], 1)
+        slab = pltpu.roll(slab, Wp - oxr[b, k], 2)
+        raw = slab[:, :Pxy, :Pxy]  # (Pz, Pxy, Pxy)
+        fx = fx_ref[j, 0]
+        fy = fy_ref[j, 0]
+        fz = fz_ref[j, 0]
+        pb2 = (
+            (1.0 - fy) * (1.0 - fx) * raw[:, :Pb, :Pb]
+            + (1.0 - fy) * fx * raw[:, :Pb, 1:]
+            + fy * (1.0 - fx) * raw[:, 1:, :Pb]
+            + fy * fx * raw[:, 1:, 1:]
+        )  # (Pz, Pb, Pb) in-plane bilinear per slice
+        out_ref[j] = (1.0 - fz) * pb2[: Pz - 1] + fz * pb2[1:]
+
+
+@functools.partial(jax.jit, static_argnames=("Pz", "Pxy", "interpret"))
+def extract_blended_3d(
+    padded: jnp.ndarray,
+    xyz: jnp.ndarray,
+    Pz: int,
+    Pxy: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Keypoint-first trilinear-blended 3D patches.
+
+    padded: (B, Dp, Hp, Wp) volumes edge-padded by (rz+1, rxy+1, rxy+1)
+    (the describe3d convention); xyz: (B, K, 3) subpixel (x, y, z)
+    keypoint positions. Returns (B, K, Pz-1, Pxy-1, Pxy-1) — the
+    trilinear resample of each patch at its keypoint's subpixel
+    fraction (z-lerp of per-slice bilinear blends — the exact
+    decomposition of the 8-corner blend).
+
+    Each keypoint's slab arrives as its own Element-indexed input block
+    (z start exact, y start 8-aligned with the residual rolled out, x
+    selected by a lane roll), so VMEM holds only KB tiny slabs — not
+    the volume.
+    """
+    B, Dp, Hp, Wp0 = padded.shape
+    K = xyz.shape[1]
+    x0 = jnp.floor(xyz[..., 0])
+    y0 = jnp.floor(xyz[..., 1])
+    z0 = jnp.floor(xyz[..., 2])
+    oz = z0.astype(jnp.int32) + 1
+    oy = y0.astype(jnp.int32) + 1
+    ox = x0.astype(jnp.int32) + 1
+    fx = (xyz[..., 0] - x0)[..., None].astype(jnp.float32)
+    fy = (xyz[..., 1] - y0)[..., None].astype(jnp.float32)
+    fz = (xyz[..., 2] - z0)[..., None].astype(jnp.float32)
+    KB = 8
+    if K % KB:
+        pad = KB - K % KB
+        z = jnp.zeros((B, pad), jnp.int32)
+        zf = jnp.zeros((B, pad, 1), jnp.float32)
+        oz = jnp.concatenate([oz, z], axis=1)
+        oy = jnp.concatenate([oy, z], axis=1)
+        ox = jnp.concatenate([ox, z], axis=1)
+        fx = jnp.concatenate([fx, zf], axis=1)
+        fy = jnp.concatenate([fy, zf], axis=1)
+        fz = jnp.concatenate([fz, zf], axis=1)
+    Kp = oz.shape[1]
+    SY = ((Pxy + 7) // 8) * 8 + 8  # aligned rows covering Pxy + residual
+    # Margins for the aligned/over-length reads.
+    Wp = -(-(Wp0 + 128) // 128) * 128
+    padded = jnp.pad(
+        padded,
+        ((0, 0), (0, Pz), (0, SY), (0, Wp - Wp0)),
+        mode="edge",
+    )
+    Dpp, Hpp = padded.shape[1], padded.shape[2]
+    oy8 = oy // 8
+    ry = oy - oy8 * 8
+
+    def slab_spec(j):
+        return pl.BlockSpec(
+            (pl.Element(1), pl.Element(Pz), pl.Element(SY), pl.Element(Wp)),
+            lambda b, kb, ozr, oy8r, ryr, oxr, j=j: (
+                b, ozr[b, kb * KB + j], oy8r[b, kb * KB + j] * 8, 0
+            ),
+        )
+
+    frac_spec = pl.BlockSpec(
+        (None, KB, 1), lambda b, kb, ozr, oy8r, ryr, oxr: (b, kb, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, Kp // KB),
+        in_specs=[slab_spec(j) for j in range(KB)]
+        + [frac_spec, frac_spec, frac_spec],
+        out_specs=pl.BlockSpec(
+            (None, KB, Pz - 1, Pxy - 1, Pxy - 1),
+            lambda b, kb, ozr, oy8r, ryr, oxr: (b, kb, 0, 0, 0),
+        ),
+    )
+    out = pl.pallas_call(
+        functools.partial(_blended3d_kernel, Pz=Pz, Pxy=Pxy, KB=KB),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (B, Kp, Pz - 1, Pxy - 1, Pxy - 1), jnp.float32
+        ),
+        interpret=interpret,
+    )(
+        oz, oy8, ry.astype(jnp.int32), ox,
+        *([padded.astype(jnp.float32)] * KB),
+        fx, fy, fz,
+    )
+    return out[:, :K]
+
+
 @functools.partial(jax.jit, static_argnames=("P", "interpret"))
 def extract_patches(
     padded: jnp.ndarray,
