@@ -48,6 +48,10 @@ class CorrectorConfig:
     # Per-frame Pearson correlation between each corrected frame and the
     # reference (the standard microscopy registration-quality metric);
     # computed on device, reported as diagnostics["template_corr"].
+    # Caveat: the correlation runs over the full frame including
+    # out-of-coverage pixels the warp zeroed, so on data with a large
+    # background offset a big drift depresses the score even when the
+    # registration is exact — read it jointly with n_inliers/warp_ok.
     quality_metrics: bool = False
 
     # -- execution ---------------------------------------------------------
