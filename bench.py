@@ -209,7 +209,7 @@ def main() -> None:
 
     if args.all:
         for model in ("rigid", "affine", "homography", "piecewise"):
-            rr = run(max(256, args.frames // 4), args.size, model, args.batch)
+            rr = run(max(512, args.frames // 2), args.size, model, args.batch)
             print(
                 f"[bench] {model}: {rr['fps']:.1f} fps, rmse {rr['rmse_px']:.3f} px",
                 file=sys.stderr,

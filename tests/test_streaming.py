@@ -67,7 +67,7 @@ def test_cli_info_and_correct(tmp_path):
     opath = tmp_path / "corr.tif"
     args = [
         "correct", str(path), "-o", str(opath), "--transforms", str(tpath),
-        "--model", "translation", "--batch-size", "3",
+        "--model", "translation", "--batch-size", "3", "--quality",
     ]
     out = subprocess.run(
         [sys.executable, "-c", env_script % (args,)],
@@ -76,6 +76,7 @@ def test_cli_info_and_correct(tmp_path):
     assert out.returncode == 0, out.stderr
     summary = json.loads(out.stdout.strip().splitlines()[-1])
     assert summary["output"] == str(opath)
+    assert 0.5 < summary["template_corr_mean"] <= 1.0
     saved = np.load(tpath)
     assert saved["transforms"].shape == (6, 3, 3)
     assert read_stack(opath).shape == data.stack.shape
