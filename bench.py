@@ -36,7 +36,7 @@ import time
 import numpy as np
 
 
-def _build_stack(n_frames: int, size: int, model: str):
+def _build_stack(n_frames: int, size: int, model: str, n_blobs: int | None = None):
     """Synthetic drift stack; generation is host-side and excluded from
     the timed region. For speed, generate `base` frames and tile."""
     from kcmc_tpu.utils.synthetic import (
@@ -54,7 +54,8 @@ def _build_stack(n_frames: int, size: int, model: str):
         )
     else:
         data = make_drift_stack(
-            n_frames=base, shape=(size, size), model=model, max_drift=10.0, seed=0
+            n_frames=base, shape=(size, size), model=model, max_drift=10.0,
+            seed=0, n_blobs=n_blobs,
         )
     return data
 
@@ -73,7 +74,10 @@ def _rmse(data, model, transforms, fields):
     )
 
 
-def run_bench_device(n_frames: int, size: int, model: str, batch: int) -> dict:
+def run_bench_device(
+    n_frames: int, size: int, model: str, batch: int,
+    n_blobs: int | None = None, **mc_overrides,
+) -> dict:
     """Steady-state on-chip throughput: stack resident in HBM, outputs
     stay on device (only the tiny transform matrices come back)."""
     import jax
@@ -81,10 +85,12 @@ def run_bench_device(n_frames: int, size: int, model: str, batch: int) -> dict:
 
     from kcmc_tpu import MotionCorrector
 
-    data = _build_stack(n_frames, size, model)
+    data = _build_stack(n_frames, size, model, n_blobs=n_blobs)
     base = len(data.stack)
     batch = min(batch, n_frames)
-    mc = MotionCorrector(model=model, backend="jax", batch_size=batch)
+    mc = MotionCorrector(
+        model=model, backend="jax", batch_size=batch, **mc_overrides
+    )
     ref = mc.backend.prepare_reference(np.asarray(data.stack[0], np.float32))
     ref = {k: jnp.asarray(v) for k, v in ref.items()}
 
@@ -149,16 +155,21 @@ def run_bench_device(n_frames: int, size: int, model: str, batch: int) -> dict:
     return {"fps": fps, "seconds": dt, "rmse_px": rmse, "n_frames": done}
 
 
-def run_bench_host(n_frames: int, size: int, model: str, batch: int) -> dict:
+def run_bench_host(
+    n_frames: int, size: int, model: str, batch: int,
+    n_blobs: int | None = None, **mc_overrides,
+) -> dict:
     """Host-fed end-to-end path through MotionCorrector.correct."""
     from kcmc_tpu import MotionCorrector
 
-    data = _build_stack(n_frames, size, model)
+    data = _build_stack(n_frames, size, model, n_blobs=n_blobs)
     base = len(data.stack)
     reps = (n_frames + base - 1) // base
     tile_dims = (reps,) + (1,) * (data.stack.ndim - 1)
     stack = np.tile(data.stack, tile_dims)[:n_frames]
-    mc = MotionCorrector(model=model, backend="jax", batch_size=batch)
+    mc = MotionCorrector(
+        model=model, backend="jax", batch_size=batch, **mc_overrides
+    )
     mc.correct(stack[: batch * 2])  # warmup/compile
 
     t0 = time.perf_counter()
@@ -208,29 +219,46 @@ def main() -> None:
     )
 
     if args.all:
-        for model in ("rigid", "affine", "homography", "piecewise"):
-            rr = run(max(512, args.frames // 2), args.size, model, args.batch)
+        # Unified protocol: every sub-config runs the SAME sweep length
+        # as the flagship run (short sub-runs read ~20% low under the
+        # tunneled platform's clock ramp); a 32x256x256 rigid3d volume is
+        # 8x the pixels of a 512x512 frame, so its sweep is frames//8 for
+        # equal pixel work.
+        for label, model, kw in (
+            ("rigid", "rigid", {}),
+            ("affine", "affine", {}),
+            ("affine@2k", "affine", {"max_keypoints": 2048, "n_blobs": 6000}),
+            ("homography", "homography", {}),
+            ("piecewise", "piecewise", {}),
+        ):
+            rr = run(args.frames, args.size, model, args.batch, **kw)
             print(
-                f"[bench] {model}: {rr['fps']:.1f} fps, rmse {rr['rmse_px']:.3f} px",
+                f"[bench] {label}: {rr['fps']:.1f} fps, rmse {rr['rmse_px']:.3f} px",
                 file=sys.stderr,
             )
-        rr = run(64, args.size, "rigid3d", min(args.batch, 8))
+        rr = run(
+            max(64, args.frames // 8), args.size, "rigid3d", min(args.batch, 8)
+        )
         print(
             f"[bench] rigid3d (32x{args.size // 2}x{args.size // 2}): "
             f"{rr['fps']:.1f} vol/s, rmse {rr['rmse_px']:.3f} px",
             file=sys.stderr,
         )
 
+    print(judged_json_line(args.model, args.size, r["fps"]))
+
+
+def judged_json_line(model: str, size: int, fps: float) -> str:
+    """The driver-contract output: ONE JSON line with metric/value/unit/
+    vs_baseline (vs the 200 fps/chip north-star target)."""
     target = 200.0  # frames/sec/chip — BASELINE.json north-star target
-    print(
-        json.dumps(
-            {
-                "metric": f"registration_throughput_{args.model}_{args.size}x{args.size}",
-                "value": round(r["fps"], 2),
-                "unit": "frames/sec/chip",
-                "vs_baseline": round(r["fps"] / target, 3),
-            }
-        )
+    return json.dumps(
+        {
+            "metric": f"registration_throughput_{model}_{size}x{size}",
+            "value": round(fps, 2),
+            "unit": "frames/sec/chip",
+            "vs_baseline": round(fps / target, 3),
+        }
     )
 
 

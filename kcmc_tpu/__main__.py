@@ -86,16 +86,35 @@ def _cmd_correct(args) -> int:
         if "n_inliers" in res.diagnostics
         else None,
     }
-    if "warp_ok" in res.diagnostics:
+    # With rescue_warp on, warp_ok is rewritten to all-True after the
+    # rescue pass; warp_rescued records which frames actually exceeded a
+    # bounded kernel's motion bound, so report from it when present.
+    # After a mid-run escalation the remaining frames run the unbounded
+    # warp and are never tested against the bound, so the count covers
+    # only pre-escalation frames — warp_escalated flags that.
+    if "warp_rescued" in res.diagnostics:
+        summary["warp_flagged_frames"] = int(
+            res.diagnostics["warp_rescued"].sum()
+        )
+    elif "warp_ok" in res.diagnostics:
         summary["warp_flagged_frames"] = int(
             (~res.diagnostics["warp_ok"]).sum()
         )
+    if res.timing.get("warp_escalated"):
+        summary["warp_escalated"] = True
     if "template_corr" in res.diagnostics:
         corr = res.diagnostics["template_corr"]
         summary["template_corr_mean"] = round(float(np.mean(corr)), 4)
         summary["template_corr_min"] = round(float(np.min(corr)), 4)
     print(json.dumps(summary))
     return 0
+
+
+def _cmd_selftest(args) -> int:
+    from kcmc_tpu.selftest import main as selftest_main
+
+    argv = ["--size", str(args.size), "--depth", str(args.depth)]
+    return selftest_main(argv)
 
 
 def main(argv=None) -> int:
@@ -108,6 +127,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("info", help="describe a TIFF stack")
     p.add_argument("stack")
     p.set_defaults(fn=_cmd_info)
+
+    p = sub.add_parser(
+        "selftest",
+        help="on-device kernel parity checks (Pallas vs jnp oracles)",
+    )
+    p.add_argument("--size", type=int, default=512)
+    p.add_argument("--depth", type=int, default=32)
+    p.set_defaults(fn=_cmd_selftest)
 
     p = sub.add_parser("correct", help="register + correct a stack")
     p.add_argument("stack", help="input multi-page TIFF")

@@ -16,6 +16,7 @@ import numpy as np
 
 from kcmc_tpu.ops.patterns import (
     CAND_TILE,
+    WINDOW_SIGMA,
     MOMENTS as _MOMENTS,
     MOMENT_RADIUS as _MOMENT_RADIUS,
     N_BITS,
@@ -23,6 +24,7 @@ from kcmc_tpu.ops.patterns import (
     N_WORDS,
     PATCH_RADIUS,
     PATTERN,
+    PATTERN_3D,
     ROT_PATTERNS,
 )
 
@@ -55,7 +57,7 @@ _SOBEL_X = np.array([[-1, 0, 1], [-2, 0, 2], [-1, 0, 1]], dtype=np.float32) / 8.
 _SOBEL_Y = _SOBEL_X.T
 
 
-def harris_response(img: np.ndarray, k: float = 0.04, window_sigma: float = 1.5) -> np.ndarray:
+def harris_response(img: np.ndarray, k: float = 0.04, window_sigma: float = WINDOW_SIGMA) -> np.ndarray:
     gx = conv2d_same(img, _SOBEL_X)
     gy = conv2d_same(img, _SOBEL_Y)
     ixx = gaussian_blur(gx * gx, window_sigma)
@@ -441,4 +443,201 @@ def warp_frame_flow(frame: np.ndarray, flow: np.ndarray) -> np.ndarray:
     sy = ys + flow[..., 1]
     out = bilinear_sample(frame, sx, sy)
     inb = (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+    return (out * inb).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# 3D volumetric kernels (config 5) — mirror kcmc_tpu/ops/detect3d.py /
+# describe3d.py / warp.py::warp_volume with the same constants so the
+# two backends agree to registration accuracy.
+# ---------------------------------------------------------------------------
+
+
+def _conv3d_axis(vol: np.ndarray, k: np.ndarray, axis: int) -> np.ndarray:
+    """SAME-padded 1D convolution along one axis of a (D, H, W) volume."""
+    taps = len(k)
+    R = taps // 2
+    pad = [(R, taps - 1 - R) if a == axis else (0, 0) for a in range(3)]
+    padded = np.pad(vol, pad)
+    out = np.zeros_like(vol, dtype=np.float32)
+    for i in range(taps):
+        sl = tuple(
+            slice(i, i + vol.shape[a]) if a == axis else slice(None)
+            for a in range(3)
+        )
+        out += np.float32(k[i]) * padded[sl]
+    return out
+
+
+def gaussian_blur_3d(vol: np.ndarray, sigma: float) -> np.ndarray:
+    radius = max(1, int(3.0 * sigma + 0.5))
+    x = np.arange(-radius, radius + 1, dtype=np.float32)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    k /= k.sum()
+    for axis in range(3):
+        vol = _conv3d_axis(vol, k, axis)
+    return vol
+
+
+_DIFF3 = np.array([-0.5, 0.0, 0.5], dtype=np.float32)
+
+
+def harris_response_3d(
+    vol: np.ndarray, k: float = 0.005, window_sigma: float = WINDOW_SIGMA
+) -> np.ndarray:
+    gz = _conv3d_axis(vol, _DIFF3, 0)
+    gy = _conv3d_axis(vol, _DIFF3, 1)
+    gx = _conv3d_axis(vol, _DIFF3, 2)
+    sxx = gaussian_blur_3d(gx * gx, window_sigma)
+    syy = gaussian_blur_3d(gy * gy, window_sigma)
+    szz = gaussian_blur_3d(gz * gz, window_sigma)
+    sxy = gaussian_blur_3d(gx * gy, window_sigma)
+    sxz = gaussian_blur_3d(gx * gz, window_sigma)
+    syz = gaussian_blur_3d(gy * gz, window_sigma)
+    det = (
+        sxx * (syy * szz - syz * syz)
+        - sxy * (sxy * szz - syz * sxz)
+        + sxz * (sxy * syz - syy * sxz)
+    )
+    trace = sxx + syy + szz
+    return det - k * trace * trace * trace
+
+
+def detect_keypoints_3d(
+    vol: np.ndarray,
+    max_keypoints: int = 256,
+    threshold: float = 1e-4,
+    border: int = 6,
+    harris_k: float = 0.005,
+):
+    """Returns (xyz (K,3), score (K,), valid (K,)); same selection rules
+    as ops/detect3d.py (3x3x3 NMS, border-excluded relative threshold,
+    per-(1,8,8)-tile bucketing, per-axis parabola subpixel)."""
+    D, H, W = vol.shape
+    resp = harris_response_3d(vol, k=harris_k)
+    mx = resp
+    for axis in range(3):
+        pad = [(1, 1) if a == axis else (0, 0) for a in range(3)]
+        p = np.pad(mx, pad, constant_values=-np.inf)
+        sl = lambda i: tuple(
+            slice(i, i + resp.shape[a]) if a == axis else slice(None)
+            for a in range(3)
+        )
+        mx = np.maximum(np.maximum(p[sl(0)], p[sl(1)]), p[sl(2)])
+    is_max = resp >= mx
+    zs, ys, xs = np.mgrid[0:D, 0:H, 0:W]
+    bz = min(border, max(1, D // 8))
+    inb = (
+        (zs >= bz) & (zs < D - bz)
+        & (ys >= border) & (ys < H - border)
+        & (xs >= border) & (xs < W - border)
+    )
+    sel = np.where(is_max & inb, resp, -np.inf)
+    peak = max(sel.max(), 1e-12)
+    cand = is_max & inb & (resp > threshold * peak)
+    masked = np.where(cand, resp, -np.inf)
+
+    T = 8
+    Hp, Wp = -(-H // T) * T, -(-W // T) * T
+    m = np.full((D, Hp, Wp), -np.inf, np.float32)
+    m[:, :H, :W] = masked
+    tiles = m.reshape(D, Hp // T, T, Wp // T, T).transpose(0, 1, 3, 2, 4)
+    tiles = tiles.reshape(D, Hp // T, Wp // T, T * T)
+    tile_val = tiles.max(-1)
+    tile_arg = tiles.argmax(-1)
+    k_ = min(max_keypoints, tile_val.size)
+    order = np.argsort(-tile_val.ravel(), kind="stable")[:k_]
+    scores = tile_val.ravel()[order]
+    if k_ < max_keypoints:
+        pad = max_keypoints - k_
+        scores = np.concatenate([scores, np.full(pad, -np.inf, np.float32)])
+        order = np.concatenate([order, np.zeros(pad, order.dtype)])
+    valid = np.isfinite(scores)
+    within = tile_arg.ravel()[order]
+    th, tw = tile_val.shape[1], tile_val.shape[2]
+    iz = order // (th * tw)
+    iy = ((order // tw) % th) * T + within // T
+    ix = (order % tw) * T + within % T
+    iy = np.clip(iy, 0, H - 1)
+    ix = np.clip(ix, 0, W - 1)
+
+    cz = np.clip(iz, 1, D - 2)
+    cy = np.clip(iy, 1, H - 2)
+    cx = np.clip(ix, 1, W - 2)
+    c = resp[cz, cy, cx]
+
+    def axis_off(plus, minus):
+        d1 = 0.5 * (plus - minus)
+        d2 = plus - 2.0 * c + minus
+        with np.errstate(divide="ignore", invalid="ignore"):
+            o = np.where(np.abs(d2) > 1e-8, -d1 / d2, 0.0)
+        return np.clip(o, -0.5, 0.5)
+
+    ox = axis_off(resp[cz, cy, cx + 1], resp[cz, cy, cx - 1])
+    oy = axis_off(resp[cz, cy + 1, cx], resp[cz, cy - 1, cx])
+    oz = axis_off(resp[cz + 1, cy, cx], resp[cz - 1, cy, cx])
+    xyz = np.stack(
+        [ix + ox, iy + oy, iz + oz], axis=-1
+    ).astype(np.float32)
+    xyz = np.where(valid[:, None], xyz, 0.0).astype(np.float32)
+    scores = np.where(valid, scores, 0.0).astype(np.float32)
+    return xyz, scores, valid
+
+
+def trilinear_sample(vol: np.ndarray, x, y, z) -> np.ndarray:
+    """Edge-clamped trilinear sampling of a (D, H, W) volume."""
+    D, H, W = vol.shape
+    x = np.clip(x, 0.0, W - 1.0)
+    y = np.clip(y, 0.0, H - 1.0)
+    z = np.clip(z, 0.0, D - 1.0)
+    x0 = np.floor(x).astype(np.int32)
+    y0 = np.floor(y).astype(np.int32)
+    z0 = np.floor(z).astype(np.int32)
+    fx, fy, fz = x - x0, y - y0, z - z0
+    x1 = np.minimum(x0 + 1, W - 1)
+    y1 = np.minimum(y0 + 1, H - 1)
+    z1 = np.minimum(z0 + 1, D - 1)
+    return (
+        vol[z0, y0, x0] * (1 - fx) * (1 - fy) * (1 - fz)
+        + vol[z0, y0, x1] * fx * (1 - fy) * (1 - fz)
+        + vol[z0, y1, x0] * (1 - fx) * fy * (1 - fz)
+        + vol[z0, y1, x1] * fx * fy * (1 - fz)
+        + vol[z1, y0, x0] * (1 - fx) * (1 - fy) * fz
+        + vol[z1, y0, x1] * fx * (1 - fy) * fz
+        + vol[z1, y1, x0] * (1 - fx) * fy * fz
+        + vol[z1, y1, x1] * fx * fy * fz
+    ).astype(np.float32)
+
+
+def describe_keypoints_3d(
+    vol: np.ndarray, xyz: np.ndarray, valid: np.ndarray, blur_sigma: float = 2.0
+) -> np.ndarray:
+    """(K, N_WORDS) 3D-BRIEF descriptors — same PATTERN_3D constant and
+    comparison rule as ops/describe3d.py."""
+    smooth = gaussian_blur_3d(vol, blur_sigma)
+    K = xyz.shape[0]
+    pos = xyz[:, None, None, :] + PATTERN_3D[None]  # (K, N_BITS, 2, 3)
+    vals = trilinear_sample(smooth, pos[..., 0], pos[..., 1], pos[..., 2])
+    bits = (vals[..., 0] < vals[..., 1]).astype(np.uint32)  # (K, N_BITS)
+    b = bits.reshape(K, N_WORDS, 32)
+    desc = (
+        (b << np.arange(32, dtype=np.uint32)[None, None, :]).sum(-1)
+    ).astype(np.uint32)
+    desc[~valid] = 0
+    return desc
+
+
+def warp_volume(vol: np.ndarray, M: np.ndarray) -> np.ndarray:
+    """Trilinear inverse warp of a (D, H, W) volume through a 4x4
+    transform (ref -> frame coords, acting on (x, y, z))."""
+    D, H, W = vol.shape
+    zs, ys, xs = np.mgrid[0:D, 0:H, 0:W].astype(np.float32)
+    sx = M[0, 0] * xs + M[0, 1] * ys + M[0, 2] * zs + M[0, 3]
+    sy = M[1, 0] * xs + M[1, 1] * ys + M[1, 2] * zs + M[1, 3]
+    sz = M[2, 0] * xs + M[2, 1] * ys + M[2, 2] * zs + M[2, 3]
+    out = trilinear_sample(vol, sx, sy, sz)
+    inb = (
+        (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+        & (sz >= 0) & (sz <= D - 1)
+    )
     return (out * inb).astype(np.float32)

@@ -34,18 +34,49 @@ from kcmc_tpu.ops.warp import warp_batch_with_ok, warp_frame_flow, warp_volume
 
 
 @jax.jit
-def _template_corr(corrected: jnp.ndarray, ref_frame: jnp.ndarray) -> jnp.ndarray:
+def _template_corr(
+    corrected: jnp.ndarray, ref_frame: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
     """Per-frame Pearson correlation against the reference — the
-    standard registration-quality diagnostic. Frames a bounded warp
-    zeroed read ~0 here; the corrector recomputes after a rescue."""
+    standard registration-quality diagnostic — computed over the warp
+    coverage mask, so the zeros the warp wrote outside its coverage
+    never depress the score (a large exactly-corrected drift scores the
+    same ~1.0 as a small one). Frames a bounded warp zeroed entirely
+    read ~0 here; the corrector recomputes after a rescue."""
     axes = tuple(range(1, corrected.ndim))
-    c = corrected - jnp.mean(corrected, axis=axes, keepdims=True)
-    r = ref_frame - jnp.mean(ref_frame)
+    m = mask.astype(corrected.dtype)
+    n = jnp.maximum(jnp.sum(m, axis=axes, keepdims=True), 1.0)
+    cm = jnp.sum(corrected * m, axis=axes, keepdims=True) / n
+    rm = jnp.sum(ref_frame * m, axis=axes, keepdims=True) / n
+    c = (corrected - cm) * m
+    r = (ref_frame - rm) * m
     num = jnp.sum(c * r, axis=axes)
-    den = jnp.sqrt(
-        jnp.sum(c * c, axis=axes) * jnp.sum(r * r)
-    )
+    den = jnp.sqrt(jnp.sum(c * c, axis=axes) * jnp.sum(r * r, axis=axes))
     return num / jnp.maximum(den, 1e-12)
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def _coverage_matrix(transforms: jnp.ndarray, shape) -> jnp.ndarray:
+    from kcmc_tpu.ops.warp import coverage_mask
+
+    return jax.vmap(lambda M: coverage_mask(shape, M))(transforms)
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def _coverage_matrix3d(transforms: jnp.ndarray, shape) -> jnp.ndarray:
+    from kcmc_tpu.ops.warp import coverage_mask_3d
+
+    return jax.vmap(lambda M: coverage_mask_3d(shape, M))(transforms)
+
+
+@functools.partial(jax.jit, static_argnames=("shape",))
+def _coverage_field(fields: jnp.ndarray, shape) -> jnp.ndarray:
+    from kcmc_tpu.ops.piecewise import upsample_field
+    from kcmc_tpu.ops.warp import coverage_mask_flow
+
+    return jax.vmap(
+        lambda f: coverage_mask_flow(upsample_field(f, shape))
+    )(fields)
 
 
 @register_backend("jax")
@@ -125,8 +156,17 @@ class JaxBackend:
             and ref.get("frame") is not None
         ):
             out = dict(out)
+            if "field" in out:
+                mask = _coverage_field(out["field"], shape)
+            elif out["transform"].shape[-1] == 4:
+                mask = _coverage_matrix3d(out["transform"], shape)
+            else:
+                mask = _coverage_matrix(out["transform"], shape)
             out["template_corr"] = _template_corr(
-                out["corrected"], ref["frame"]
+                out["corrected"], ref["frame"], mask
+            )
+            out["coverage"] = jnp.mean(
+                mask.astype(jnp.float32), axis=tuple(range(1, mask.ndim))
             )
         if to_host:
             for v in out.values():  # start D2H copies in the background
@@ -167,7 +207,7 @@ class JaxBackend:
             flow_warp = self._resolve_flow_warp()
         else:
             model = get_model(cfg.model)
-            batch_warp = self._resolve_batch_warp()
+            batch_warp = self._resolve_batch_warp(shape)
 
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
@@ -338,7 +378,21 @@ class JaxBackend:
         # via TPU Mosaic). "axon" is this image's tunneled-TPU platform.
         return jax.default_backend() in ("tpu", "axon")
 
-    def _resolve_batch_warp(self):
+    def _shear_bound_px(self, shape) -> int:
+        """The separable warp's static shear bound for this frame shape:
+        `max_rotation_deg` (ergonomic, per-shape) wins over the raw
+        `max_shear_px` pixel knob when set."""
+        cfg = self.config
+        if cfg.max_rotation_deg is None:
+            return cfg.max_shear_px
+        import math
+
+        side = max(shape)
+        return int(
+            math.ceil(math.tan(math.radians(cfg.max_rotation_deg)) * side / 2.0)
+        )
+
+    def _resolve_batch_warp(self, shape):
         """Pick the batched warp implementation per the `warp` policy.
 
         Returns fn(frames (B,H,W), transforms (B,3,3)) ->
@@ -364,14 +418,16 @@ class JaxBackend:
             from kcmc_tpu.ops.warp_separable import warp_batch_affine
 
             return functools.partial(
-                warp_batch_affine, shear_px=cfg.max_shear_px, with_ok=True
+                warp_batch_affine,
+                shear_px=self._shear_bound_px(shape),
+                with_ok=True,
             )
         if cfg.warp == "auto" and cfg.model == "homography" and on_tpu:
             from kcmc_tpu.ops.warp_field import warp_batch_homography
 
             return functools.partial(
                 warp_batch_homography,
-                shear_px=cfg.max_shear_px,
+                shear_px=self._shear_bound_px(shape),
                 max_px=cfg.max_projective_px,
                 with_ok=True,
             )

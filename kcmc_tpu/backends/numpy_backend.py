@@ -16,16 +16,72 @@ from kcmc_tpu.backends import _np_kernels as K
 from kcmc_tpu.config import CorrectorConfig
 
 
-def template_corr_np(corrected: np.ndarray, ref_frame: np.ndarray) -> np.ndarray:
-    """Per-frame Pearson correlation against the reference (NumPy
-    mirror of the jax backend's quality metric; also used by the
-    corrector to refresh rescued frames)."""
+def template_corr_np(
+    corrected: np.ndarray, ref_frame: np.ndarray, mask: np.ndarray | None = None
+) -> np.ndarray:
+    """Per-frame Pearson correlation against the reference, over the
+    warp-coverage mask (NumPy mirror of the jax backend's quality
+    metric; also used by the corrector to refresh rescued frames)."""
     axes = tuple(range(1, corrected.ndim))
-    c = corrected - corrected.mean(axis=axes, keepdims=True)
-    r = ref_frame - ref_frame.mean()
+    if mask is None:
+        mask = np.ones(corrected.shape, bool)
+    m = mask.astype(corrected.dtype)
+    n = np.maximum(m.sum(axis=axes, keepdims=True), 1.0)
+    cm = (corrected * m).sum(axis=axes, keepdims=True) / n
+    rm = (ref_frame * m).sum(axis=axes, keepdims=True) / n
+    c = (corrected - cm) * m
+    r = (ref_frame - rm) * m
     num = (c * r).sum(axis=axes)
-    den = np.sqrt((c * c).sum(axis=axes) * (r * r).sum())
+    den = np.sqrt((c * c).sum(axis=axes) * (r * r).sum(axis=axes))
     return (num / np.maximum(den, 1e-12)).astype(np.float32)
+
+
+def _coverage_mask_np(shape, M: np.ndarray) -> np.ndarray:
+    """In-bounds source-sample mask of the 2D matrix warp (NumPy mirror
+    of ops/warp.coverage_mask)."""
+    H, W = shape
+    ys, xs = np.mgrid[0:H, 0:W].astype(np.float32)
+    w = M[2, 0] * xs + M[2, 1] * ys + M[2, 2]
+    w = np.where(np.abs(w) < 1e-8, 1e-8, w)
+    sx = (M[0, 0] * xs + M[0, 1] * ys + M[0, 2]) / w
+    sy = (M[1, 0] * xs + M[1, 1] * ys + M[1, 2]) / w
+    return (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+
+
+def _coverage_mask_3d_np(shape, M: np.ndarray) -> np.ndarray:
+    D, H, W = shape
+    zs, ys, xs = np.mgrid[0:D, 0:H, 0:W].astype(np.float32)
+    sx = M[0, 0] * xs + M[0, 1] * ys + M[0, 2] * zs + M[0, 3]
+    sy = M[1, 0] * xs + M[1, 1] * ys + M[1, 2] * zs + M[1, 3]
+    sz = M[2, 0] * xs + M[2, 1] * ys + M[2, 2] * zs + M[2, 3]
+    return (
+        (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+        & (sz >= 0) & (sz <= D - 1)
+    )
+
+
+def coverage_masks_np(shape, out: dict) -> np.ndarray:
+    """Per-frame warp-coverage masks from a batch's transform/field
+    outputs (host side): (n, *shape) bool. Dispatches on the model
+    family — dense flow for piecewise fields, 4x4 volumetric or 3x3
+    planar matrices otherwise."""
+    if "field" in out:
+        from kcmc_tpu.utils.synthetic import upsample_field
+
+        masks = []
+        for f in np.asarray(out["field"], np.float32):
+            flow = upsample_field(f, shape)
+            ys, xs = np.mgrid[0 : shape[0], 0 : shape[1]].astype(np.float32)
+            sx = xs + flow[..., 0]
+            sy = ys + flow[..., 1]
+            masks.append(
+                (sx >= 0) & (sx <= shape[1] - 1)
+                & (sy >= 0) & (sy <= shape[0] - 1)
+            )
+        return np.stack(masks)
+    Ms = np.asarray(out["transform"], np.float32)
+    fn = _coverage_mask_3d_np if Ms.shape[-1] == 4 else _coverage_mask_np
+    return np.stack([fn(shape, M) for M in Ms])
 
 
 @register_backend("numpy")
@@ -34,16 +90,21 @@ class NumpyBackend:
 
     def __init__(self, config: CorrectorConfig, **_options):
         self.config = config
-        if config.model == "rigid3d":
-            raise NotImplementedError(
-                "numpy backend: 3D volumetric path not yet implemented; "
-                "use backend='jax'"
-            )
 
     def prepare_reference(self, ref_frame: np.ndarray) -> dict:
         cfg = self.config
-        if ref_frame.ndim != 2:
-            raise NotImplementedError("numpy backend supports 2D frames")
+        if ref_frame.ndim == 3:
+            frame = np.asarray(ref_frame, np.float32)
+            xyz, score, valid = K.detect_keypoints_3d(
+                frame,
+                max_keypoints=cfg.max_keypoints,
+                threshold=cfg.detect_threshold,
+                border=min(cfg.border, min(frame.shape) // 4),
+            )
+            desc = K.describe_keypoints_3d(
+                frame, xyz, valid, blur_sigma=cfg.blur_sigma
+            )
+            return {"xy": xyz, "desc": desc, "valid": valid, "frame": frame}
         xy, score, valid = K.detect_keypoints(
             np.asarray(ref_frame, np.float32),
             max_keypoints=cfg.max_keypoints,
@@ -73,9 +134,13 @@ class NumpyBackend:
             self._process_one(np.asarray(frame, np.float32), int(gidx), ref, out)
         merged = {k: np.stack(v) for k, v in out.items()}
         if cfg.quality_metrics and "corrected" in merged and "frame" in ref:
+            masks = coverage_masks_np(merged["corrected"].shape[1:], merged)
             merged["template_corr"] = template_corr_np(
-                merged["corrected"], ref["frame"]
+                merged["corrected"], ref["frame"], masks
             )
+            merged["coverage"] = masks.mean(
+                axis=tuple(range(1, masks.ndim))
+            ).astype(np.float32)
         return merged
 
     def _keys(self):
@@ -87,6 +152,9 @@ class NumpyBackend:
 
     def _process_one(self, frame, gidx, ref, out):
         cfg = self.config
+        if frame.ndim == 3:
+            self._process_one_3d(frame, gidx, ref, out)
+            return
         xy, score, valid = K.detect_keypoints(
             frame,
             max_keypoints=cfg.max_keypoints,
@@ -135,6 +203,48 @@ class NumpyBackend:
             out["corrected"].append(K.warp_frame(frame, M))
             out["n_inliers"].append(np.int32(n_in))
             out["rms_residual"].append(np.float32(rms))
+
+    def _process_one_3d(self, frame, gidx, ref, out):
+        """Volumetric (rigid3d) mirror of the jax backend's 3D tail."""
+        cfg = self.config
+        xyz, score, valid = K.detect_keypoints_3d(
+            frame,
+            max_keypoints=cfg.max_keypoints,
+            threshold=cfg.detect_threshold,
+            border=min(cfg.border, min(frame.shape) // 4),
+        )
+        desc = K.describe_keypoints_3d(
+            frame, xyz, valid, blur_sigma=cfg.blur_sigma
+        )
+        idx, dist, second, ok = K.knn_match(
+            desc,
+            ref["desc"],
+            valid,
+            ref["valid"],
+            ratio=cfg.ratio,
+            max_dist=cfg.max_hamming,
+            mutual=cfg.mutual,
+        )
+        src = ref["xy"][idx]
+        dst = xyz
+        rng = np.random.default_rng([cfg.seed, gidx])
+        out["n_keypoints"].append(np.int32(valid.sum()))
+        out["n_matches"].append(np.int32(ok.sum()))
+        out["warp_ok"].append(np.bool_(True))  # gather warp: unbounded
+        M, n_in, inl, rms = K.ransac_estimate(
+            cfg.model,
+            src,
+            dst,
+            ok,
+            rng,
+            n_hypotheses=cfg.n_hypotheses,
+            threshold=cfg.inlier_threshold,
+            refine_iters=cfg.refine_iters,
+        )
+        out["transform"].append(M)
+        out["corrected"].append(K.warp_volume(frame, M))
+        out["n_inliers"].append(np.int32(n_in))
+        out["rms_residual"].append(np.float32(rms))
 
     def _estimate_field(self, src, dst, ok, rng, shape):
         """Mirror of ops/piecewise.estimate_field in NumPy."""

@@ -46,12 +46,14 @@ class CorrectorConfig:
 
     # -- diagnostics -------------------------------------------------------
     # Per-frame Pearson correlation between each corrected frame and the
-    # reference (the standard microscopy registration-quality metric);
-    # computed on device, reported as diagnostics["template_corr"].
-    # Caveat: the correlation runs over the full frame including
-    # out-of-coverage pixels the warp zeroed, so on data with a large
-    # background offset a big drift depresses the score even when the
-    # registration is exact — read it jointly with n_inliers/warp_ok.
+    # reference (the standard microscopy registration-quality metric),
+    # computed on device over the warp-coverage mask — pixels whose
+    # source sample was in-bounds — so the zeros the warp writes outside
+    # its coverage never depress the score (exact registration scores
+    # ~1.0 regardless of drift size or background offset). Reported as
+    # diagnostics["template_corr"], alongside diagnostics["coverage"]
+    # (per-frame in-coverage pixel fraction — low values mean little
+    # frame overlap and a correlation estimated from few pixels).
     quality_metrics: bool = False
 
     # -- execution ---------------------------------------------------------
@@ -77,6 +79,21 @@ class CorrectorConfig:
     # (covers ~|tan(rotation)| * frame_side/2; 8 px ~ 1.8 deg at 512 —
     # raise it for larger rotations at a linear cost in the shear pass).
     max_shear_px: int = 8
+    # Rotation bound in DEGREES — the ergonomic alternative to
+    # max_shear_px. When set, the separable/homography warp's shear
+    # bound is derived per frame shape as ceil(tan(deg) * side/2), so
+    # "my stack rotates up to 4 deg" needs no pixel arithmetic.
+    max_rotation_deg: float | None = None
+    # Out-of-bound telemetry: warn when more than this fraction of
+    # processed frames exceeded a bounded warp kernel's static motion
+    # bound (each such frame pays the slow per-frame exact-warp rescue).
+    rescue_warn_fraction: float = 0.25
+    # Auto-escalation: when the warn threshold trips, switch the
+    # REMAINING batches to the exact unbounded warp (one recompile,
+    # then full-batch speed) instead of rescuing frame by frame.
+    # Corrected output is identical either way — the rescue path uses
+    # the same exact warp; only the throughput differs.
+    rescue_escalate: bool = True
     # Static bound on the field warp's residual displacement after the
     # mean translation is factored out (piecewise-rigid local motion).
     max_flow_px: int = 6
@@ -88,6 +105,19 @@ class CorrectorConfig:
         if self.blur_sigma <= 0.0:
             raise ValueError(
                 f"blur_sigma must be positive, got {self.blur_sigma}"
+            )
+        if self.max_rotation_deg is not None and not (
+            0.0 < self.max_rotation_deg < 45.0
+        ):
+            raise ValueError(
+                "max_rotation_deg must be in (0, 45) — beyond that the "
+                "separable shear decomposition degrades; use warp='jnp' "
+                f"for extreme rotations (got {self.max_rotation_deg})"
+            )
+        if not 0.0 < self.rescue_warn_fraction <= 1.0:
+            raise ValueError(
+                "rescue_warn_fraction must be in (0, 1], got "
+                f"{self.rescue_warn_fraction}"
             )
         if self.warp not in ("auto", "jnp", "pallas", "separable"):
             raise ValueError(

@@ -105,6 +105,12 @@ class MotionCorrector:
             if template_window is not None
             else max(reference_window, 32)
         )
+        # Out-of-bound warp telemetry (reset per dispatch run).
+        self._escalation_backend = None
+        self._rescue_seen = 0
+        self._rescue_count = 0
+        self._escalated = False
+        self._rescue_warned = False
 
     # ------------------------------------------------------------------
 
@@ -277,12 +283,14 @@ class MotionCorrector:
             )
         transforms = merged.pop("transform", None)
         fields = merged.pop("field", None)
+        timing = timer.report(n_frames=len(indices))
+        timing["warp_escalated"] = self._escalated
         return CorrectionResult(
             corrected=corrected,
             transforms=transforms,
             fields=fields,
             diagnostics=merged,
-            timing=timer.report(n_frames=len(indices)),
+            timing=timing,
         )
 
     @staticmethod
@@ -318,10 +326,24 @@ class MotionCorrector:
         them); off, drain gets None and in-flight batches don't pin
         ~depth extra batch arrays alive. `to_host=False` skips the
         eager device->host copies (device-resident output pipelines).
+
+        The out-of-bound telemetry (`_maybe_escalate`) can flip the
+        run to the unbounded-warp backend mid-stream: the backend is
+        re-resolved per batch, so batches dispatched after the flip
+        take the exact warp at full batch speed (already-in-flight
+        bounded batches still rescue frame by frame). Corrected output
+        is identical either way — only throughput changes.
         """
-        dispatch = getattr(self.backend, "process_batch_async", None)
+        self._rescue_seen = 0
+        self._rescue_count = 0
+        self._escalated = False
+        self._rescue_warned = False
         inflight: list[tuple[int, dict, Any]] = []
         for n, batch, idx in batches:
+            backend = (
+                self._get_escalation_backend() if self._escalated else self.backend
+            )
+            dispatch = getattr(backend, "process_batch_async", None)
             kept = batch if keep_frames else None
             if dispatch is not None:
                 # Only pass to_host when overriding its default: plugin
@@ -336,9 +358,65 @@ class MotionCorrector:
                 if len(inflight) >= depth:
                     drain(inflight.pop(0))
             else:
-                drain((n, self.backend.process_batch(batch, ref, idx), kept))
+                drain((n, backend.process_batch(batch, ref, idx), kept))
         for entry in inflight:
             drain(entry)
+
+    def _get_escalation_backend(self):
+        """The same backend with `warp="jnp"` (exact, unbounded) — built
+        lazily the first time out-of-bound escalation trips."""
+        if self._escalation_backend is None:
+            cfg = self.config.replace(warp="jnp")
+            mesh = getattr(self.backend, "mesh", None)
+            options = {"mesh": mesh} if mesh is not None else {}
+            self._escalation_backend = get_backend(
+                self.backend_name, cfg, **options
+            )
+        return self._escalation_backend
+
+    def _maybe_escalate(self) -> None:
+        """Out-of-bound policy: when more than `rescue_warn_fraction` of
+        the frames seen so far exceeded a bounded warp kernel's motion
+        bound, warn — the per-frame rescue path is a silent many-x
+        throughput cliff — and (with `rescue_escalate`) switch the
+        remaining batches to the exact unbounded warp."""
+        cfg = self.config
+        if self._rescue_warned or self._rescue_seen < cfg.batch_size:
+            return
+        frac = self._rescue_count / max(self._rescue_seen, 1)
+        if frac <= cfg.rescue_warn_fraction:
+            return
+        import warnings
+
+        self._rescue_warned = True
+        detail = (
+            f"{self._rescue_count}/{self._rescue_seen} frames "
+            f"({100.0 * frac:.0f}%) exceeded the bounded warp kernel's "
+            "static motion bound and took the per-frame exact-warp "
+            "rescue path"
+        )
+        can_escalate = (
+            cfg.rescue_escalate
+            and getattr(self.backend, "process_batch_async", None) is not None
+        )
+        if can_escalate:
+            self._escalated = True
+            warnings.warn(
+                f"kcmc: {detail}; switching the remaining batches to the "
+                "exact unbounded warp (one recompile, then full batch "
+                "speed). Raise max_shear_px / set max_rotation_deg to "
+                "keep such stacks on the fast bounded kernels.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        else:
+            warnings.warn(
+                f"kcmc: {detail}. Use warp='jnp', or raise max_shear_px / "
+                "set max_rotation_deg, for stacks with persistently "
+                "large motion.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     def _rescue_flagged(self, host: dict, batch, n: int, ref=None) -> None:
         """Re-warp frames a bounded kernel zeroed (`warp_ok` False)
@@ -350,6 +428,9 @@ class MotionCorrector:
             return
         ok = np.asarray(ok, bool)
         host["warp_rescued"] = ~ok
+        self._rescue_seen += len(ok)
+        self._rescue_count += int((~ok).sum())
+        self._maybe_escalate()
         if ok.all() or "corrected" not in host:
             return
         bad = np.nonzero(~ok)[0]
@@ -366,11 +447,15 @@ class MotionCorrector:
         host["corrected"] = corrected
         host["warp_ok"] = np.ones_like(ok)
         if "template_corr" in host and ref is not None and "frame" in ref:
-            from kcmc_tpu.backends.numpy_backend import template_corr_np
+            from kcmc_tpu.backends.numpy_backend import (
+                coverage_masks_np,
+                template_corr_np,
+            )
 
             corr = np.array(host["template_corr"])
+            masks = coverage_masks_np(corrected.shape[1:], sub)
             corr[bad] = template_corr_np(
-                corrected[bad], np.asarray(ref["frame"], np.float32)
+                corrected[bad], np.asarray(ref["frame"], np.float32), masks
             )
             host["template_corr"] = corr
 
@@ -495,10 +580,14 @@ class MotionCorrector:
         corrected = merged.pop(
             "corrected", np.empty((0,) + ts.frame_shape, np.float32)
         )
+        timing = timer.report(
+            n_frames=sum(len(o.get("n_inliers", [])) for o in outs)
+        )
+        timing["warp_escalated"] = self._escalated
         return CorrectionResult(
             corrected=corrected,
             transforms=merged.pop("transform", None),
             fields=merged.pop("field", None),
             diagnostics=merged,
-            timing=timer.report(n_frames=sum(len(o.get("n_inliers", [])) for o in outs)),
+            timing=timing,
         )

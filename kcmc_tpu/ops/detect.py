@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from kcmc_tpu.ops.patterns import CAND_TILE
+from kcmc_tpu.ops.patterns import CAND_TILE, WINDOW_SIGMA
 
 
 class Keypoints(NamedTuple):
@@ -87,7 +87,7 @@ _SOBEL_DIFF = jnp.array([-1.0, 0.0, 1.0], dtype=jnp.float32) / 2.0
 
 
 def harris_response(
-    img: jnp.ndarray, k: float = 0.04, window_sigma: float = 1.5
+    img: jnp.ndarray, k: float = 0.04, window_sigma: float = WINDOW_SIGMA
 ) -> jnp.ndarray:
     """Harris corner response R = det(M) - k * trace(M)^2 per pixel.
 
@@ -272,7 +272,7 @@ def detect_keypoints_batch(
         # border >= 1: the kernel's subpixel fields differ from the jnp
         # path on the 1-px frame boundary (zero- vs edge-extension);
         # border=0 keypoints could land there, so take the jnp route.
-        if border >= 1 and supports((H, W), nms_size, 1.5, smooth_sigma):
+        if border >= 1 and supports((H, W), nms_size, WINDOW_SIGMA, smooth_sigma):
             out = response_fields(
                 frames, harris_k=harris_k, nms_size=nms_size,
                 smooth_sigma=smooth_sigma, interpret=interpret,

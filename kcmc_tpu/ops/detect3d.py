@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from kcmc_tpu.ops.detect import Keypoints
+from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 
 
 def _conv3d_axis(vol: jnp.ndarray, k: jnp.ndarray, axis: int) -> jnp.ndarray:
@@ -58,7 +59,7 @@ def gaussian_blur_3d(vol: jnp.ndarray, sigma: float) -> jnp.ndarray:
 _DIFF = jnp.array([-0.5, 0.0, 0.5], dtype=jnp.float32)
 
 
-def harris_response_3d(vol: jnp.ndarray, k: float = 0.005, window_sigma: float = 1.5) -> jnp.ndarray:
+def harris_response_3d(vol: jnp.ndarray, k: float = 0.005, window_sigma: float = WINDOW_SIGMA) -> jnp.ndarray:
     gz = _conv3d_axis(vol, _DIFF, 0)
     gy = _conv3d_axis(vol, _DIFF, 1)
     gx = _conv3d_axis(vol, _DIFF, 2)

@@ -53,6 +53,8 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -70,7 +72,7 @@ _DF = (0.5, 0.0, -0.5)
 def supports(
     shape: tuple[int, int],
     nms_size: int = 5,
-    window_sigma: float = 1.5,
+    window_sigma: float = WINDOW_SIGMA,
     smooth_sigma: float | None = None,
 ) -> bool:
     """Whether the strip kernel can run this configuration.
@@ -226,7 +228,7 @@ def response_fields(
     frames: jnp.ndarray,
     harris_k: float = 0.04,
     nms_size: int = 5,
-    window_sigma: float = 1.5,
+    window_sigma: float = WINDOW_SIGMA,
     smooth_sigma: float | None = None,
     interpret: bool = False,
 ):

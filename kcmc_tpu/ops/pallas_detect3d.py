@@ -41,6 +41,8 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -53,7 +55,7 @@ _DIFF = (0.5, 0.0, -0.5)  # central difference, correlation form
 
 def supports(
     shape: tuple[int, int, int],
-    window_sigma: float = 1.5,
+    window_sigma: float = WINDOW_SIGMA,
     smooth_sigma: float | None = None,
 ) -> bool:
     """Whether the fused kernel handles this volume configuration."""
@@ -157,7 +159,7 @@ def _structure_kernel(*refs, D: int, H: int, W: int, gauss, smooth_taps=None):
 def response_fields_3d(
     vols: jnp.ndarray,
     harris_k: float = 0.005,
-    window_sigma: float = 1.5,
+    window_sigma: float = WINDOW_SIGMA,
     smooth_sigma: float | None = None,
     interpret: bool = False,
 ):

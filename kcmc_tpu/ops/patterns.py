@@ -18,6 +18,9 @@ N_ORIENT_BINS = 16  # orientation quantization (22.5 deg, ORB-style)
 ROT_RADIUS = 15  # rotated-pattern support radius (rotated offsets clipped)
 CAND_TILE = 8  # detector candidate-reduction tile side (one keypoint/tile);
 # shared so both backends bucket candidates into the same grid
+WINDOW_SIGMA = 1.5  # Harris structure-tensor window sigma — shared by the
+# jnp/NumPy responses and the fused Pallas kernels (their supports() gate
+# sizes VMEM slabs from it), so the paths cannot silently desync
 
 # 3D descriptor support (anisotropic: z-stacks are shallow)
 RADIUS_XY = 9.0

@@ -99,6 +99,32 @@ def coverage_mask(shape: tuple[int, int], M: jnp.ndarray) -> jnp.ndarray:
     return (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
 
 
+def coverage_mask_flow(flow: jnp.ndarray) -> jnp.ndarray:
+    """Coverage of the dense-flow warp: pixels whose sample p + u(p) is
+    in-bounds. flow is (H, W, 2)."""
+    H, W = flow.shape[:2]
+    xs, ys = _grid((H, W))
+    sx = xs + flow[..., 0]
+    sy = ys + flow[..., 1]
+    return (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+
+
+def coverage_mask_3d(shape: tuple[int, int, int], M: jnp.ndarray) -> jnp.ndarray:
+    """Coverage of the volumetric warp: voxels whose source sample is
+    in-bounds under the 4x4 transform (same map as warp_volume)."""
+    D, H, W = shape
+    zs = jnp.arange(D, dtype=jnp.float32)[:, None, None]
+    ys = jnp.arange(H, dtype=jnp.float32)[None, :, None]
+    xs = jnp.arange(W, dtype=jnp.float32)[None, None, :]
+    sx = M[0, 0] * xs + M[0, 1] * ys + M[0, 2] * zs + M[0, 3]
+    sy = M[1, 0] * xs + M[1, 1] * ys + M[1, 2] * zs + M[1, 3]
+    sz = M[2, 0] * xs + M[2, 1] * ys + M[2, 2] * zs + M[2, 3]
+    return (
+        (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+        & (sz >= 0) & (sz <= D - 1)
+    )
+
+
 # --------------------------------------------------------------------------
 # 3D (volumetric) warping — config 5.
 # --------------------------------------------------------------------------

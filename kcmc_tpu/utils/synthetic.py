@@ -126,8 +126,15 @@ def make_drift_stack(
     noise: float = 0.01,
     max_drift: float = 12.0,
     seed: int = 0,
+    n_blobs: int | None = None,
 ) -> SyntheticStack:
-    """Configs 1/2/4: a 2D stack drifting under the given transform model."""
+    """Configs 1/2/4: a 2D stack drifting under the given transform model.
+
+    `n_blobs` overrides the scene's feature density (default ~400 on
+    512x512). Config 2's nominal "~2k matches/frame" regime needs a
+    dense scene: n_blobs ~ 4000 with max_keypoints=2048 yields ~2k
+    detected keypoints and >1k surviving matches per frame.
+    """
     allowed = ("translation", "rigid", "affine", "homography")
     if model not in allowed:
         raise ValueError(
@@ -136,7 +143,9 @@ def make_drift_stack(
         )
     rng = np.random.default_rng(seed)
     H, W = shape
-    scene = render_scene(rng, shape, n_blobs=max(200, H * W // 650))
+    if n_blobs is None:
+        n_blobs = max(200, H * W // 650)
+    scene = render_scene(rng, shape, n_blobs=n_blobs)
     cx, cy = (W - 1) / 2.0, (H - 1) / 2.0
     trans = _random_walk(rng, n_frames, 2, step=1.0, maxdev=max_drift)
     mats = np.tile(np.eye(3, dtype=np.float32), (n_frames, 1, 1))

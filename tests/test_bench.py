@@ -1,0 +1,35 @@
+"""Guards on bench.py — the judged artifact the driver runs every round.
+
+A syntax error or a drifted JSON schema in bench.py would silently cost
+the round's benchmark record, so the contract is asserted here.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_BENCH = os.path.join(os.path.dirname(os.path.dirname(__file__)), "bench.py")
+
+
+def test_bench_module_compiles_and_has_cli():
+    out = subprocess.run(
+        [sys.executable, _BENCH, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    for flag in ("--frames", "--size", "--model", "--batch", "--all"):
+        assert flag in out.stdout
+
+
+def test_judged_json_line_parses():
+    sys.path.insert(0, os.path.dirname(_BENCH))
+    import bench
+
+    line = bench.judged_json_line("translation", 512, 3210.4)
+    rec = json.loads(line)
+    assert rec["metric"] == "registration_throughput_translation_512x512"
+    assert rec["value"] == 3210.4
+    assert rec["unit"] == "frames/sec/chip"
+    assert rec["vs_baseline"] == round(3210.4 / 200.0, 3)
+    assert "\n" not in line

@@ -16,7 +16,9 @@ from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
 SHAPE = (160, 160)
 
 
-@pytest.mark.parametrize("model", ["translation", "rigid", "affine"])
+@pytest.mark.parametrize(
+    "model", ["translation", "rigid", "affine", "homography"]
+)
 def test_jax_numpy_transform_parity(model):
     data = synthetic.make_drift_stack(
         n_frames=6, shape=SHAPE, model=model, max_drift=6.0, seed=21
@@ -65,6 +67,53 @@ def test_descriptor_bit_parity():
         (dj[:nj] ^ dn[:nj]).view(np.uint8)
     ).sum() / max(nj, 1)
     assert mismatch_bits < 4, f"avg descriptor bit mismatch {mismatch_bits:.2f}"
+
+
+def test_rigid3d_parity():
+    """Config 5 cross-backend parity: volumetric rigid registration on
+    the numpy backend's 3D pipeline vs the jax backend."""
+    data = synthetic.make_drift_stack_3d(
+        n_frames=4, shape=(24, 96, 96), max_drift=3.0, seed=13
+    )
+    shape = data.stack.shape[1:]
+    rj = MotionCorrector(model="rigid3d", backend="jax", batch_size=2).correct(data.stack)
+    rn = MotionCorrector(model="rigid3d", backend="numpy", batch_size=2).correct(data.stack)
+    rel = relative_transforms(data.transforms)
+    rmse_j = transform_rmse(rj.transforms, rel, shape)
+    rmse_n = transform_rmse(rn.transforms, rel, shape)
+    cross = transform_rmse(rj.transforms, rn.transforms, shape)
+    assert rmse_j < 1.0, f"jax rigid3d RMSE {rmse_j:.3f}"
+    assert rmse_n < 1.0, f"numpy rigid3d RMSE {rmse_n:.3f}"
+    bound = 1.2 * float(np.hypot(rmse_j, rmse_n)) + 0.05
+    assert cross < bound, f"cross-backend rigid3d RMSE {cross:.3f} (bound {bound:.3f})"
+
+
+def test_descriptor_bit_parity_3d():
+    """3D descriptors agree closely across backends on shared keypoints."""
+    import jax.numpy as jnp
+
+    from kcmc_tpu.backends import _np_kernels as K
+    from kcmc_tpu.ops.describe3d import describe_keypoints_3d
+    from kcmc_tpu.ops.detect3d import detect_keypoints_3d
+
+    rng = np.random.default_rng(5)
+    vol = synthetic.render_scene(rng, (20, 80, 80), n_blobs=60)
+
+    kj = detect_keypoints_3d(jnp.asarray(vol), max_keypoints=48, border=10)
+    xyzn, scoren, validn = K.detect_keypoints_3d(vol, max_keypoints=48, border=10)
+
+    nj = int(np.asarray(kj.valid).sum())
+    nn = int(validn.sum())
+    assert abs(nj - nn) <= 2, f"keypoint count mismatch: jax {nj} vs numpy {nn}"
+    n = min(nj, nn)
+    np.testing.assert_allclose(np.asarray(kj.xy)[:n], xyzn[:n], atol=2e-2)
+
+    dj = np.asarray(describe_keypoints_3d(jnp.asarray(vol), kj, blur_sigma=2.0))
+    dn = K.describe_keypoints_3d(vol, xyzn, validn, blur_sigma=2.0)
+    mismatch_bits = np.unpackbits(
+        (dj[:n] ^ dn[:n]).view(np.uint8)
+    ).sum() / max(n, 1)
+    assert mismatch_bits < 8, f"avg 3D descriptor bit mismatch {mismatch_bits:.2f}"
 
 
 def test_piecewise_parity_and_recovery():

@@ -111,3 +111,24 @@ def test_input_validation():
         MotionCorrector(model="translation", reference=99).correct(
             np.zeros((3, 64, 64), np.float32)
         )
+
+
+def test_affine_nominal_2k_matches_scale():
+    """Config 2 at its nominal scale (~2k matches/frame): a dense scene
+    with max_keypoints=2048 must yield >1k surviving matches per frame
+    and recover the drift to sub-pixel RMSE (BASELINE.json configs[1])."""
+    data = synthetic.make_drift_stack(
+        n_frames=2, shape=(512, 512), model="affine", max_drift=6.0,
+        seed=33, n_blobs=6000,
+    )
+    mc = MotionCorrector(
+        model="affine", backend="jax", batch_size=2, max_keypoints=2048
+    )
+    res = mc.correct(data.stack)
+    n_kp = np.asarray(res.diagnostics["n_keypoints"])
+    n_matches = np.asarray(res.diagnostics["n_matches"])
+    assert n_kp.min() > 1800, f"dense scene should near-fill K=2048: {n_kp}"
+    assert n_matches[1:].min() > 1000, f"nominal-scale matching: {n_matches}"
+    rel = relative_transforms(data.transforms)
+    rmse = transform_rmse(res.transforms, rel, (512, 512))
+    assert rmse < 0.5, f"affine@2k RMSE {rmse:.3f}"

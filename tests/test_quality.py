@@ -32,3 +32,50 @@ def test_template_corr_absent_by_default():
     data = make_drift_stack(n_frames=4, shape=(96, 96), model="translation", seed=0)
     res = MotionCorrector(model="translation").correct(data.stack)
     assert "template_corr" not in res.diagnostics
+
+
+@pytest.mark.parametrize("backend", ["jax", "numpy"])
+def test_template_corr_offset_background_invariance(backend):
+    """Masked correlation: exact registration on offset-background data
+    scores ~1.0 even for large drifts (the old full-frame metric read
+    the warp's out-of-coverage zeros against the offset background and
+    sank with drift size)."""
+    data = make_drift_stack(
+        n_frames=6, shape=(128, 128), model="translation",
+        max_drift=25.0, seed=4,
+    )
+    stack = np.asarray(data.stack, np.float32) + 500.0  # background offset
+    mc = MotionCorrector(
+        model="translation", backend=backend, quality_metrics=True,
+    )
+    res = mc.correct(stack)
+    corr = np.asarray(res.diagnostics["template_corr"])
+    cov = np.asarray(res.diagnostics["coverage"])
+    assert corr.shape == (6,) and cov.shape == (6,)
+    # Coverage below 1 for the drifted frames proves the mask is real...
+    assert cov[1:].max() < 1.0
+    assert cov.min() > 0.5
+    # ...and the in-coverage correlation stays high regardless of drift.
+    assert corr.min() > 0.9
+
+
+def test_template_corr_piecewise_and_homography_masks():
+    """The mask derivation covers every model family's output form
+    (field for piecewise, 3x3 matrix for homography)."""
+    from kcmc_tpu.utils.synthetic import make_piecewise_stack
+
+    data = make_piecewise_stack(n_frames=3, shape=(128, 128), seed=2)
+    res = MotionCorrector(
+        model="piecewise", quality_metrics=True, batch_size=3
+    ).correct(data.stack)
+    corr = np.asarray(res.diagnostics["template_corr"])
+    assert corr.shape == (3,) and corr.min() > 0.7
+
+    data = make_drift_stack(
+        n_frames=3, shape=(128, 128), model="homography", seed=2
+    )
+    res = MotionCorrector(
+        model="homography", quality_metrics=True, batch_size=3
+    ).correct(data.stack)
+    corr = np.asarray(res.diagnostics["template_corr"])
+    assert corr.shape == (3,) and corr.min() > 0.7

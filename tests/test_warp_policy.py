@@ -38,7 +38,7 @@ def test_auto_on_cpu_uses_jnp():
     from kcmc_tpu.ops.warp import warp_batch_with_ok
 
     b = JaxBackend(CorrectorConfig(model="translation", warp="auto"))
-    assert b._resolve_batch_warp() is warp_batch_with_ok
+    assert b._resolve_batch_warp((128, 128)) is warp_batch_with_ok
 
 
 def test_warp_ok_flag_surfaces():
@@ -66,3 +66,54 @@ def test_warp_ok_flag_surfaces():
         model="rigid", backend="jax", batch_size=4, warp="separable"
     ).correct(data.stack)
     assert np.all(res2.diagnostics["warp_ok"])
+
+
+def test_max_rotation_deg_sets_shear_bound():
+    """max_rotation_deg derives the separable shear bound per shape."""
+    import math
+
+    from kcmc_tpu.backends.jax_backend import JaxBackend
+    from kcmc_tpu.config import CorrectorConfig
+
+    b = JaxBackend(CorrectorConfig(model="rigid", max_rotation_deg=5.0))
+    # conservative: the longer frame side sets the worst-case shear
+    expect = math.ceil(math.tan(math.radians(5.0)) * 256 / 2)
+    assert b._shear_bound_px((128, 256)) == expect
+    # unset: the raw pixel knob wins
+    b2 = JaxBackend(CorrectorConfig(model="rigid", max_shear_px=11))
+    assert b2._shear_bound_px((128, 256)) == 11
+    with pytest.raises(ValueError, match="max_rotation_deg"):
+        CorrectorConfig(model="rigid", max_rotation_deg=60.0)
+
+
+def test_out_of_bound_telemetry_warns_and_escalates():
+    """A persistently out-of-bound stack must (a) warn, (b) switch the
+    remaining batches to the exact warp, (c) still produce output
+    identical to a pure-jnp run."""
+    data = synthetic.make_drift_stack(
+        n_frames=12, shape=(96, 96), model="rigid", max_drift=4.0, seed=7
+    )
+    kw = dict(
+        model="rigid", backend="jax", batch_size=2, warp="separable",
+        max_shear_px=0,  # every rotated frame exceeds the bound
+        rescue_warn_fraction=0.25,
+    )
+    ref = MotionCorrector(model="rigid", backend="jax", batch_size=2,
+                          warp="jnp").correct(data.stack)
+
+    with pytest.warns(RuntimeWarning, match="switching the remaining"):
+        res = MotionCorrector(**kw).correct(data.stack)
+    rescued = np.asarray(res.diagnostics["warp_rescued"])
+    assert rescued[:2].any()  # early batches hit the bounded kernel
+    assert not rescued[-2:].any()  # post-escalation batches don't rescue
+    np.testing.assert_allclose(res.corrected, ref.corrected, atol=1e-5)
+    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
+
+    # escalation off: warn-only, every flagged frame rescues
+    with pytest.warns(RuntimeWarning, match="persistently"):
+        res2 = MotionCorrector(
+            **{**kw, "rescue_escalate": False}
+        ).correct(data.stack)
+    rescued2 = np.asarray(res2.diagnostics["warp_rescued"])
+    assert rescued2[1:].all()
+    np.testing.assert_allclose(res2.corrected, ref.corrected, atol=1e-5)
