@@ -65,6 +65,8 @@ def _cmd_correct(args) -> int:
         progress=args.progress,
         n_threads=args.io_threads,
         output_dtype=args.output_dtype,
+        checkpoint=args.checkpoint or None,
+        checkpoint_every=args.checkpoint_every,
     )
 
     if args.transforms:
@@ -164,6 +166,12 @@ def main(argv=None) -> int:
         "--quality", action="store_true",
         help="report per-frame template correlation (registration QC)",
     )
+    p.add_argument(
+        "--checkpoint", default="",
+        help="resume-checkpoint .npz: a killed run re-invoked with the "
+        "same arguments resumes after the last checkpointed frame",
+    )
+    p.add_argument("--checkpoint-every", type=int, default=512)
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
 

@@ -40,8 +40,16 @@ class CorrectorConfig:
     # -- piecewise-rigid (config 3) ---------------------------------------
     patch_grid: tuple[int, int] = (8, 8)
     patch_hypotheses: int = 32
-    patch_prior: float = 8.0  # inlier-mass scale blending patch vs global
-    field_smooth_sigma: float = 0.7  # in grid cells
+    # Inlier-mass scale blending each patch's own translation against the
+    # global one (lambda = n_inliers / (n_inliers + prior)), and the
+    # grid-cell sigma of the field smoothing. Defaults set by a 2D sweep
+    # across rich/sparse/noisy synthetic stacks (DESIGN.md "Piecewise
+    # regularization sweep"): accuracy improves monotonically as both
+    # shrink, because patch matches are pre-gated by the global-stage
+    # consensus; prior=2/sigma=0.4 keeps both regularizers mildly active
+    # at ~15% better field RMSE than the old 8/0.7 across every regime.
+    patch_prior: float = 2.0
+    field_smooth_sigma: float = 0.4  # in grid cells
     global_threshold: float = 8.0  # generous inlier px for the global stage
 
     # -- diagnostics -------------------------------------------------------

@@ -462,23 +462,45 @@ def _packbits_encode(row: bytes) -> bytes:
 
 
 class TiffWriter:
-    """Incremental classic little-endian multi-page TIFF writer.
+    """Incremental little-endian multi-page TIFF writer (classic or BigTIFF).
 
     Pages append one at a time (streaming pipelines write corrected
     frames as they come off the device); all pages must share shape and
     dtype. compression: "none" | "deflate" | "packbits".
+
+    `bigtiff=True` writes 64-bit-offset BigTIFF — required for stacks
+    past the classic format's 4 GiB offset ceiling (a 512x512x10k-frame
+    uint16 stack is 5 GB); both this module's reader and the native C++
+    decoder read it back.
     """
 
-    def __init__(self, path: str | os.PathLike, compression: str = "none"):
+    def __init__(
+        self,
+        path: str | os.PathLike,
+        compression: str = "none",
+        bigtiff: bool = False,
+    ):
         if compression not in _COMP_CODES:
             raise ValueError(f"compression must be one of {sorted(_COMP_CODES)}")
         self.compression = compression
+        self.bigtiff = bool(bigtiff)
         self._f = open(path, "wb")
-        self._f.write(b"II\x2a\x00")
-        self._f.write(struct.pack("<I", 0))  # first-IFD offset patched later
-        self._ifd_ptr_pos = 4
+        if self.bigtiff:
+            # BigTIFF header: II, 43, offset size 8, pad 0, first-IFD u64
+            self._f.write(b"II\x2b\x00" + struct.pack("<HH", 8, 0))
+            self._f.write(struct.pack("<Q", 0))
+            self._ifd_ptr_pos = 8
+        else:
+            self._f.write(b"II\x2a\x00")
+            self._f.write(struct.pack("<I", 0))  # first-IFD offset patched later
+            self._ifd_ptr_pos = 4
         self._meta = None  # (H, W, dtype)
         self.n_pages = 0
+
+    # struct formats per flavor: next-IFD pointer, entry-count, entry
+    @property
+    def _ptr_fmt(self):
+        return "<Q" if self.bigtiff else "<I"
 
     def append(self, frame: np.ndarray) -> None:
         frame = np.ascontiguousarray(frame)
@@ -505,10 +527,11 @@ class TiffWriter:
         strip_off = f.tell()
         # Classic TIFF carries 32-bit offsets; refuse to stream past them
         # with a clear error instead of corrupting the file mid-write.
-        if strip_off + len(data) + 256 >= 2**32:
+        if not self.bigtiff and strip_off + len(data) + 256 >= 2**32:
             raise ValueError(
-                "classic TIFF output would exceed 4 GiB; write compressed "
-                "(compression='deflate') or split the stack across files"
+                "classic TIFF output would exceed 4 GiB; pass bigtiff=True "
+                "(64-bit offsets), write compressed (compression='deflate'), "
+                "or split the stack across files"
             )
         f.write(data)
         if f.tell() % 2:
@@ -516,7 +539,7 @@ class TiffWriter:
         ifd_off = f.tell()
         # patch previous next-IFD (or the header's first-IFD) pointer
         f.seek(self._ifd_ptr_pos)
-        f.write(struct.pack("<I", ifd_off))
+        f.write(struct.pack(self._ptr_fmt, ifd_off))
         f.seek(ifd_off)
 
         entries = [
@@ -525,17 +548,22 @@ class TiffWriter:
             (258, 3, 1, dt.itemsize * 8),              # BitsPerSample
             (259, 3, 1, _COMP_CODES[self.compression]),
             (262, 3, 1, 1),                            # Photometric: BlackIsZero
-            (273, 4, 1, strip_off),                    # StripOffsets
+            (273, 16 if self.bigtiff else 4, 1, strip_off),  # StripOffsets
             (277, 3, 1, 1),                            # SamplesPerPixel
             (278, 4, 1, H),                            # RowsPerStrip
             (279, 4, 1, len(data)),                    # StripByteCounts
             (339, 3, 1, _SAMPLE_FORMAT[dt.kind]),      # SampleFormat
         ]
-        f.write(struct.pack("<H", len(entries)))
-        for tag, type_, count, value in entries:
-            f.write(struct.pack("<HHII", tag, type_, count, value))
+        if self.bigtiff:
+            f.write(struct.pack("<Q", len(entries)))
+            for tag, type_, count, value in entries:
+                f.write(struct.pack("<HHQQ", tag, type_, count, value))
+        else:
+            f.write(struct.pack("<H", len(entries)))
+            for tag, type_, count, value in entries:
+                f.write(struct.pack("<HHII", tag, type_, count, value))
         self._ifd_ptr_pos = f.tell()
-        f.write(struct.pack("<I", 0))  # next IFD (patched on next append)
+        f.write(struct.pack(self._ptr_fmt, 0))  # next IFD (patched on next append)
         self.n_pages += 1
 
     def close(self) -> None:
@@ -549,18 +577,79 @@ class TiffWriter:
     def __exit__(self, *exc):
         self.close()
 
+    # -- checkpoint/resume (streaming-resume support, corrector.py) --------
+
+    def checkpoint_state(self) -> dict:
+        """Flush and capture the writer's exact append cursor.
+
+        The returned dict, stored in a resume checkpoint, lets
+        `TiffWriter.resume` reopen the file mid-stream and continue
+        producing a byte-identical TIFF: file size, the position of the
+        open next-IFD pointer, page count, and page metadata.
+        """
+        self._f.flush()
+        return {
+            "file_size": self._f.tell(),
+            "ifd_ptr_pos": self._ifd_ptr_pos,
+            "n_pages": self.n_pages,
+            "bigtiff": self.bigtiff,
+            "meta": None
+            if self._meta is None
+            else [self._meta[0], self._meta[1], self._meta[2].str],
+        }
+
+    @classmethod
+    def resume(cls, path, state: dict, compression: str = "none") -> "TiffWriter":
+        """Reopen a partially-written TIFF at a checkpointed state.
+
+        Truncates anything appended after the checkpoint (a kill can
+        leave a torn page) and re-zeros the last completed page's
+        next-IFD pointer, restoring the byte-exact writer state, so the
+        resumed stream is indistinguishable from an uninterrupted one.
+        """
+        if compression not in _COMP_CODES:
+            raise ValueError(f"compression must be one of {sorted(_COMP_CODES)}")
+        # A file SHORTER than the checkpoint (replaced/partial copy)
+        # must not be zero-extended by truncate() into silent garbage
+        # pages — fail so the caller restarts from scratch.
+        if os.path.getsize(path) < int(state["file_size"]):
+            raise OSError(
+                f"{path}: shorter than the checkpointed cursor "
+                f"({os.path.getsize(path)} < {state['file_size']} bytes)"
+            )
+        w = cls.__new__(cls)
+        w.compression = compression
+        w.bigtiff = bool(state.get("bigtiff", False))
+        w._f = open(path, "r+b")
+        w._f.truncate(state["file_size"])
+        w._ifd_ptr_pos = int(state["ifd_ptr_pos"])
+        # a torn append may have patched the open next-IFD pointer
+        w._f.seek(w._ifd_ptr_pos)
+        w._f.write(struct.pack(w._ptr_fmt, 0))
+        w._f.seek(int(state["file_size"]))
+        meta = state.get("meta")
+        w._meta = (
+            None
+            if meta is None
+            else (int(meta[0]), int(meta[1]), np.dtype(meta[2]))
+        )
+        w.n_pages = int(state["n_pages"])
+        return w
+
 
 def write_stack(
     path: str | os.PathLike,
     stack: np.ndarray,
     compression: str = "none",
+    bigtiff: bool = False,
 ) -> None:
-    """Write a (T, H, W) array as classic little-endian multi-page TIFF."""
+    """Write a (T, H, W) array as little-endian multi-page TIFF
+    (classic, or BigTIFF with `bigtiff=True` for >4 GiB stacks)."""
     stack = np.asarray(stack)
     if stack.ndim == 2:
         stack = stack[None]
     if stack.ndim != 3:
         raise ValueError(f"stack must be (T, H, W), got {stack.shape}")
-    with TiffWriter(path, compression=compression) as w:
+    with TiffWriter(path, compression=compression, bigtiff=bigtiff) as w:
         for frame in stack:
             w.append(frame)

@@ -340,18 +340,26 @@ def _check_pipeline_end_to_end(size):
 
 def run_selftest(size: int = 512, size3d=(32, 256, 256)) -> list[dict]:
     """Run every kernel-vs-oracle check on the current default platform."""
+    # labels match the names the checks record on success, so a raising
+    # check keeps a stable identity in the JSON summary across rounds
     checks = [
-        ("detect2d", lambda: _check_detect2d(size)),
-        ("describe2d_upright", lambda: _check_describe2d(size, oriented=False)),
-        ("describe2d_oriented", lambda: _check_describe2d(size, oriented=True)),
-        ("warp_translation", lambda: _check_warp_translation(size)),
-        ("warp_separable", lambda: _check_warp_separable(size)),
-        ("warp_homography", lambda: _check_warp_homography(size)),
-        ("warp_flow", lambda: _check_warp_flow(size)),
-        ("detect3d", lambda: _check_detect3d(size3d)),
-        ("describe3d", lambda: _check_describe3d(size3d)),
-        ("warp_rigid3d", lambda: _check_warp_rigid3d(size3d)),
-        ("pipeline_end_to_end", lambda: _check_pipeline_end_to_end(size)),
+        ("detect2d_pallas_vs_jnp", lambda: _check_detect2d(size)),
+        (
+            "describe2d_pallas_vs_jnp[oriented=False]",
+            lambda: _check_describe2d(size, oriented=False),
+        ),
+        (
+            "describe2d_pallas_vs_jnp[oriented=True]",
+            lambda: _check_describe2d(size, oriented=True),
+        ),
+        ("warp_translation_pallas_vs_gather", lambda: _check_warp_translation(size)),
+        ("warp_separable_vs_gather", lambda: _check_warp_separable(size)),
+        ("warp_homography_vs_gather", lambda: _check_warp_homography(size)),
+        ("warp_flow_vs_gather", lambda: _check_warp_flow(size)),
+        ("detect3d_pallas_vs_jnp", lambda: _check_detect3d(size3d)),
+        ("describe3d_pallas_vs_jnp", lambda: _check_describe3d(size3d)),
+        ("warp_rigid3d_vs_gather", lambda: _check_warp_rigid3d(size3d)),
+        ("pipeline_auto_vs_jnp_warp", lambda: _check_pipeline_end_to_end(size)),
     ]
     results = []
     for name, chk in checks:

@@ -17,6 +17,79 @@ import tempfile
 import numpy as np
 
 
+def _atomic_savez(path: str, **payload) -> None:
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
+    os.close(fd)
+    try:
+        np.savez(tmp, **payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _segment_arrays(segments: list[dict]) -> dict:
+    return {
+        f"c{i}_{k}": np.asarray(v)
+        for i, seg in enumerate(segments)
+        for k, v in seg.items()
+    }
+
+
+def _split_segments(arrays: dict) -> list[dict]:
+    n = 0
+    while any(k.startswith(f"c{n}_") for k in arrays):
+        n += 1
+    return [
+        {k[len(f"c{i}_") :]: arrays[k] for k in arrays if k.startswith(f"c{i}_")}
+        for i in range(n)
+    ]
+
+
+def save_stream_checkpoint(
+    path: str, meta: dict, new_segments: list[dict], part_index: int
+) -> None:
+    """Persist one streaming-resume checkpoint increment.
+
+    The segments NEW since the last save go into an append-only part
+    file (`<path>.partNNNNN.npz`); then the small meta record (run
+    signature, done-cursor, output-TIFF writer state, part count) is
+    atomically replaced at `path`. Each save is O(new work), not O(run
+    so far) — a million-frame run writes each diagnostic array once.
+    A crash between the two writes leaves the old meta pointing at the
+    old part count; the orphan part is simply overwritten next time.
+    Used by MotionCorrector.correct_file.
+    """
+    if new_segments:
+        _atomic_savez(
+            _part_path(path, part_index), **_segment_arrays(new_segments)
+        )
+        meta = dict(meta, n_parts=part_index + 1)
+    _atomic_savez(path, meta=json.dumps(meta))
+
+
+def _part_path(path: str, i: int) -> str:
+    return f"{path}.part{i:05d}.npz"
+
+
+def load_stream_checkpoint(path: str):
+    """Load a streaming-resume checkpoint; returns (meta, segments) or
+    None when absent/unreadable (including a missing part file)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["meta"]))
+        segments: list[dict] = []
+        for p in range(int(meta.get("n_parts", 0))):
+            with np.load(_part_path(path, p), allow_pickle=False) as z:
+                segments.extend(_split_segments({k: z[k] for k in z.files}))
+    except Exception:
+        return None  # torn/corrupt checkpoint: restart from scratch
+    return meta, segments
+
+
 class ResumableCorrector:
     """Wraps a MotionCorrector with chunk-level checkpoint/resume.
 

@@ -57,7 +57,12 @@ def render_scene(
     """
     nd = len(shape)
     img = np.zeros(shape, dtype=np.float32)
-    coords = [rng.uniform(8, s - 8, size=n_blobs) for s in shape]
+    # keep blob centers off the boundary; shallow axes (z-stacks with a
+    # dozen planes) get a proportional margin instead of the fixed 8
+    coords = [
+        rng.uniform(min(8, s / 4), s - min(8, s / 4), size=n_blobs)
+        for s in shape
+    ]
     amps = rng.uniform(0.4, 1.0, size=n_blobs).astype(np.float32)
     sigmas = rng.uniform(1.0, 2.5, size=(n_blobs, nd)).astype(np.float32)
     grids = np.meshgrid(*[np.arange(s, dtype=np.float32) for s in shape], indexing="ij")
@@ -180,12 +185,15 @@ def make_piecewise_stack(
     max_disp: float = 6.0,
     noise: float = 0.01,
     seed: int = 0,
+    n_blobs: int | None = None,
 ) -> SyntheticStack:
     """Config 3: smooth non-rigid per-frame displacement fields on a patch grid."""
     rng = np.random.default_rng(seed)
     H, W = shape
     gh, gw = grid
-    scene = render_scene(rng, shape, n_blobs=max(200, H * W // 650))
+    if n_blobs is None:
+        n_blobs = max(200, H * W // 650)
+    scene = render_scene(rng, shape, n_blobs=n_blobs)
     fields = np.zeros((n_frames, gh, gw, 2), dtype=np.float32)
     # Temporally-correlated, spatially-smooth displacement fields.
     walk = _random_walk(rng, n_frames, 2, step=0.6, maxdev=max_disp * 0.6)

@@ -13,3 +13,47 @@ def test_profiling_stage_breakdown_cpu():
     assert set(rep) == {"detect", "describe", "match", "consensus", "full (+warp)",
                         "frames_per_sec"}
     assert rep["frames_per_sec"] > 0
+
+
+def test_honest_time_forces_execution():
+    """honest_time must return a sane per-call cost for a jitted fn."""
+    import jax
+    import jax.numpy as jnp
+
+    from kcmc_tpu.utils.profiling import honest_time
+
+    f = jax.jit(lambda x: jnp.sum(x * 2.0))
+    x = jnp.ones((256, 256))
+    t = honest_time(f, x, iters=4, min_warmup_s=0.05)
+    assert 0 < t < 5.0
+
+
+def test_stage_breakdown_structure():
+    """stage_breakdown reports cumulative+incremental ms per stage and a
+    throughput figure; cumulative must be nondecreasing-ish and the full
+    program must dominate single stages."""
+    from kcmc_tpu.utils.profiling import stage_breakdown
+
+    rep = stage_breakdown(
+        model="translation", shape=(96, 96), batch_size=4, iters=2,
+        max_keypoints=64,
+    )
+    stages = ["detect", "describe", "match", "consensus", "full (+warp)"]
+    for s in stages:
+        assert set(rep[s]) == {"cumulative_ms", "incremental_ms"}
+        assert rep[s]["cumulative_ms"] > 0
+    assert rep["frames_per_sec"] > 0
+    # prefix programs are supersets: describe includes detect etc.
+    # (clock noise can wobble single measurements; assert the big
+    # relation only: the full pipeline costs at least half the
+    # detect-only prefix — a sanity floor, not a microbenchmark)
+    assert rep["full (+warp)"]["cumulative_ms"] > 0.5 * rep["detect"]["cumulative_ms"]
+
+
+def test_stage_breakdown_rejects_non_matrix_models():
+    import pytest
+
+    from kcmc_tpu.utils.profiling import stage_breakdown
+
+    with pytest.raises(ValueError, match="piecewise"):
+        stage_breakdown(model="piecewise")

@@ -5,6 +5,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from kcmc_tpu import MotionCorrector
 from kcmc_tpu.io import read_stack, write_stack
@@ -80,3 +81,127 @@ def test_cli_info_and_correct(tmp_path):
     saved = np.load(tpath)
     assert saved["transforms"].shape == (6, 3, 3)
     assert read_stack(opath).shape == data.stack.shape
+
+
+class _PoisonAfter:
+    """Makes ChunkedStackLoader._read raise after `allow` successful
+    chunk reads — a deterministic stand-in for a mid-run kill."""
+
+    def __init__(self, allow):
+        self.allow = allow
+        self.calls = 0
+
+    def __call__(self, orig, loader, lo, hi):
+        self.calls += 1
+        if self.calls > self.allow:
+            raise RuntimeError("simulated kill")
+        return orig(loader, lo, hi)
+
+
+def test_streaming_resume_byte_identical(tmp_path, monkeypatch):
+    """Kill-and-rerun via checkpoint= must resume after the last
+    checkpointed frame and produce a byte-identical output TIFF."""
+    from kcmc_tpu.io import ChunkedStackLoader
+    from kcmc_tpu.io.tiff import write_stack
+    from kcmc_tpu.utils.checkpoint import load_stream_checkpoint
+
+    data = synthetic.make_drift_stack(
+        n_frames=24, shape=(96, 96), model="translation", seed=11
+    )
+    u16 = np.clip(data.stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, u16)
+
+    orig = ChunkedStackLoader._read
+
+    def run(output, checkpoint=None, poison=None):
+        mc = MotionCorrector(model="translation", backend="jax", batch_size=4)
+        if poison is not None:
+            monkeypatch.setattr(
+                ChunkedStackLoader, "_read",
+                lambda self, lo, hi: poison(orig, self, lo, hi),
+            )
+        else:
+            monkeypatch.setattr(ChunkedStackLoader, "_read", orig)
+        return mc.correct_file(
+            str(src), output=str(output), chunk_size=8,
+            checkpoint=checkpoint and str(checkpoint),
+            checkpoint_every=8,
+        )
+
+    ref = run(tmp_path / "ref.tif")  # uninterrupted, no checkpoint
+
+    ckpt = tmp_path / "run.ckpt.npz"
+    out = tmp_path / "out.tif"
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        run(out, checkpoint=ckpt, poison=_PoisonAfter(2))
+    meta, _segments = load_stream_checkpoint(str(ckpt))
+    assert 0 < meta["done"] < 24  # partial progress checkpointed
+
+    res = run(out, checkpoint=ckpt)  # resume to completion
+    assert res.timing["restored_frames"] == meta["done"]
+    assert (tmp_path / "ref.tif").read_bytes() == out.read_bytes()
+    # transforms/diagnostics identical to the uninterrupted run
+    np.testing.assert_array_equal(res.transforms.shape, (24, 3, 3))
+    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
+
+    # idempotent: re-running a completed job restores everything
+    res2 = run(out, checkpoint=ckpt)
+    assert res2.timing["restored_frames"] == 24
+    np.testing.assert_allclose(res2.transforms, ref.transforms, atol=1e-6)
+    assert (tmp_path / "ref.tif").read_bytes() == out.read_bytes()
+
+
+def test_streaming_checkpoint_stale_config_restarts(tmp_path):
+    """A checkpoint written under different settings must be ignored."""
+    from kcmc_tpu.io.tiff import write_stack
+
+    data = synthetic.make_drift_stack(
+        n_frames=8, shape=(96, 96), model="translation", seed=12
+    )
+    src = tmp_path / "in.tif"
+    write_stack(src, np.clip(data.stack * 40000, 0, 65535).astype(np.uint16))
+    ckpt = tmp_path / "c.npz"
+    out = tmp_path / "o.tif"
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=4)
+    mc.correct_file(str(src), output=str(out), checkpoint=str(ckpt))
+    # different config: stale checkpoint ignored, full restart still works
+    mc2 = MotionCorrector(
+        model="translation", backend="jax", batch_size=4, n_hypotheses=64
+    )
+    res = mc2.correct_file(str(src), output=str(out), checkpoint=str(ckpt))
+    assert res.timing["restored_frames"] == 0
+    assert res.transforms.shape == (8, 3, 3)
+
+
+def test_streaming_checkpoint_requires_output(tmp_path):
+    mc = MotionCorrector(model="translation", backend="jax")
+    with pytest.raises(ValueError, match="checkpoint requires output"):
+        mc.correct_file(str(tmp_path / "x.tif"), checkpoint=str(tmp_path / "c.npz"))
+
+
+def test_streaming_checkpoint_replaced_input_restarts(tmp_path):
+    """A completed checkpoint must not serve stale results when the
+    input file is replaced by a different same-shape stack."""
+    from kcmc_tpu.io.tiff import write_stack
+
+    def make(seed):
+        data = synthetic.make_drift_stack(
+            n_frames=8, shape=(96, 96), model="translation", seed=seed
+        )
+        return np.clip(data.stack * 40000, 0, 65535).astype(np.uint16), data
+
+    src = tmp_path / "in.tif"
+    ckpt = tmp_path / "c.npz"
+    out = tmp_path / "o.tif"
+    u16a, _ = make(1)
+    write_stack(src, u16a)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=4)
+    ra = mc.correct_file(str(src), output=str(out), checkpoint=str(ckpt))
+
+    u16b, _ = make(2)  # same shape/dtype/frames, different content
+    write_stack(src, u16b)
+    rb = mc.correct_file(str(src), output=str(out), checkpoint=str(ckpt))
+    assert rb.timing["restored_frames"] == 0  # checkpoint invalidated
+    # and the results genuinely reflect the new stack
+    assert not np.allclose(ra.transforms, rb.transforms)
