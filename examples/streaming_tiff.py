@@ -22,10 +22,18 @@ result = mc.correct_file(
     output="corrected.tif",      # corrected frames stream to disk
     compression="deflate",
     progress=True,
+    checkpoint="run.ckpt.npz",   # kill-safe: an interrupted run resumes
+    # after the last checkpointed frame, and the resumed output TIFF is
+    # byte-identical to an uninterrupted one
 )
 print("transforms:", result.transforms.shape)
+print("restored frames (resume):", result.timing.get("restored_frames"))
 print("corrected file:", read_stack("corrected.tif").shape)
+
+# Outputs past 4 GiB (e.g. a 512x512x10k uint16 stack) switch to
+# BigTIFF automatically.
 
 # The same thing from the command line:
 #   python -m kcmc_tpu correct drifting.tif -o corrected.tif \
-#       --transforms transforms.npz --model translation
+#       --transforms transforms.npz --model translation \
+#       --checkpoint run.ckpt.npz

@@ -55,6 +55,16 @@ def _template_corr(
     return num / jnp.maximum(den, 1e-12)
 
 
+@functools.partial(jax.jit, static_argnames=("dtype_name",))
+def _cast_corrected(corrected: jnp.ndarray, dtype_name: str) -> jnp.ndarray:
+    """Round/clip/cast resampled frames to an integer output dtype ON
+    DEVICE (mirrors corrector._cast_output), so the device->host copy
+    moves the small integer array instead of float32."""
+    dt = jnp.dtype(dtype_name)
+    info = np.iinfo(dt)
+    return jnp.clip(jnp.rint(corrected), info.min, info.max).astype(dt)
+
+
 @functools.partial(jax.jit, static_argnames=("shape",))
 def _coverage_matrix(transforms: jnp.ndarray, shape) -> jnp.ndarray:
     from kcmc_tpu.ops.warp import coverage_mask
@@ -133,16 +143,26 @@ class JaxBackend:
         out = self.process_batch_async(frames, ref, frame_indices)
         return jax.tree.map(np.asarray, out)
 
-    def process_batch_async(self, frames, ref: dict, frame_indices, to_host=True) -> dict:
+    def process_batch_async(
+        self, frames, ref: dict, frame_indices, to_host=True, cast_dtype=None
+    ) -> dict:
         """Dispatch one batch; return the *device* output arrays without
         blocking. With `to_host` (the orchestrator's host-fed path) the
         device->host copies of this batch start immediately so they overlap
         with the compute of later batches (the host<->device link is the
         scarce resource for host-fed stacks); `to_host=False` keeps
-        everything on device (device-resident pipelines, benchmarking)."""
+        everything on device (device-resident pipelines, benchmarking).
+
+        Frames upload in their NATIVE dtype (a uint16 microscopy batch is
+        half the bytes of float32 on the scarce host->device link) and are
+        cast to float32 on device by the batch program. `cast_dtype`
+        (integer targets) additionally rounds/clips/casts the corrected
+        frames on device BEFORE the device->host copy — for a uint16
+        stack the two together halve the tunnel traffic in each
+        direction."""
         shape = tuple(frames.shape[1:])
         fn = self._get_batch_fn(shape)
-        frames_j = jnp.asarray(frames, jnp.float32)
+        frames_j = jnp.asarray(frames)
         idx_j = jnp.asarray(frame_indices, jnp.uint32)
         if self.mesh is not None:
             from kcmc_tpu.parallel.sharded import shard_frames
@@ -168,6 +188,11 @@ class JaxBackend:
             out["coverage"] = jnp.mean(
                 mask.astype(jnp.float32), axis=tuple(range(1, mask.ndim))
             )
+        if cast_dtype is not None and "corrected" in out:
+            dt = np.dtype(cast_dtype)
+            if np.issubdtype(dt, np.integer):
+                out = dict(out)
+                out["corrected"] = _cast_corrected(out["corrected"], dt.name)
         if to_host:
             for v in out.values():  # start D2H copies in the background
                 if hasattr(v, "copy_to_host_async"):
@@ -210,6 +235,9 @@ class JaxBackend:
             batch_warp = self._resolve_batch_warp(shape)
 
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
+            # Frames upload in their native dtype (uint16 stacks halve
+            # the host->device bytes); all math runs in float32.
+            frames = frames.astype(jnp.float32)
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
             # smooth (the descriptor-stage blur) rides along with the
             # fused Pallas detection kernel's resident slab.
@@ -317,6 +345,7 @@ class JaxBackend:
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d_batch
 
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
+            frames = frames.astype(jnp.float32)  # native-dtype upload
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
             # smooth (the descriptor-stage blur) rides along with the
             # fused detection kernel's resident slab, as in 2D.

@@ -258,6 +258,18 @@ class MotionCorrector:
             xp = np
         convert = (lambda v: v) if device_outputs else np.asarray
         do_rescue = cfg.rescue_warp and not device_outputs
+        out_dt = (
+            None
+            if device_outputs
+            else self._resolve_output_dtype(output_dtype, stack.dtype)
+        )
+        # Integer targets cast on device before the device->host copy
+        # (half the tunnel bytes for uint16 stacks).
+        cast = (
+            out_dt
+            if out_dt is not None and np.issubdtype(out_dt, np.integer)
+            else None
+        )
 
         def drain(entry):
             n, out, batch = entry
@@ -276,7 +288,7 @@ class MotionCorrector:
         with timer.stage("register_batches"):
             self._dispatch_batches(
                 batches(), ref, drain, to_host=not device_outputs,
-                keep_frames=do_rescue,
+                keep_frames=do_rescue, cast_dtype=cast,
             )
 
         if device_outputs:
@@ -292,9 +304,7 @@ class MotionCorrector:
         } if outs else {}
         corrected = merged.pop("corrected", empty)
         if not device_outputs:
-            corrected = _cast_output(
-                corrected, self._resolve_output_dtype(output_dtype, stack.dtype)
-            )
+            corrected = _cast_output(corrected, out_dt)  # no-op if device-cast
         transforms = merged.pop("transform", None)
         fields = merged.pop("field", None)
         timing = timer.report(n_frames=len(indices))
@@ -327,7 +337,7 @@ class MotionCorrector:
 
     def _dispatch_batches(
         self, batches, ref, drain, depth: int = 3, to_host=True,
-        keep_frames=False,
+        keep_frames=False, cast_dtype=None,
     ):
         """Pipelined dispatch: keep `depth` batches in flight so the
         host->device upload of batch i+1, the compute of batch i, and
@@ -353,6 +363,7 @@ class MotionCorrector:
         self._escalated = False
         self._rescue_warned = False
         inflight: list[tuple[int, dict, Any]] = []
+        accepts_cast: dict[int, bool] = {}  # per-backend, inspected once
         for n, batch, idx in batches:
             backend = (
                 self._get_escalation_backend() if self._escalated else self.backend
@@ -360,14 +371,21 @@ class MotionCorrector:
             dispatch = getattr(backend, "process_batch_async", None)
             kept = batch if keep_frames else None
             if dispatch is not None:
-                # Only pass to_host when overriding its default: plugin
-                # backends implementing the original 3-arg seam keep
-                # working for the (default) host-output path.
-                out = (
-                    dispatch(batch, ref, idx, to_host=False)
-                    if not to_host
-                    else dispatch(batch, ref, idx)
-                )
+                # Only pass non-default options the backend declares:
+                # plugin backends implementing the original 3-arg seam
+                # keep working for the (default) host-output path.
+                kw = {}
+                if not to_host:
+                    kw["to_host"] = False
+                if cast_dtype is not None:
+                    key = id(backend)
+                    if key not in accepts_cast:
+                        accepts_cast[key] = self._dispatch_accepts(
+                            dispatch, "cast_dtype"
+                        )
+                    if accepts_cast[key]:
+                        kw["cast_dtype"] = cast_dtype
+                out = dispatch(batch, ref, idx, **kw)
                 inflight.append((n, out, kept))
                 if len(inflight) >= depth:
                     drain(inflight.pop(0))
@@ -375,6 +393,15 @@ class MotionCorrector:
                 drain((n, backend.process_batch(batch, ref, idx), kept))
         for entry in inflight:
             drain(entry)
+
+    @staticmethod
+    def _dispatch_accepts(dispatch, name: str) -> bool:
+        import inspect
+
+        try:
+            return name in inspect.signature(dispatch).parameters
+        except (TypeError, ValueError):
+            return False
 
     def _get_escalation_backend(self):
         """The same backend with `warp="jnp"` (exact, unbounded) — built
@@ -457,7 +484,9 @@ class MotionCorrector:
             if k in ("transform", "field")
         }
         corrected = np.array(host["corrected"])
-        corrected[bad] = rescue(frames, sub)
+        # round/clip like every other cast when the batch came back in
+        # an integer output dtype (device-side cast path)
+        corrected[bad] = _cast_output(rescue(frames, sub), corrected.dtype)
         host["corrected"] = corrected
         host["warp_ok"] = np.ones_like(ok)
         if "template_corr" in host and ref is not None and "frame" in ref:
@@ -573,8 +602,16 @@ class MotionCorrector:
                     # Input identity: a rerun over a REPLACED same-shape
                     # input must not resume into stale results.
                     "input": [int(st.st_size), int(st.st_mtime_ns)],
+                    # Every argument that changes the results or the
+                    # output file must be part of the signature — a
+                    # mismatched rerun restarts instead of silently
+                    # mixing two runs' frames.
+                    "backend": self.backend_name,
+                    "output": os.path.abspath(output),
                     "reference": _fingerprint(self.reference),
+                    "reference_window": self.reference_window,
                     "template_iters": self.template_iters,
+                    "template_window": self.template_window,
                     "output_dtype": str(out_dt),
                     "compression": compression,
                 }
@@ -598,12 +635,12 @@ class MotionCorrector:
                 # BigTIFF for outputs past classic TIFF's 4 GiB offset
                 # ceiling (e.g. the 512x512x10k-frame judged stack at
                 # uint16 is 5 GB); both decoders read it back. The
-                # estimate counts pixel data plus per-page IFD overhead
-                # (~215 B written; 256 covers padding) — compression can
-                # only shrink it, and a false-positive BigTIFF is free.
-                est = len(ts) * (
-                    int(np.prod(ts.frame_shape)) * out_dt.itemsize + 256
-                )
+                # estimate counts pixel data (+1% — packbits EXPANDS
+                # incompressible data by up to ~0.8%, and a false-
+                # positive BigTIFF is free) plus per-page IFD overhead
+                # (~215 B written; 256 covers padding).
+                frame_bytes = int(np.prod(ts.frame_shape)) * out_dt.itemsize
+                est = len(ts) * (frame_bytes + frame_bytes // 100 + 256)
                 writer = TiffWriter(
                     output, compression=compression,
                     bigtiff=est + (1 << 20) >= 2**32,
@@ -663,7 +700,9 @@ class MotionCorrector:
                 chunks = iter(loader)
                 try:
                     for lo, hi, frames in chunks:
-                        frames = np.asarray(frames, np.float32)
+                        # native dtype: uint16 uploads at half the bytes;
+                        # the device program casts to float32
+                        frames = np.asarray(frames)
                         for blo in range(lo, hi, B):
                             bhi = min(blo + B, hi)
                             yield self._pad_batch(
@@ -675,10 +714,12 @@ class MotionCorrector:
                     chunks.close()  # stop + join the prefetch thread
 
             batch_gen = batches()
+            cast = out_dt if np.issubdtype(out_dt, np.integer) else None
             try:
                 with timer.stage("register_batches"):
                     self._dispatch_batches(
-                        batch_gen, ref, drain, keep_frames=cfg.rescue_warp
+                        batch_gen, ref, drain, keep_frames=cfg.rescue_warp,
+                        cast_dtype=cast,
                     )
                 if checkpoint is not None and cursor["done"] > cursor["saved"]:
                     save_ckpt()

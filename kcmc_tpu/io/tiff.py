@@ -621,6 +621,17 @@ class TiffWriter:
         w.compression = compression
         w.bigtiff = bool(state.get("bigtiff", False))
         w._f = open(path, "r+b")
+        # ...and an unrelated file that happens to be big enough must
+        # not be truncated into a corrupt TIFF: the header must match
+        # the checkpointed flavor before any destructive write.
+        magic = w._f.read(4)
+        want = b"II\x2b\x00" if w.bigtiff else b"II\x2a\x00"
+        if magic != want:
+            w._f.close()
+            raise OSError(
+                f"{path}: header {magic!r} does not match the "
+                f"checkpointed output ({want!r}) — not resuming"
+            )
         w._f.truncate(state["file_size"])
         w._ifd_ptr_pos = int(state["ifd_ptr_pos"])
         # a torn append may have patched the open next-IFD pointer

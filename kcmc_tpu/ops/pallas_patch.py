@@ -41,6 +41,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 _WIN = 256  # lane window: covers the 128-alignment residual + patch width
 _KB = 16  # keypoints per program (measured best on v5e)
+# Scalar-prefetch arrays (keypoint origins) live whole in SMEM, which is
+# 1 MB on v5e: at batch 64 x K=2048 the two (B, K) i32 origin planes
+# alone are exactly 1 MB and the compile dies with "Ran out of memory in
+# memory space smem". The extract wrappers chunk the batch axis so the
+# scalar arrays stay under this budget (half of SMEM, leaving room for
+# grid bookkeeping); chunking costs one extra kernel launch per chunk,
+# nothing else — the grid already iterates frames serially.
+_SMEM_SCALAR_BUDGET = 512 * 1024
+
+
+def _smem_batch_limit(n_scalar_arrays: int, K: int, KB: int) -> int:
+    """Max frames per pallas_call keeping (B, K) i32 scalar prefetch
+    arrays within the SMEM budget."""
+    Kp = -(-K // KB) * KB
+    return max(1, _SMEM_SCALAR_BUDGET // (n_scalar_arrays * Kp * 4))
 
 
 def _patch_kernel(oy_ref, ox_ref, src_ref, out_ref, *, P: int, KB: int):
@@ -200,6 +215,21 @@ def extract_blended_planes(
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
     KB = _KB
+    bc = _smem_batch_limit(2, K, KB)
+    if B > bc:  # chunk the batch to keep scalar prefetch within SMEM
+        outs = [
+            extract_blended_planes(
+                padded[i : i + bc], oy[i : i + bc], ox[i : i + bc],
+                fx[i : i + bc], fy[i : i + bc], P,
+                with_moments=with_moments, interpret=interpret,
+            )
+            for i in range(0, B, bc)
+        ]
+        if with_moments:
+            return tuple(
+                jnp.concatenate([o[j] for o in outs]) for j in range(3)
+            )
+        return jnp.concatenate(outs)
     if K % KB:
         pad = KB - K % KB
         z = jnp.zeros((B, pad), oy.dtype)
@@ -312,6 +342,17 @@ def extract_blended_3d(
     """
     B, Dp, Hp, Wp0 = padded.shape
     K = xyz.shape[1]
+    bc = _smem_batch_limit(4, K, 8)
+    if B > bc:  # chunk the batch to keep scalar prefetch within SMEM
+        return jnp.concatenate(
+            [
+                extract_blended_3d(
+                    padded[i : i + bc], xyz[i : i + bc], Pz, Pxy,
+                    interpret=interpret,
+                )
+                for i in range(0, B, bc)
+            ]
+        )
     x0 = jnp.floor(xyz[..., 0])
     y0 = jnp.floor(xyz[..., 1])
     z0 = jnp.floor(xyz[..., 2])
@@ -399,6 +440,17 @@ def extract_patches(
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
     KB = _KB
+    bc = _smem_batch_limit(2, K, KB)
+    if B > bc:  # chunk the batch to keep scalar prefetch within SMEM
+        return jnp.concatenate(
+            [
+                extract_patches(
+                    padded[i : i + bc], oy[i : i + bc], ox[i : i + bc], P,
+                    interpret=interpret,
+                )
+                for i in range(0, B, bc)
+            ]
+        )
     if K % KB:  # pad the keypoint axis up; callers slice the tail off
         pad = KB - K % KB
         oy = jnp.concatenate([oy, jnp.zeros((B, pad), oy.dtype)], axis=1)

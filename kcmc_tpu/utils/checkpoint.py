@@ -120,15 +120,7 @@ class ResumableCorrector:
 
     def _save(self, meta: dict, arrays: dict) -> None:
         # atomic replace so a mid-write kill can't corrupt the checkpoint
-        d = os.path.dirname(os.path.abspath(self.path)) or "."
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
-        os.close(fd)
-        try:
-            np.savez(tmp, meta=json.dumps(meta), **arrays)
-            os.replace(tmp, self.path)
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
+        _atomic_savez(self.path, meta=json.dumps(meta), **arrays)
 
     # -- main loop ---------------------------------------------------------
 
@@ -160,10 +152,7 @@ class ResumableCorrector:
             meta, arrays = state
             if meta.get("config") == cfg_sig and meta.get("n_frames") == T:
                 done = int(meta["done"])
-                chunks = [
-                    {k[len(f"c{i}_") :]: arrays[k] for k in arrays if k.startswith(f"c{i}_")}
-                    for i in range(meta["n_chunks"])
-                ]
+                chunks = _split_segments(arrays)
             # config/stack mismatch: restart from scratch (stale checkpoint)
 
         timer = StageTimer()

@@ -86,3 +86,31 @@ def test_describe3d_batch_pallas_path_matches_vmap():
     bits = 32 * ref.shape[-1] * ref.shape[0] * ref.shape[1]
     # split-precision selection + blend order: only exact-tie bits may flip
     assert diff <= bits * 1e-3
+
+
+def test_smem_batch_chunking_matches_unchunked(data, monkeypatch):
+    """Large B x K runs split the batch to fit scalar prefetch in SMEM
+    (batch 64 x K=2048 overflows the 1 MB space otherwise); the split
+    must be output-identical to one call.
+
+    The budget is read at TRACE time (extract_patches is jitted), so the
+    jit cache must be cleared after shrinking it — otherwise the second
+    call is a cache hit of the unchunked executable and the test proves
+    nothing.
+    """
+    import kcmc_tpu.ops.pallas_patch as pp
+
+    padded, oy, ox = data
+    ref = np.asarray(pp.extract_patches(padded, oy, ox, 16, interpret=True))
+    try:
+        # shrink the budget so even this tiny case must chunk per-frame
+        monkeypatch.setattr(pp, "_SMEM_SCALAR_BUDGET", 8)
+        assert pp._smem_batch_limit(2, oy.shape[1], pp._KB) == 1
+        jax.clear_caches()
+        got = np.asarray(
+            pp.extract_patches(padded, oy, ox, 16, interpret=True)
+        )
+    finally:
+        monkeypatch.undo()
+        jax.clear_caches()  # don't leak tiny-budget traces to other tests
+    np.testing.assert_array_equal(got, ref)
