@@ -1,0 +1,127 @@
+"""TRUE multi-host execution: two OS processes, TCP-coordinated.
+
+tests/test_distributed.py exercises the sharded program on one process
+with 8 virtual devices; this spawns TWO jax.distributed processes (4
+virtual CPU devices each) that form one global 8-device mesh — the same
+topology as a 2-host TPU pod slice over DCN. Each process feeds only
+its host-local half of the batch (`shard_host_local_frames`) and its
+half of the keypoint-sharded reference; the all-gather then crosses
+process boundaries for real, and each host's transform shards must
+match a single-device run.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_WORKER = r"""
+import os, sys
+import numpy as np
+
+pid = int(sys.argv[1]); port = sys.argv[2]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from kcmc_tpu.parallel import initialize_multihost, make_mesh, shard_host_local_frames
+initialize_multihost(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kcmc_tpu.backends.jax_backend import JaxBackend
+from kcmc_tpu.config import CorrectorConfig
+from kcmc_tpu.parallel.mesh import FRAME_AXIS
+from kcmc_tpu.utils import synthetic
+
+B, K, SHAPE = 8, 64, (96, 96)
+data = synthetic.make_drift_stack(
+    n_frames=B, shape=SHAPE, model="translation", max_drift=5.0, seed=41
+)
+cfg = CorrectorConfig(model="translation", max_keypoints=K, batch_size=B)
+
+# single-device truth, computed independently on this host
+single = JaxBackend(cfg)
+ref = single.prepare_reference(np.asarray(data.stack[0], np.float32))
+truth = single.process_batch(
+    np.asarray(data.stack, np.float32), ref, np.arange(B, dtype=np.uint32)
+)
+
+# global mesh across both processes; host-local halves of everything
+mesh = make_mesh()
+sharded_backend = JaxBackend(cfg, mesh=mesh)
+fn = sharded_backend._get_batch_fn(SHAPE)
+
+lo, hi = pid * (B // 2), (pid + 1) * (B // 2)
+frames = shard_host_local_frames(
+    np.asarray(data.stack[lo:hi], np.float32), mesh
+)
+idx = shard_host_local_frames(np.arange(lo, hi, dtype=np.uint32), mesh)
+
+klo, khi = pid * (K // 2), (pid + 1) * (K // 2)
+sh = NamedSharding(mesh, P(FRAME_AXIS))
+ref_sharded = {
+    k: jax.make_array_from_process_local_data(
+        sh, np.asarray(ref[k])[klo:khi]
+    )
+    for k in ("xy", "desc", "valid")
+}
+
+out = fn(
+    frames, ref_sharded["xy"], ref_sharded["desc"], ref_sharded["valid"], idx
+)
+
+# every host checks ITS addressable transform shards against the truth
+got = np.concatenate(
+    [np.asarray(s.data) for s in out["transform"].addressable_shards]
+)
+want = truth["transform"][lo:hi]
+err = np.abs(got - want).max()
+assert err < 1e-4, f"process {pid}: transform mismatch {err}"
+print(f"process {pid}: OK, max|dT|={err:.2e}", flush=True)
+"""
+
+
+@pytest.mark.skipif(
+    os.environ.get("KCMC_SKIP_MULTIHOST") == "1",
+    reason="multihost spawn disabled",
+)
+def test_two_process_multihost_matches_single_device(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"process {pid} failed:\nSTDOUT:\n{out}\nSTDERR:\n{err[-3000:]}"
+        )
+        assert f"process {pid}: OK" in out

@@ -87,3 +87,53 @@ def test_rigid3d_z_translation_recovery():
     # transform maps ref coords -> frame coords; frame shifted +dz means
     # sampling at z + dz
     np.testing.assert_allclose(got_dz, dz, atol=0.35)
+
+
+def test_cli_rigid3d_volume_depth(tmp_path):
+    """Config 5 from the CLI: pages fold into D-deep volumes."""
+    import json
+    import subprocess
+    import sys
+
+    from kcmc_tpu.io import read_stack
+    from kcmc_tpu.io.tiff import write_stack
+
+    data = synthetic.make_drift_stack_3d(
+        n_frames=3, shape=(12, 64, 64), max_drift=2.0, seed=17
+    )
+    pages = np.clip(
+        data.stack.reshape(3 * 12, 64, 64) * 40000, 0, 65535
+    ).astype(np.uint16)
+    src = tmp_path / "zstack.tif"
+    write_stack(src, pages)
+
+    script = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import kcmc_tpu.__main__ as m; import sys; sys.exit(m.main(%r))"
+    )
+    args = [
+        "correct", str(src), "-o", str(tmp_path / "out.tif"),
+        "--model", "rigid3d", "--volume-depth", "12",
+        "--transforms", str(tmp_path / "t.npz"), "--batch-size", "3",
+    ]
+    out = subprocess.run(
+        [sys.executable, "-c", script % (args,)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["n_volumes"] == 3
+    assert summary["volume_shape"] == [12, 64, 64]
+    t = np.load(tmp_path / "t.npz")
+    assert t["transforms"].shape == (3, 4, 4)
+    assert read_stack(tmp_path / "out.tif").shape == (36, 64, 64)
+
+    # depth that doesn't divide the page count fails loudly
+    bad = subprocess.run(
+        [sys.executable, "-c", script % ([
+            "correct", str(src), "--model", "rigid3d", "--volume-depth", "7",
+        ],)],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert bad.returncode != 0
+    assert "whole number" in bad.stderr
