@@ -95,6 +95,12 @@ def _cmd_correct(args) -> int:
         "mean_inliers": float(np.mean(res.diagnostics["n_inliers"]))
         if "n_inliers" in res.diagnostics
         else None,
+        # registration-failure detection: frames whose consensus is this
+        # thin are suspect — inspect them (transforms npz has per-frame
+        # n_inliers)
+        "min_inliers": int(np.min(res.diagnostics["n_inliers"]))
+        if "n_inliers" in res.diagnostics
+        else None,
     }
     # With rescue_warp on, warp_ok is rewritten to all-True after the
     # rescue pass; warp_rescued records which frames actually exceeded a

@@ -291,6 +291,7 @@ class JaxBackend:
                         patch_threshold=cfg.inlier_threshold,
                         prior=cfg.patch_prior,
                         smooth_sigma=cfg.field_smooth_sigma,
+                        passes=cfg.field_passes,
                     )
                     out["field"] = res.field
                     if flow_warp is not None:

@@ -247,7 +247,8 @@ class NumpyBackend:
         out["rms_residual"].append(np.float32(rms))
 
     def _estimate_field(self, src, dst, ok, rng, shape):
-        """Mirror of ops/piecewise.estimate_field in NumPy."""
+        """Mirror of ops/piecewise.estimate_field in NumPy (including
+        the residual refinement passes)."""
         cfg = self.config
         gh, gw = cfg.patch_grid
         H, W = shape
@@ -259,6 +260,7 @@ class NumpyBackend:
         cy = (np.arange(gh, dtype=np.float32) + 0.5) * H / gh - 0.5
         cx = (np.arange(gw, dtype=np.float32) + 0.5) * W / gw - 0.5
         reach = 1.5 * max(H / gh, W / gw)
+        thr = cfg.inlier_threshold
         field = np.zeros((gh, gw, 2), np.float32)
         for i in range(gh):
             for j in range(gw):
@@ -266,15 +268,56 @@ class NumpyBackend:
                 member = inl_g & (((src - c) ** 2).sum(-1) < reach * reach)
                 Mp, n_p, _, _ = K.ransac_estimate(
                     "translation", src, dst, member, rng,
-                    n_hypotheses=cfg.patch_hypotheses, threshold=cfg.inlier_threshold,
+                    n_hypotheses=cfg.patch_hypotheses, threshold=thr,
                 )
                 lam = n_p / (n_p + cfg.patch_prior)
                 field[i, j] = lam * Mp[:2, 2] + (1 - lam) * g_t
         field = self._smooth_field(field, cfg.field_smooth_sigma)
+
+        for _ in range(cfg.field_passes - 1):
+            pred = self._sample_field_at(field, src, shape)
+            resid = dst - src - pred
+            gate = inl_g & ((resid**2).sum(-1) < (2.0 * thr) ** 2)
+            dst_resid = dst - pred
+            r = np.zeros((gh, gw, 2), np.float32)
+            for i in range(gh):
+                for j in range(gw):
+                    c = np.array([cx[j], cy[i]], np.float32)
+                    member = gate & (((src - c) ** 2).sum(-1) < reach * reach)
+                    Mp, n_p, _, _ = K.ransac_estimate(
+                        "translation", src, dst_resid, member, rng,
+                        n_hypotheses=cfg.patch_hypotheses, threshold=thr,
+                    )
+                    lam = n_p / (n_p + cfg.patch_prior)
+                    r[i, j] = lam * Mp[:2, 2]
+            field = self._smooth_field(field + r, cfg.field_smooth_sigma)
+
         from kcmc_tpu.utils.synthetic import upsample_field
 
         flow = upsample_field(field, shape)
         return field, flow, n_g, rms_g
+
+    @staticmethod
+    def _sample_field_at(field, pts, shape):
+        """Bilinear sample of a cell-centered (gh, gw, 2) field at
+        (N, 2) points (mirror of ops/piecewise.sample_field_at)."""
+        gh, gw, _ = field.shape
+        H, W = shape
+        gx = np.clip((pts[:, 0] + 0.5) * gw / W - 0.5, 0, gw - 1)
+        gy = np.clip((pts[:, 1] + 0.5) * gh / H - 0.5, 0, gh - 1)
+        x0 = np.floor(gx).astype(np.int32)
+        y0 = np.floor(gy).astype(np.int32)
+        x1 = np.minimum(x0 + 1, gw - 1)
+        y1 = np.minimum(y0 + 1, gh - 1)
+        fx = (gx - x0)[:, None]
+        fy = (gy - y0)[:, None]
+        flat = field.reshape(-1, 2)
+        return (
+            flat[y0 * gw + x0] * (1 - fx) * (1 - fy)
+            + flat[y0 * gw + x1] * fx * (1 - fy)
+            + flat[y1 * gw + x0] * (1 - fx) * fy
+            + flat[y1 * gw + x1] * fx * fy
+        ).astype(np.float32)
 
     @staticmethod
     def _smooth_field(field, sigma):

@@ -50,6 +50,12 @@ class CorrectorConfig:
     # at ~15% better field RMSE than the old 8/0.7 across every regime.
     patch_prior: float = 2.0
     field_smooth_sigma: float = 0.4  # in grid cells
+    # TOTAL field-estimation passes (>= 1): 1 = the plain per-patch
+    # consensus; each pass beyond the first is a residual-refinement
+    # round re-estimating every patch against the previous field's
+    # prediction, turning the membership-averaging bias second-order
+    # (~10% lower field RMSE at 2 passes; see ops/piecewise.py).
+    field_passes: int = 2
     global_threshold: float = 8.0  # generous inlier px for the global stage
 
     # -- diagnostics -------------------------------------------------------
@@ -121,6 +127,10 @@ class CorrectorConfig:
                 "max_rotation_deg must be in (0, 45) — beyond that the "
                 "separable shear decomposition degrades; use warp='jnp' "
                 f"for extreme rotations (got {self.max_rotation_deg})"
+            )
+        if self.field_passes < 1:
+            raise ValueError(
+                f"field_passes must be >= 1, got {self.field_passes}"
             )
         if not 0.0 < self.rescue_warn_fraction <= 1.0:
             raise ValueError(

@@ -132,3 +132,27 @@ def test_affine_nominal_2k_matches_scale():
     rel = relative_transforms(data.transforms)
     rmse = transform_rmse(res.transforms, rel, (512, 512))
     assert rmse < 0.5, f"affine@2k RMSE {rmse:.3f}"
+
+
+def test_piecewise_residual_passes_improve_field():
+    """field_passes=2 (default) must not be worse than a single pass on
+    a seeded stack — the residual pass exists to cut the membership-
+    averaging bias (deterministic: same keys, same data)."""
+    data = synthetic.make_piecewise_stack(
+        n_frames=6, shape=(192, 192), max_disp=5.0, seed=15
+    )
+    from kcmc_tpu.utils.metrics import field_rmse
+
+    gt = data.fields - data.fields[0]
+    errs = {}
+    for passes in (1, 2):
+        res = MotionCorrector(
+            model="piecewise", backend="jax", batch_size=6,
+            field_passes=passes,
+        ).correct(data.stack)
+        errs[passes] = field_rmse(res.fields, gt)
+    assert errs[2] <= errs[1] + 1e-3, errs
+    import pytest
+
+    with pytest.raises(ValueError, match="field_passes"):
+        MotionCorrector(model="piecewise", field_passes=0)

@@ -140,3 +140,22 @@ def test_background_offset_does_not_kill_detection():
         res2.transforms, relative_transforms(d2.transforms), (128, 128)
     )
     assert rmse2 < 0.2
+
+
+def test_nan_frame_degrades_gracefully():
+    """A frame of NaNs (dead camera, flat-field artifact) must not
+    crash or poison its neighbors: the bad frame yields ~no inliers
+    (visible in diagnostics) while every other frame registers."""
+    data = synthetic.make_drift_stack(
+        n_frames=6, shape=(128, 128), model="translation", seed=19
+    )
+    stack = np.array(data.stack)
+    stack[3] = np.nan
+    res = MotionCorrector(
+        model="translation", backend="jax", batch_size=3
+    ).correct(stack)
+    n_in = np.asarray(res.diagnostics["n_inliers"])
+    assert n_in[3] <= 3  # the NaN frame finds no consensus...
+    good = [0, 1, 2, 4, 5]
+    assert (n_in[good] > 10).all()  # ...and the rest are untouched
+    assert np.isfinite(np.asarray(res.transforms)[good]).all()
