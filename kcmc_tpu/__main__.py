@@ -75,6 +75,7 @@ def _cmd_correct(args) -> int:
         output_dtype=args.output_dtype,
         checkpoint=args.checkpoint or None,
         checkpoint_every=args.checkpoint_every,
+        stall_abort=args.stall_exit or None,
     )
 
     if args.transforms:
@@ -261,6 +262,12 @@ def main(argv=None) -> int:
         "same arguments resumes after the last checkpointed frame",
     )
     p.add_argument("--checkpoint-every", type=int, default=512)
+    p.add_argument(
+        "--stall-exit", type=float, default=0,
+        help="exit(3) after this many seconds of zero frame progress "
+        "(wedged device link); rerun with the same --checkpoint to "
+        "resume. Set well above the first batch's compile time.",
+    )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
 

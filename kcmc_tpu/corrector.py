@@ -35,6 +35,50 @@ def _fingerprint(ref) -> str:
     return repr(ref)
 
 
+class _StallWatchdog:
+    """Hard-exit the process when frame progress freezes (correct_file's
+    `stall_abort`). A wedged accelerator link blocks the main thread
+    inside an uninterruptible device wait, so a cooperative exception
+    cannot fire — a daemon thread sampling the progress counter and
+    calling os._exit(3) is the only reliable escape. Pair with
+    `checkpoint=` so the rerun resumes."""
+
+    def __init__(self, timeout_s: float, get_done, total: int):
+        import threading
+
+        self._timeout = float(timeout_s)
+        self._get_done = get_done
+        self._total = total
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        import os
+        import sys
+        import time
+
+        last = self._get_done()
+        last_change = time.monotonic()
+        while not self._stop.wait(min(10.0, self._timeout / 4.0)):
+            done = self._get_done()
+            if done != last:
+                last, last_change = done, time.monotonic()
+            elif time.monotonic() - last_change > self._timeout:
+                print(
+                    f"[kcmc] STALL: no frame progress for {self._timeout:.0f}s "
+                    f"(stuck at {done}/{self._total}); the device link is "
+                    "likely wedged. Exiting 3 — rerun with the same "
+                    "checkpoint to resume.",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                os._exit(3)
+
+    def stop(self):
+        self._stop.set()
+
+
 def _cast_output(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
     """Cast resampled float32 frames to the requested output dtype.
 
@@ -513,6 +557,7 @@ class MotionCorrector:
         output_dtype: str | np.dtype = "input",
         checkpoint: str | None = None,
         checkpoint_every: int = 512,
+        stall_abort: float | None = None,
     ) -> CorrectionResult:
         """Stream-correct a multi-page TIFF stack.
 
@@ -528,6 +573,18 @@ class MotionCorrector:
         (default: match the source file, so a uint16 microscopy stack
         stays uint16 on disk; integer targets are rounded and clipped),
         "float32", or any NumPy dtype.
+
+        `stall_abort`: seconds of zero frame progress after which the
+        PROCESS hard-exits (code 3) with a diagnostic — failure
+        detection for unattended runs. An accelerator link can wedge
+        with no error (observed on this image's TPU tunnel: the socket
+        half-dies and the blocking device wait never returns, which no
+        Python-level exception can interrupt); with `checkpoint` set, a
+        supervisor loop simply reruns the command and the job resumes
+        after the last checkpointed frame. Off (None) by default —
+        libraries shouldn't kill their host process; the CLI exposes it
+        as --stall-exit. Set it well above your first batch's compile
+        time (~2 min at 512x512 on TPU).
 
         `checkpoint`: path to a resume checkpoint (.npz). Every
         `checkpoint_every` processed frames (rounded to batches), the
@@ -553,6 +610,11 @@ class MotionCorrector:
             raise ValueError(
                 "checkpoint requires output= (corrected frames are "
                 "persisted in the output TIFF, not the checkpoint)"
+            )
+        if stall_abort is not None and stall_abort <= 0:
+            raise ValueError(
+                f"stall_abort must be positive seconds, got {stall_abort} "
+                "(use None to disable)"
             )
 
         with TiffStack(path, n_threads=n_threads) as ts:
@@ -682,8 +744,10 @@ class MotionCorrector:
                 if corrected is not None:
                     corrected = _cast_output(corrected, out_dt)
                 if writer is not None and corrected is not None:
-                    for fr in corrected:
-                        writer.append(fr)
+                    # batch append: deflate pages compress in parallel
+                    # through the native encoder when available,
+                    # honoring the caller's IO thread budget
+                    writer.append_batch(corrected, n_threads=n_threads)
                 elif corrected is not None:
                     host["corrected"] = corrected
                 outs.append(host)
@@ -715,6 +779,11 @@ class MotionCorrector:
 
             batch_gen = batches()
             cast = out_dt if np.issubdtype(out_dt, np.integer) else None
+            watchdog = (
+                _StallWatchdog(stall_abort, lambda: cursor["done"], len(ts))
+                if stall_abort
+                else None
+            )
             try:
                 with timer.stage("register_batches"):
                     self._dispatch_batches(
@@ -724,6 +793,8 @@ class MotionCorrector:
                 if checkpoint is not None and cursor["done"] > cursor["saved"]:
                     save_ckpt()
             finally:
+                if watchdog is not None:
+                    watchdog.stop()
                 # Shut the prefetch thread down BEFORE the TiffStack
                 # context closes the native handle it reads through
                 # (closing the generator triggers the loader iterator's

@@ -205,3 +205,57 @@ def test_streaming_checkpoint_replaced_input_restarts(tmp_path):
     assert rb.timing["restored_frames"] == 0  # checkpoint invalidated
     # and the results genuinely reflect the new stack
     assert not np.allclose(ra.transforms, rb.transforms)
+
+
+def test_stall_watchdog_exits_and_resume_completes(tmp_path):
+    """A frozen device wait must turn into exit(3) (stall_abort), and a
+    rerun with the same checkpoint must finish the job."""
+    import subprocess
+
+    data = synthetic.make_drift_stack(
+        n_frames=32, shape=(96, 96), model="translation", seed=23
+    )
+    src = tmp_path / "in.tif"
+    write_stack(src, np.clip(data.stack * 40000, 0, 65535).astype(np.uint16))
+
+    # Child wedges the loader after 5 chunks (like the observed tunnel
+    # hang: blocked forever, no exception), with a 30 s stall budget
+    # (well past the CPU compile, so progress starts before the freeze).
+    # 5 chunks = 20 frames in: with depth-3 pipelined dispatch at least
+    # two batches have drained and checkpointed before the freeze.
+    script = f"""
+import time
+import numpy as np
+import jax; jax.config.update('jax_platforms', 'cpu')
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.io import ChunkedStackLoader
+
+orig = ChunkedStackLoader._read
+calls = {{}}
+def wedge(self, lo, hi):
+    calls['n'] = calls.get('n', 0) + 1
+    if calls['n'] > 5:
+        time.sleep(3600)  # simulated wedged link
+    return orig(self, lo, hi)
+ChunkedStackLoader._read = wedge
+mc = MotionCorrector(model="translation", backend="jax", batch_size=4)
+mc.correct_file({str(src)!r}, output={str(tmp_path / 'out.tif')!r},
+                chunk_size=4, checkpoint={str(tmp_path / 'c.npz')!r},
+                checkpoint_every=4, stall_abort=30.0)
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 3, (out.returncode, out.stderr[-1500:])
+    assert "STALL" in out.stderr
+    assert (tmp_path / "c.npz").exists()  # progress was checkpointed
+
+    # rerun (no wedge): resumes from the checkpoint and completes
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=4)
+    res = mc.correct_file(
+        str(src), output=str(tmp_path / "out.tif"), chunk_size=4,
+        checkpoint=str(tmp_path / "c.npz"), checkpoint_every=4,
+    )
+    assert res.timing["restored_frames"] > 0
+    assert res.transforms.shape == (32, 3, 3)
