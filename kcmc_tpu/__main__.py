@@ -228,8 +228,8 @@ def main(argv=None) -> int:
         "--model",
         default="translation",
         choices=[
-            "translation", "rigid", "affine", "homography", "piecewise",
-            "rigid3d",
+            "translation", "rigid", "similarity", "affine", "homography",
+            "piecewise", "rigid3d",
         ],
     )
     p.add_argument(

@@ -268,6 +268,29 @@ def solve_rigid(src, dst, w):
     return M
 
 
+def solve_similarity(src, dst, w):
+    """Weighted 2D similarity (Umeyama) — mirror of
+    models/transforms.solve_similarity."""
+    if w.sum() < 1e-3:
+        return np.eye(3, dtype=np.float32)
+    cs, cd = _wmean(src, w), _wmean(dst, w)
+    s, d = src - cs, dst - cd
+    a = (w * (s[:, 0] * d[:, 0] + s[:, 1] * d[:, 1])).sum()
+    b = (w * (s[:, 0] * d[:, 1] - s[:, 1] * d[:, 0])).sum()
+    var_s = max((w * (s[:, 0] ** 2 + s[:, 1] ** 2)).sum(), 1e-8)
+    n = np.hypot(a, b)
+    if n < 1e-6:
+        return np.eye(3, dtype=np.float32)
+    scale = n / var_s
+    c, sn = a / n, b / n
+    R = scale * np.array([[c, -sn], [sn, c]], dtype=np.float64)
+    t = cd - R @ cs
+    M = np.eye(3, dtype=np.float32)
+    M[:2, :2] = R
+    M[:2, 2] = t
+    return M
+
+
 def _norm_T(pts, w):
     c = _wmean(pts, w)
     rms = np.sqrt(max(_wmean(((pts - c) ** 2).sum(-1, keepdims=True), w)[0], 1e-16))
@@ -350,6 +373,7 @@ def solve_rigid3d(src, dst, w):
 SOLVERS = {
     "translation": (solve_translation, 1, 2),
     "rigid": (solve_rigid, 2, 2),
+    "similarity": (solve_similarity, 2, 2),
     "affine": (solve_affine, 3, 2),
     "homography": (solve_homography, 4, 2),
     "rigid3d": (solve_rigid3d, 3, 3),

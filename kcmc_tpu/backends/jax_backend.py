@@ -442,7 +442,9 @@ class JaxBackend:
                 warp_batch_translation, interpret=interp, with_ok=True
             )
         use_separable = cfg.warp == "separable" or (
-            cfg.warp == "auto" and cfg.model in ("rigid", "affine") and on_tpu
+            cfg.warp == "auto"
+            and cfg.model in ("rigid", "similarity", "affine")
+            and on_tpu
         )
         if use_separable:
             from kcmc_tpu.ops.warp_separable import warp_batch_affine

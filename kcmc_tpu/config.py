@@ -11,8 +11,8 @@ class CorrectorConfig:
     """All knobs of the registration pipeline. Frozen + hashable so jitted
     batch functions can cache on it."""
 
-    # transform family: translation | rigid | affine | homography |
-    # piecewise | rigid3d
+    # transform family: translation | rigid | similarity | affine |
+    # homography | piecewise | rigid3d
     model: str = "translation"
 
     # -- detection ---------------------------------------------------------
@@ -148,7 +148,7 @@ class CorrectorConfig:
                 f"model {self.model!r} needs warp='jnp' (or 'auto')"
             )
         if self.warp == "separable" and self.model not in (
-            "translation", "rigid", "affine"
+            "translation", "rigid", "similarity", "affine"
         ):
             raise ValueError(
                 "warp='separable' resamples affine-family transforms; "

@@ -169,6 +169,28 @@ def solve_rigid(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarr
     return _guard(_embed(2, R, t), ok=ok)
 
 
+def solve_similarity(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Weighted 2D similarity (uniform scale + rotation + translation),
+    closed form (Umeyama): the rigid Procrustes rotation with
+    scale = |(a, b)| / Σw‖src−c‖² — zoom/defocus drift plus motion,
+    between `rigid` (no scale) and `affine` (anisotropic shear) in the
+    model lattice."""
+    cs = _wmean(src, w)
+    cd = _wmean(dst, w)
+    s = src - cs
+    d = dst - cd
+    a = jnp.sum(w * (s[:, 0] * d[:, 0] + s[:, 1] * d[:, 1]))
+    b = jnp.sum(w * (s[:, 0] * d[:, 1] - s[:, 1] * d[:, 0]))
+    var_s = jnp.maximum(jnp.sum(w * (s[:, 0] ** 2 + s[:, 1] ** 2)), _EPS)
+    norm = jnp.maximum(jnp.sqrt(a * a + b * b), _EPS)
+    scale = norm / var_s
+    c, sn = a / norm, b / norm
+    R = scale * jnp.array([[c, -sn], [sn, c]], dtype=src.dtype)
+    t = cd - _mm(R, cs)
+    ok = jnp.logical_and(jnp.sum(w) > _MIN_MASS, norm > 1e-6)
+    return _guard(_embed(2, R, t), ok=ok)
+
+
 def _solve_sym3(
     M: jnp.ndarray, rhs: jnp.ndarray
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -492,6 +514,9 @@ MODELS: dict[str, TransformModel] = {
     for m in [
         TransformModel("translation", ndim=2, dof=2, min_samples=1, solve=solve_translation),
         TransformModel("rigid", ndim=2, dof=3, min_samples=2, solve=solve_rigid),
+        TransformModel(
+            "similarity", ndim=2, dof=4, min_samples=2, solve=solve_similarity
+        ),
         TransformModel(
             "affine", ndim=2, dof=6, min_samples=3,
             solve=solve_affine, refine_solve=solve_affine_accurate,

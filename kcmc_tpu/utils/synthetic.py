@@ -140,7 +140,7 @@ def make_drift_stack(
     dense scene: n_blobs ~ 4000 with max_keypoints=2048 yields ~2k
     detected keypoints and >1k surviving matches per frame.
     """
-    allowed = ("translation", "rigid", "affine", "homography")
+    allowed = ("translation", "rigid", "similarity", "affine", "homography")
     if model not in allowed:
         raise ValueError(
             f"make_drift_stack model must be one of {allowed}, got {model!r}"
@@ -154,8 +154,11 @@ def make_drift_stack(
     cx, cy = (W - 1) / 2.0, (H - 1) / 2.0
     trans = _random_walk(rng, n_frames, 2, step=1.0, maxdev=max_drift)
     mats = np.tile(np.eye(3, dtype=np.float32), (n_frames, 1, 1))
-    if model in ("rigid", "affine", "homography"):
+    if model in ("rigid", "similarity", "affine", "homography"):
         angles = _random_walk(rng, n_frames, 1, step=0.004, maxdev=0.05)[:, 0]
+    if model == "similarity":
+        # zoom drift: bounded random walk of the uniform scale
+        scales = 1.0 + _random_walk(rng, n_frames, 1, step=0.002, maxdev=0.03)[:, 0]
     for t in range(n_frames):
         M = np.eye(3, dtype=np.float32)
         if model == "translation":
@@ -165,6 +168,8 @@ def make_drift_stack(
             # content out of frame.
             c, s = np.cos(angles[t]), np.sin(angles[t])
             L = np.array([[c, -s], [s, c]], dtype=np.float32)
+            if model == "similarity":
+                L = np.float32(scales[t]) * L
             if model == "affine":
                 L = L @ (np.eye(2, dtype=np.float32) + rng.uniform(-0.02, 0.02, (2, 2)).astype(np.float32))
             M[:2, :2] = L

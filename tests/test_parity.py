@@ -17,7 +17,7 @@ SHAPE = (160, 160)
 
 
 @pytest.mark.parametrize(
-    "model", ["translation", "rigid", "affine", "homography"]
+    "model", ["translation", "rigid", "similarity", "affine", "homography"]
 )
 def test_jax_numpy_transform_parity(model):
     data = synthetic.make_drift_stack(

@@ -68,6 +68,23 @@ def test_affine_drift_recovery():
     assert rmse < 1.0, f"affine RMSE {rmse:.3f} px"
 
 
+def test_similarity_drift_recovery():
+    """Similarity (4-DoF) family: zoom drift + rotation + translation
+    recovered, including the scale component specifically."""
+    data = synthetic.make_drift_stack(
+        n_frames=8, shape=SHAPE, model="similarity", max_drift=6.0, seed=9
+    )
+    mc = MotionCorrector(model="similarity", backend="jax", batch_size=4)
+    res = mc.correct(data.stack)
+    rmse = transform_rmse(res.transforms, relative_transforms(data.transforms), SHAPE)
+    assert rmse < 0.7, f"similarity RMSE {rmse:.3f} px"
+    # recovered per-frame scale must track the ground-truth zoom walk
+    got_s = np.linalg.det(np.asarray(res.transforms)[:, :2, :2]) ** 0.5
+    rel = relative_transforms(data.transforms)
+    want_s = np.linalg.det(rel[:, :2, :2]) ** 0.5
+    np.testing.assert_allclose(got_s, want_s, atol=5e-3)
+
+
 def test_homography_drift_recovery():
     data = synthetic.make_drift_stack(
         n_frames=8, shape=SHAPE, model="homography", max_drift=6.0, seed=7

@@ -21,6 +21,13 @@ def make_gt(name, rng):
         th = rng.uniform(-0.5, 0.5)
         c, s = np.cos(th), np.sin(th)
         M = np.array([[c, -s, 5.0], [s, c, -3.0], [0, 0, 1]], dtype=np.float32)
+    elif name == "similarity":
+        th = rng.uniform(-0.5, 0.5)
+        s = rng.uniform(0.8, 1.2)
+        c, sn = s * np.cos(th), s * np.sin(th)
+        M = np.array(
+            [[c, -sn, 7.0], [sn, c, -4.0], [0, 0, 1]], dtype=np.float32
+        )
     elif name == "affine":
         M = np.eye(3, dtype=np.float32)
         M[:2, :2] += rng.uniform(-0.2, 0.2, (2, 2))
