@@ -39,3 +39,17 @@ print(f"transform RMSE:      {rmse:.3f} px vs ground-truth drift")
 print(f"template corr:       mean {corr.mean():.3f}, min {corr.min():.3f}")
 print(f"rescued frames:      {int(np.asarray(res.diagnostics['warp_rescued']).sum())}")
 print(f"mean inliers/frame:  {np.asarray(res.diagnostics['n_inliers']).mean():.0f}")
+
+# -- multi-channel: apply the structural channel's motion to the
+#    functional channel, then crop to the region covered by every frame
+from kcmc_tpu import apply_correction, common_valid_region
+
+functional = np.clip(
+    np.rint(data.stack**2 * 20000.0 + 400.0), 0, 65535
+).astype(np.uint16)  # same motion, different contrast
+func_corrected = apply_correction(
+    functional, res.transforms, output_dtype="input"
+)
+ys, xs = common_valid_region(res.transforms, (256, 256))
+print(f"functional channel:  {func_corrected.dtype} {func_corrected.shape}")
+print(f"common valid crop:   rows {ys.start}:{ys.stop}, cols {xs.start}:{xs.stop}")

@@ -32,7 +32,12 @@ __all__ = [
 
 def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only use
     try:
-        if name in ("MotionCorrector", "CorrectionResult"):
+        if name in (
+            "MotionCorrector",
+            "CorrectionResult",
+            "apply_correction",
+            "common_valid_region",
+        ):
             from kcmc_tpu import corrector
 
             return getattr(corrector, name)

@@ -109,6 +109,205 @@ class CorrectionResult:
         return self.timing.get("frames_per_sec")
 
 
+def apply_correction(
+    stack: np.ndarray,
+    transforms: np.ndarray | None = None,
+    fields: np.ndarray | None = None,
+    batch_size: int = 32,
+    output_dtype: str | np.dtype = "float32",
+) -> np.ndarray:
+    """Resample a stack through previously-recovered transforms/fields.
+
+    The multi-channel microscopy workflow: register the structural
+    channel (`MotionCorrector.correct`), then apply ITS transforms to
+    the functional channel(s) — the channels share the motion but not
+    the contrast, so estimating on the stable channel and applying to
+    the noisy one beats registering each independently.
+
+        res = mc.correct(structural)
+        functional_corrected = apply_correction(functional, res.transforms)
+
+    Exactly one of `transforms` ((T, 3, 3) / (T, 4, 4)) or `fields`
+    ((T, gh, gw, 2), piecewise) must be given; `stack` is (T, H, W) or
+    (T, D, H, W) matching. Uses the exact (unbounded) warp. Integer
+    `output_dtype` rounds + clips (`"input"` keeps the stack's dtype).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kcmc_tpu.ops.piecewise import upsample_field
+    from kcmc_tpu.ops.warp import warp_frame, warp_frame_flow, warp_volume
+
+    if (transforms is None) == (fields is None):
+        raise ValueError("pass exactly one of transforms= or fields=")
+    stack = np.asarray(stack)
+    if fields is not None and stack.ndim != 3:
+        raise ValueError(
+            "fields= (piecewise) applies to 2D (T, H, W) stacks only; "
+            f"got stack shape {stack.shape}"
+        )
+    n = len(stack)
+    ref = transforms if transforms is not None else fields
+    if len(ref) != n:
+        raise ValueError(
+            f"stack has {n} frames but {len(ref)} transforms/fields"
+        )
+    # jitted warpers are cached at module level so per-channel calls
+    # (the headline use case applies one registration to several
+    # channels) hit the trace cache instead of recompiling
+    if transforms is not None and stack.ndim == 4:
+        fn = _apply_fn("volume", lambda: jax.jit(jax.vmap(warp_volume)))
+        args = lambda lo, hi: (jnp.asarray(transforms[lo:hi]),)
+    elif transforms is not None:
+        fn = _apply_fn("frame", lambda: jax.jit(jax.vmap(warp_frame)))
+        args = lambda lo, hi: (jnp.asarray(transforms[lo:hi]),)
+    else:
+        shape = tuple(stack.shape[1:])
+        fn = _apply_fn(
+            ("flow", shape),
+            lambda: jax.jit(
+                jax.vmap(
+                    lambda f, fld: warp_frame_flow(f, upsample_field(fld, shape))
+                )
+            ),
+        )
+        args = lambda lo, hi: (jnp.asarray(fields[lo:hi], jnp.float32),)
+
+    out_dt = (
+        np.dtype(stack.dtype)
+        if isinstance(output_dtype, str) and output_dtype == "input"
+        else np.dtype(output_dtype)
+    )
+    outs = []
+    for lo in range(0, n, batch_size):
+        hi = min(lo + batch_size, n)
+        got = np.asarray(
+            fn(jnp.asarray(stack[lo:hi], jnp.float32), *args(lo, hi))
+        )
+        outs.append(_cast_output(got, out_dt))
+    return np.concatenate(outs)
+
+
+_APPLY_FN_CACHE: dict = {}
+
+
+def _apply_fn(key, build):
+    if key not in _APPLY_FN_CACHE:
+        _APPLY_FN_CACHE[key] = build()
+    return _APPLY_FN_CACHE[key]
+
+
+def _largest_true_rect(mask: np.ndarray) -> tuple[slice, slice] | None:
+    """Largest axis-aligned all-True rectangle of a 2D boolean mask
+    (row-by-row histogram + monotonic stack, O(H*W))."""
+    H, W = mask.shape
+    heights = np.zeros(W, np.int64)
+    best_area, best = 0, None
+    for y in range(H):
+        heights = np.where(mask[y], heights + 1, 0)
+        stack: list[tuple[int, int]] = []  # (start_col, height)
+        for x in range(W + 1):
+            h = int(heights[x]) if x < W else 0
+            start = x
+            while stack and stack[-1][1] >= h:
+                sx, sh = stack.pop()
+                area = sh * (x - sx)
+                if area > best_area:
+                    best_area = area
+                    best = (slice(y - sh + 1, y + 1), slice(sx, x))
+                start = sx
+            if not stack or h > stack[-1][1]:
+                stack.append((start, h))
+    return best
+
+
+def _longest_true_run(v: np.ndarray) -> slice | None:
+    """Longest contiguous True run of a 1D boolean array."""
+    best, run_start, best_len = None, None, 0
+    for i in range(len(v) + 1):
+        if i < len(v) and v[i]:
+            if run_start is None:
+                run_start = i
+        elif run_start is not None:
+            if i - run_start > best_len:
+                best_len, best = i - run_start, slice(run_start, i)
+            run_start = None
+    return best
+
+
+def common_valid_region(transforms: np.ndarray, shape) -> tuple[slice, ...]:
+    """The largest axis-aligned crop covered by EVERY corrected frame —
+    every pixel inside the returned slices had an in-bounds source
+    sample under every transform (NOT a bounding box: with rotation the
+    common region is a rotated polygon, and this returns its largest
+    inscribed upright rectangle). The standard post-correction crop for
+    downstream analysis.
+
+        ys, xs = common_valid_region(res.transforms, stack.shape[1:])
+        cropped = res.corrected[:, ys, xs]
+
+    2D: transforms (T, 3, 3), shape (H, W) -> (ys, xs). 3D (rigid3d):
+    transforms (T, 4, 4), shape (D, H, W) -> (zs, ys, xs) — a z-run and
+    an inscribed rectangle every plane of the run fully covers.
+
+    Raises ValueError when NO region is covered by every frame (e.g.
+    opposite drifts larger than the frame) — silently returning a crop
+    containing invalid pixels would defeat the function's purpose.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kcmc_tpu.ops.warp import coverage_mask, coverage_mask_3d
+
+    transforms = np.asarray(transforms, np.float32)
+    d = transforms.shape[-1]
+    if d == 4 and len(shape) != 3:
+        raise ValueError("(T, 4, 4) transforms need shape=(D, H, W)")
+    if d == 3 and len(shape) != 2:
+        raise ValueError("(T, 3, 3) transforms need shape=(H, W)")
+    mask_fn = coverage_mask_3d if d == 4 else coverage_mask
+    shape = tuple(int(s) for s in shape)
+    batched = _apply_fn(
+        ("coverage", d, shape),
+        lambda: jax.jit(jax.vmap(lambda M: mask_fn(shape, M))),
+    )
+    # running AND over transform batches: never materializes a
+    # (T, *shape) mask tensor for long recordings
+    common = np.ones(shape, bool)
+    for lo in range(0, len(transforms), 256):
+        chunk = np.asarray(batched(jnp.asarray(transforms[lo : lo + 256])))
+        common &= chunk.all(axis=0)
+
+    empty = ValueError(
+        "no region is covered by every frame — the motion exceeds the "
+        "frame overlap; inspect diagnostics['coverage'] / n_inliers"
+    )
+    if d == 3:
+        rect = _largest_true_rect(common)
+        if rect is None:
+            raise empty
+        return rect
+    # 3D: a z-shift empties the coverage of the end planes entirely, so
+    # start from the longest run of planes with ANY common coverage and
+    # inscribe the rectangle in the AND over the run. Z-dependent shear
+    # can make the per-plane bands disjoint (AND empty over a run whose
+    # every plane is nonempty); shrink the run greedily from whichever
+    # end contributes less coverage until a rectangle exists.
+    zs = _longest_true_run(common.any(axis=(1, 2)))
+    if zs is None:
+        raise empty
+    z0, z1 = zs.start, zs.stop
+    while z1 > z0:
+        rect = _largest_true_rect(common[z0:z1].all(axis=0))
+        if rect is not None:
+            return (slice(z0, z1), rect[0], rect[1])
+        if common[z0].sum() <= common[z1 - 1].sum():
+            z0 += 1
+        else:
+            z1 -= 1
+    raise empty
+
+
 class MotionCorrector:
     """Register every frame of a stack to a reference frame and resample.
 

@@ -81,6 +81,15 @@ def stage_breakdown(
     consecutive prefixes is the stage's incremental cost inside the
     fused program (stages fuse across boundaries, so isolated timings
     mislead).
+
+    Caveat: prefix programs are their own XLA compilations, and a
+    prefix can compile PATHOLOGICALLY differently from the full
+    pipeline (measured: the describe-only prefix at max_keypoints=2048
+    costs 6x the full program that contains it — its (B, K, 8) uint32
+    descriptor output forces a layout the fused program never
+    materializes). Trust the full-program row absolutely, the
+    incremental rows directionally, and profile with `trace()` when a
+    prefix row looks impossible.
     """
     import jax
     import jax.numpy as jnp

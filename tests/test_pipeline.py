@@ -173,3 +173,115 @@ def test_piecewise_residual_passes_improve_field():
 
     with pytest.raises(ValueError, match="field_passes"):
         MotionCorrector(model="piecewise", field_passes=0)
+
+
+def test_apply_correction_multichannel_and_valid_region():
+    """Register the structural channel, apply to the functional channel
+    (multi-channel microscopy workflow), then crop to the common valid
+    region."""
+    from kcmc_tpu import apply_correction, common_valid_region
+
+    data = synthetic.make_drift_stack(
+        n_frames=6, shape=(128, 128), model="translation", max_drift=8.0,
+        seed=27,
+    )
+    # "functional channel": same motion, different contrast
+    functional = (np.asarray(data.stack) ** 2 + 0.1).astype(np.float32)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=3)
+    res = mc.correct(data.stack)
+
+    corr_func = apply_correction(functional, res.transforms, batch_size=4)
+    assert corr_func.shape == functional.shape
+    # applying the structural transforms aligns the functional channel:
+    # compare against directly correcting the functional channel's pixels
+    direct = apply_correction(functional, relative_transforms(data.transforms))
+    m = 20
+    err = np.abs(corr_func[:, m:-m, m:-m] - direct[:, m:-m, m:-m])
+    assert err.mean() < 0.02, err.mean()
+
+    ys, xs = common_valid_region(res.transforms, (128, 128))
+    # the drifted stack can't be fully covered: the crop shrinks
+    assert (ys.stop - ys.start) < 128 or (xs.stop - xs.start) < 128
+    cropped = res.corrected[:, ys, xs]
+    assert (np.abs(cropped).sum(axis=(1, 2)) > 0).all()
+    # inside the common region, every frame matches the reference scene
+    ref = np.asarray(data.stack[0])[ys, xs]
+    for t in range(6):
+        d = np.abs(cropped[t] - ref)
+        assert d.mean() < 0.05, (t, d.mean())
+
+    # uint16 output dtype path + argument validation
+    u16 = apply_correction(
+        functional, res.transforms, output_dtype=np.uint16
+    )
+    assert u16.dtype == np.uint16
+    with pytest.raises(ValueError, match="exactly one"):
+        apply_correction(functional)
+    with pytest.raises(ValueError, match="frames but"):
+        apply_correction(functional[:3], res.transforms)
+
+
+def test_common_valid_region_inscribed_and_3d():
+    """Every pixel of the returned crop must be covered by EVERY
+    transform — including rotations, where the common region is a
+    rotated polygon and a bounding box would lie."""
+    import jax.numpy as jnp
+
+    from kcmc_tpu import common_valid_region
+    from kcmc_tpu.ops.warp import coverage_mask, coverage_mask_3d
+
+    def rot(th, c=31.5):
+        M = np.eye(3, dtype=np.float32)
+        M[:2, :2] = [[np.cos(th), -np.sin(th)], [np.sin(th), np.cos(th)]]
+        M[:2, 2] = [c - M[0, 0] * c - M[0, 1] * c, c - M[1, 0] * c - M[1, 1] * c]
+        return M
+
+    Ms = np.stack([rot(0.2), rot(-0.2), np.eye(3, dtype=np.float32)])
+    ys, xs = common_valid_region(Ms, (64, 64))
+    assert ys.stop - ys.start > 10 and xs.stop - xs.start > 10
+    for M in Ms:
+        cov = np.asarray(coverage_mask((64, 64), jnp.asarray(M)))
+        assert cov[ys, xs].all(), "crop contains uncovered pixels"
+
+    # 3D: z-translation plus in-plane rotation
+    M3 = np.eye(4, dtype=np.float32)
+    M3[2, 3] = 1.7
+    M3b = np.eye(4, dtype=np.float32)
+    M3b[:2, :2] = [[np.cos(0.1), -np.sin(0.1)], [np.sin(0.1), np.cos(0.1)]]
+    zs, ys, xs = common_valid_region(np.stack([M3, M3b]), (8, 32, 32))
+    for M in (M3, M3b):
+        cov = np.asarray(coverage_mask_3d((8, 32, 32), jnp.asarray(M)))
+        assert cov[zs, ys, xs].all()
+    with pytest.raises(ValueError, match="need shape"):
+        common_valid_region(np.stack([M3]), (32, 32))
+
+
+def test_common_valid_region_edge_semantics():
+    """Disjoint coverage raises instead of returning an unsafe crop;
+    z-dependent shear shrinks the z-run until a true rectangle exists;
+    4D stacks reject fields=."""
+    import jax.numpy as jnp
+
+    from kcmc_tpu import apply_correction, common_valid_region
+    from kcmc_tpu.ops.warp import coverage_mask_3d
+
+    # opposite full-frame drifts: zero common coverage -> error
+    A = np.eye(3, dtype=np.float32); A[0, 2] = 70.0
+    B = np.eye(3, dtype=np.float32); B[0, 2] = -70.0
+    with pytest.raises(ValueError, match="no region is covered"):
+        common_valid_region(np.stack([A, B]), (64, 64))
+
+    # x-shear in z makes per-plane bands disjoint across the full run;
+    # the result must still be genuinely covered (run shrinks)
+    S = np.eye(4, dtype=np.float32); S[0, 2] = 4.0
+    S2 = np.eye(4, dtype=np.float32); S2[0, 2] = 4.0; S2[0, 3] = -28.0
+    zs, ys, xs = common_valid_region(np.stack([S, S2]), (8, 32, 32))
+    for M in (S, S2):
+        cov = np.asarray(coverage_mask_3d((8, 32, 32), jnp.asarray(M)))
+        assert cov[zs, ys, xs].all()
+
+    with pytest.raises(ValueError, match="2D"):
+        apply_correction(
+            np.zeros((2, 4, 8, 8), np.float32),
+            fields=np.zeros((2, 2, 2, 2), np.float32),
+        )
