@@ -153,6 +153,11 @@ def _correct_volumetric(args) -> int:
             "kcmc_tpu.utils.checkpoint.ResumableCorrector from Python "
             "for chunk-level resume)"
         )
+    if args.stall_exit:
+        raise SystemExit(
+            "--stall-exit is not supported with --model rigid3d (the "
+            "in-memory volumetric path has no progress watchdog)"
+        )
     pages = read_stack(args.stack, n_threads=args.io_threads)
     T, rem = divmod(len(pages), D)
     if rem:

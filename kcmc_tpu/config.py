@@ -102,11 +102,15 @@ class CorrectorConfig:
     # processed frames exceeded a bounded warp kernel's static motion
     # bound (each such frame pays the slow per-frame exact-warp rescue).
     rescue_warn_fraction: float = 0.25
-    # Auto-escalation: when the warn threshold trips, switch the
-    # REMAINING batches to the exact unbounded warp (one recompile,
+    # Auto-escalation: when the warn threshold trips (cumulative OR
+    # recent-window fraction — late-onset motion must trip too), switch
+    # the REMAINING batches to the exact unbounded warp (one recompile,
     # then full-batch speed) instead of rescuing frame by frame.
-    # Corrected output is identical either way — the rescue path uses
-    # the same exact warp; only the throughput differs.
+    # Out-of-bound frames get identical pixels either way (the rescue
+    # path uses the same exact warp); in-bound frames switch from the
+    # bounded kernel's approximation to the exact warp at the flip, so
+    # checkpointed streaming runs keep warn-only behavior to preserve
+    # resume byte-identity.
     rescue_escalate: bool = True
     # Static bound on the field warp's residual displacement after the
     # mean translation is factored out (piecewise-rigid local motion).

@@ -155,6 +155,17 @@ def apply_correction(
     # jitted warpers are cached at module level so per-channel calls
     # (the headline use case applies one registration to several
     # channels) hit the trace cache instead of recompiling
+    if transforms is not None:
+        want = 4 if stack.ndim == 4 else 3
+        if np.asarray(transforms).shape[-1] != want:
+            raise ValueError(
+                f"stack of rank {stack.ndim} needs ({want}, {want}) "
+                f"transforms, got {np.asarray(transforms).shape[-2:]} — "
+                "a 4x4 rigid3d registration cannot be applied to a 2D "
+                "stack (and vice versa)"
+            )
+    if n == 0:
+        return np.empty(stack.shape, _resolve_apply_dtype(output_dtype, stack))
     if transforms is not None and stack.ndim == 4:
         fn = _apply_fn("volume", lambda: jax.jit(jax.vmap(warp_volume)))
         args = lambda lo, hi: (jnp.asarray(transforms[lo:hi]),)
@@ -173,11 +184,7 @@ def apply_correction(
         )
         args = lambda lo, hi: (jnp.asarray(fields[lo:hi], jnp.float32),)
 
-    out_dt = (
-        np.dtype(stack.dtype)
-        if isinstance(output_dtype, str) and output_dtype == "input"
-        else np.dtype(output_dtype)
-    )
+    out_dt = _resolve_apply_dtype(output_dtype, stack)
     outs = []
     for lo in range(0, n, batch_size):
         hi = min(lo + batch_size, n)
@@ -186,6 +193,12 @@ def apply_correction(
         )
         outs.append(_cast_output(got, out_dt))
     return np.concatenate(outs)
+
+
+def _resolve_apply_dtype(output_dtype, stack) -> np.dtype:
+    if isinstance(output_dtype, str) and output_dtype == "input":
+        return np.dtype(stack.dtype)
+    return np.dtype(output_dtype)
 
 
 _APPLY_FN_CACHE: dict = {}
@@ -298,8 +311,9 @@ def common_valid_region(transforms: np.ndarray, shape) -> tuple[slice, ...]:
         raise empty
     z0, z1 = zs.start, zs.stop
     while z1 > z0:
-        rect = _largest_true_rect(common[z0:z1].all(axis=0))
-        if rect is not None:
+        cur = common[z0:z1].all(axis=0)
+        if cur.any():  # nonempty AND guarantees a rectangle exists —
+            rect = _largest_true_rect(cur)  # one O(H*W) call total
             return (slice(z0, z1), rect[0], rect[1])
         if common[z0].sum() <= common[z1 - 1].sum():
             z0 += 1
@@ -366,7 +380,9 @@ class MotionCorrector:
         self._escalation_backend = None
         self._rescue_seen = 0
         self._rescue_count = 0
+        self._rescue_window: list[tuple[int, int]] = []  # (frames, rescued)
         self._escalated = False
+        self._escalation_allowed = True
         self._rescue_warned = False
 
     # ------------------------------------------------------------------
@@ -580,7 +596,7 @@ class MotionCorrector:
 
     def _dispatch_batches(
         self, batches, ref, drain, depth: int = 3, to_host=True,
-        keep_frames=False, cast_dtype=None,
+        keep_frames=False, cast_dtype=None, allow_escalation=True,
     ):
         """Pipelined dispatch: keep `depth` batches in flight so the
         host->device upload of batch i+1, the compute of batch i, and
@@ -598,12 +614,24 @@ class MotionCorrector:
         run to the unbounded-warp backend mid-stream: the backend is
         re-resolved per batch, so batches dispatched after the flip
         take the exact warp at full batch speed (already-in-flight
-        bounded batches still rescue frame by frame). Corrected output
-        is identical either way — only throughput changes.
+        bounded batches still rescue frame by frame). Out-of-bound
+        frames get the same exact-warp pixels either way; IN-bound
+        frames switch from the bounded (approximate at rotated edges)
+        kernel to the exact warp, so the flip point is visible in the
+        output at the interpolation level — `allow_escalation=False`
+        (set by checkpointed streaming runs) keeps warn-only behavior
+        so a resumed run stays byte-identical to an uninterrupted one.
+
+        NOTE (plugin seam): frames may arrive in their NATIVE dtype
+        (uint16 microscopy pages — half the upload bytes); backends
+        must cast to their compute dtype internally, as both in-tree
+        backends do.
         """
         self._rescue_seen = 0
         self._rescue_count = 0
+        self._rescue_window = []
         self._escalated = False
+        self._escalation_allowed = allow_escalation
         self._rescue_warned = False
         inflight: list[tuple[int, dict, Any]] = []
         accepts_cast: dict[int, bool] = {}  # per-backend, inspected once
@@ -668,6 +696,10 @@ class MotionCorrector:
         if self._rescue_warned or self._rescue_seen < cfg.batch_size:
             return
         frac = self._rescue_count / max(self._rescue_seen, 1)
+        wn = sum(n for n, _ in self._rescue_window)
+        wr = sum(r for _, r in self._rescue_window)
+        if wn >= cfg.batch_size:
+            frac = max(frac, wr / wn)
         if frac <= cfg.rescue_warn_fraction:
             return
         import warnings
@@ -681,6 +713,7 @@ class MotionCorrector:
         )
         can_escalate = (
             cfg.rescue_escalate
+            and self._escalation_allowed
             and getattr(self.backend, "process_batch_async", None) is not None
         )
         if can_escalate:
@@ -714,6 +747,13 @@ class MotionCorrector:
         host["warp_rescued"] = ~ok
         self._rescue_seen += len(ok)
         self._rescue_count += int((~ok).sum())
+        # sliding window: late-onset large motion (e.g. thermal ramp at
+        # hour 3) must trip the policy even when the cumulative fraction
+        # is diluted by thousands of early in-bound frames
+        self._rescue_window.append((len(ok), int((~ok).sum())))
+        win = max(256, 4 * self.config.batch_size)
+        while sum(n for n, _ in self._rescue_window[:-1]) >= win:
+            self._rescue_window.pop(0)
         self._maybe_escalate()
         if ok.all() or "corrected" not in host:
             return
@@ -988,6 +1028,11 @@ class MotionCorrector:
                     self._dispatch_batches(
                         batch_gen, ref, drain, keep_frames=cfg.rescue_warp,
                         cast_dtype=cast,
+                        # checkpointed runs stay on one warp kernel so a
+                        # resume is byte-identical to an uninterrupted
+                        # run (escalation's kernel switch is visible at
+                        # the interpolation level for in-bound frames)
+                        allow_escalation=checkpoint is None,
                     )
                 if checkpoint is not None and cursor["done"] > cursor["saved"]:
                     save_ckpt()
