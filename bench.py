@@ -226,6 +226,7 @@ def main() -> None:
         # equal pixel work.
         for label, model, kw in (
             ("rigid", "rigid", {}),
+            ("similarity", "similarity", {}),
             ("affine", "affine", {}),
             ("affine@2k", "affine", {"max_keypoints": 2048, "n_blobs": 6000}),
             ("homography", "homography", {}),
