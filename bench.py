@@ -122,10 +122,12 @@ def run_bench_device(
     # corrected frames would pin O(n_frames) HBM for nothing.
     n_check = (base + batch - 1) // batch
     done = (n_frames // batch) * batch
-    checks, fps = [], 0.0
-    # Clock/tunnel noise makes single runs swing +-25%; report the best
-    # of three timed sweeps (each is a full dispatch train with a forced
-    # completion barrier, so every sweep is real sustained work).
+    checks, sweeps = [], []
+    # Clock/tunnel noise makes single runs swing +-25%; the judged value
+    # is the best of three timed sweeps (each is a full dispatch train
+    # with a forced completion barrier, so every sweep is real sustained
+    # work) — but ALL three sweep rates are recorded in the result so
+    # round-over-round drift is attributable to noise vs regression.
     for rep in range(3):
         last = None
         t0 = time.perf_counter()
@@ -145,14 +147,20 @@ def run_bench_device(
         # the last batch's output through the host is the honest barrier.
         np.asarray(jnp.sum(last[key]))
         dt = time.perf_counter() - t0
-        fps = max(fps, done / dt)
+        sweeps.append(done / dt)
 
     got = np.concatenate([np.asarray(c) for c in checks])
     rmse = _rmse(
         data, model, got if key == "transform" else None,
         got if key == "field" else None,
     )
-    return {"fps": fps, "seconds": dt, "rmse_px": rmse, "n_frames": done}
+    return {
+        "fps": max(sweeps),
+        "seconds": dt,
+        "rmse_px": rmse,
+        "n_frames": done,
+        "sweeps_fps": [round(s, 2) for s in sweeps],
+    }
 
 
 def run_bench_host(
@@ -218,12 +226,14 @@ def main() -> None:
         file=sys.stderr,
     )
 
+    configs = None
     if args.all:
         # Unified protocol: every sub-config runs the SAME sweep length
         # as the flagship run (short sub-runs read ~20% low under the
         # tunneled platform's clock ramp); a 32x256x256 rigid3d volume is
         # 8x the pixels of a 512x512 frame, so its sweep is frames//8 for
         # equal pixel work.
+        configs = {}
         for label, model, kw in (
             ("rigid", "rigid", {}),
             ("similarity", "similarity", {}),
@@ -233,6 +243,7 @@ def main() -> None:
             ("piecewise", "piecewise", {}),
         ):
             rr = run(args.frames, args.size, model, args.batch, **kw)
+            configs[label] = _config_row(rr)
             print(
                 f"[bench] {label}: {rr['fps']:.1f} fps, rmse {rr['rmse_px']:.3f} px",
                 file=sys.stderr,
@@ -240,27 +251,55 @@ def main() -> None:
         rr = run(
             max(64, args.frames // 8), args.size, "rigid3d", min(args.batch, 8)
         )
+        configs["rigid3d"] = _config_row(rr)
         print(
             f"[bench] rigid3d (32x{args.size // 2}x{args.size // 2}): "
             f"{rr['fps']:.1f} vol/s, rmse {rr['rmse_px']:.3f} px",
             file=sys.stderr,
         )
 
-    print(judged_json_line(args.model, args.size, r["fps"]))
-
-
-def judged_json_line(model: str, size: int, fps: float) -> str:
-    """The driver-contract output: ONE JSON line with metric/value/unit/
-    vs_baseline (vs the 200 fps/chip north-star target)."""
-    target = 200.0  # frames/sec/chip — BASELINE.json north-star target
-    return json.dumps(
-        {
-            "metric": f"registration_throughput_{model}_{size}x{size}",
-            "value": round(fps, 2),
-            "unit": "frames/sec/chip",
-            "vs_baseline": round(fps / target, 3),
-        }
+    print(
+        judged_json_line(
+            args.model, args.size, r["fps"],
+            sweeps_fps=r.get("sweeps_fps"), configs=configs,
+        )
     )
+
+
+def _config_row(r: dict) -> dict:
+    rmse = float(r["rmse_px"])
+    row = {
+        "fps": round(r["fps"], 2),
+        # A degenerate run's NaN would make json.dumps emit bare NaN and
+        # break strict parsers of the one judged stdout line.
+        "rmse_px": round(rmse, 4) if np.isfinite(rmse) else None,
+    }
+    if r.get("sweeps_fps"):  # absent on the --host-io path
+        row["sweeps_fps"] = r["sweeps_fps"]
+    return row
+
+
+def judged_json_line(
+    model: str, size: int, fps: float,
+    sweeps_fps: list | None = None, configs: dict | None = None,
+) -> str:
+    """The driver-contract output: ONE JSON line with metric/value/unit/
+    vs_baseline (vs the 200 fps/chip north-star target). The optional
+    `sweeps_fps` (every timed sweep, not just the best) and `configs`
+    (the --all per-workload rows) ride along as extra keys so the
+    recorded artifact is variance-honest and self-contained."""
+    target = 200.0  # frames/sec/chip — BASELINE.json north-star target
+    rec = {
+        "metric": f"registration_throughput_{model}_{size}x{size}",
+        "value": round(fps, 2),
+        "unit": "frames/sec/chip",
+        "vs_baseline": round(fps / target, 3),
+    }
+    if sweeps_fps:
+        rec["sweeps_fps"] = list(sweeps_fps)  # already rounded at source
+    if configs:
+        rec["configs"] = configs
+    return json.dumps(rec)
 
 
 if __name__ == "__main__":

@@ -33,3 +33,28 @@ def test_judged_json_line_parses():
     assert rec["unit"] == "frames/sec/chip"
     assert rec["vs_baseline"] == round(3210.4 / 200.0, 3)
     assert "\n" not in line
+
+
+def test_judged_json_line_carries_variance_payload():
+    """VERDICT r2 #7: the artifact must record every sweep time and the
+    --all per-config rows, so round-over-round drift is attributable to
+    noise vs regression instead of a single best-of-three number."""
+    sys.path.insert(0, os.path.dirname(_BENCH))
+    import bench
+
+    sweeps = [3101.2, 2980.5, 3055.9]
+    configs = {
+        "affine": {"fps": 1745.0, "rmse_px": 0.051, "sweeps_fps": [1745.0, 1700.1, 1688.8]},
+    }
+    line = bench.judged_json_line(
+        "translation", 512, max(sweeps), sweeps_fps=sweeps, configs=configs
+    )
+    assert "\n" not in line
+    rec = json.loads(line)
+    # Contract keys unchanged...
+    assert rec["value"] == max(sweeps)
+    assert rec["unit"] == "frames/sec/chip"
+    # ...variance payload present and parseable.
+    assert rec["sweeps_fps"] == sweeps
+    assert rec["configs"]["affine"]["fps"] == 1745.0
+    assert rec["configs"]["affine"]["sweeps_fps"][1] == 1700.1
