@@ -60,9 +60,14 @@ def _cast_corrected(corrected: jnp.ndarray, dtype_name: str) -> jnp.ndarray:
     """Round/clip/cast resampled frames to an integer output dtype ON
     DEVICE (mirrors corrector._cast_output), so the device->host copy
     moves the small integer array instead of float32."""
+    from kcmc_tpu.utils.dtypes import int_clip_bounds
+
     dt = jnp.dtype(dtype_name)
-    info = np.iinfo(dt)
-    return jnp.clip(jnp.rint(corrected), info.min, info.max).astype(dt)
+    # Bounds exactly representable in the compute float dtype: clipping
+    # int32 against float32(2**31-1)==2**31.0 would wrap boundary values
+    # to INT32_MIN on the astype.
+    lo, hi = int_clip_bounds(dt, corrected.dtype)
+    return jnp.clip(jnp.rint(corrected), lo, hi).astype(dt)
 
 
 @functools.partial(jax.jit, static_argnames=("shape",))
@@ -94,6 +99,10 @@ class JaxBackend:
     """XLA-compiled pipeline; runs on TPU (or any JAX backend)."""
 
     name = "jax"
+    # Plugin-seam version flag: the orchestrator passes frame batches in
+    # their native dtype (uint16 etc.) only to backends declaring this;
+    # the batch program casts to float32 on device.
+    accepts_native_dtype = True
 
     def __init__(self, config: CorrectorConfig, mesh=None, **_options):
         self.config = config

@@ -87,6 +87,9 @@ def coverage_masks_np(shape, out: dict) -> np.ndarray:
 @register_backend("numpy")
 class NumpyBackend:
     name = "numpy"
+    # Plugin-seam version flag: batches may arrive in their native dtype
+    # (uint16 etc.); _process_one casts each frame to float32.
+    accepts_native_dtype = True
 
     def __init__(self, config: CorrectorConfig, **_options):
         self.config = config

@@ -89,8 +89,11 @@ def _cast_output(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
     if arr.dtype == dtype:
         return arr
     if np.issubdtype(dtype, np.integer):
-        info = np.iinfo(dtype)
-        return np.clip(np.rint(arr), info.min, info.max).astype(dtype)
+        from kcmc_tpu.utils.dtypes import int_clip_bounds
+
+        fdt = arr.dtype if np.issubdtype(arr.dtype, np.floating) else np.float64
+        lo, hi = int_clip_bounds(dtype, fdt)
+        return np.clip(np.rint(arr), lo, hi).astype(dtype)
     return np.asarray(arr, dtype)
 
 
@@ -210,28 +213,59 @@ def _apply_fn(key, build):
     return _APPLY_FN_CACHE[key]
 
 
+def _prev_smaller(hts: np.ndarray) -> np.ndarray:
+    """Per row, the nearest column index to the left holding a STRICTLY
+    smaller value (-1 where none). Vectorized binary lifting: a power-
+    of-two range-minimum table over each row, then every column extends
+    its all->=-own-height span leftward greedily by descending powers of
+    two — O(log W) full-matrix rounds, no interpreter loop over columns."""
+    R, W = hts.shape
+    # st[k][:, j] = min(hts[:, j : j + 2**k])
+    st = [hts]
+    while (1 << len(st)) <= W:
+        half = 1 << (len(st) - 1)
+        prev = st[-1]
+        st.append(np.minimum(prev[:, :-half], prev[:, half:]))
+    cur = np.tile(np.arange(W), (R, 1))  # leftmost col with span-min >= own h
+    for k in range(len(st) - 1, -1, -1):
+        start = cur - (1 << k)
+        sk = st[k]
+        m = np.take_along_axis(sk, np.clip(start, 0, sk.shape[1] - 1), axis=1)
+        ok = (start >= 0) & (m >= hts)
+        cur = np.where(ok, start, cur)
+    return cur - 1
+
+
 def _largest_true_rect(mask: np.ndarray) -> tuple[slice, slice] | None:
-    """Largest axis-aligned all-True rectangle of a 2D boolean mask
-    (row-by-row histogram + monotonic stack, O(H*W))."""
+    """Largest axis-aligned all-True rectangle of a 2D boolean mask.
+
+    Classic per-row histogram formulation, fully vectorized: consecutive-
+    True column heights via a running maximum over row indices, then the
+    widest span each height can fill from nearest-strictly-smaller
+    neighbors on both sides (RMQ binary lifting, O(H W log W) element ops
+    in a few dozen NumPy passes — interpreter-loop-free, so 2048x2048
+    masks take milliseconds, not seconds)."""
     H, W = mask.shape
-    heights = np.zeros(W, np.int64)
-    best_area, best = 0, None
-    for y in range(H):
-        heights = np.where(mask[y], heights + 1, 0)
-        stack: list[tuple[int, int]] = []  # (start_col, height)
-        for x in range(W + 1):
-            h = int(heights[x]) if x < W else 0
-            start = x
-            while stack and stack[-1][1] >= h:
-                sx, sh = stack.pop()
-                area = sh * (x - sx)
-                if area > best_area:
-                    best_area = area
-                    best = (slice(y - sh + 1, y + 1), slice(sx, x))
-                start = sx
-            if not stack or h > stack[-1][1]:
-                stack.append((start, h))
-    return best
+    ys = np.arange(H, dtype=np.int32)[:, None]
+    last_false = np.maximum.accumulate(np.where(mask, -1, ys), axis=0)
+    hts = ys - last_false  # consecutive True count ending at each row
+    # Row blocks keep the transient memory bounded: _prev_smaller holds
+    # all ~log2(W) RMQ levels of a block alive at once, so a block is
+    # sized to ~0.5M elements (~25 MB across levels at int32).
+    rb = max(1, (1 << 19) // max(W, 1))
+    left = np.concatenate(
+        [_prev_smaller(hts[i : i + rb]) for i in range(0, H, rb)]
+    )
+    right = (W - 1) - np.concatenate(
+        [_prev_smaller(hts[i : i + rb, ::-1]) for i in range(0, H, rb)]
+    )[:, ::-1]
+    area = hts * (right - left - 1)
+    flat = int(area.argmax())
+    if area.flat[flat] == 0:
+        return None
+    y, x = divmod(flat, W)
+    h = int(hts[y, x])
+    return (slice(y - h + 1, y + 1), slice(int(left[y, x]) + 1, int(right[y, x])))
 
 
 def _longest_true_run(v: np.ndarray) -> slice | None:
@@ -310,15 +344,21 @@ def common_valid_region(transforms: np.ndarray, shape) -> tuple[slice, ...]:
     if zs is None:
         raise empty
     z0, z1 = zs.start, zs.stop
+    # Incremental AND over the shrinking run: a per-pixel True count is
+    # decremented as planes drop, so each shrink step costs one O(H*W)
+    # compare instead of re-ANDing the whole remaining run.
+    count = common[z0:z1].sum(axis=0, dtype=np.int32)
     while z1 > z0:
-        cur = common[z0:z1].all(axis=0)
+        cur = count == (z1 - z0)
         if cur.any():  # nonempty AND guarantees a rectangle exists —
-            rect = _largest_true_rect(cur)  # one O(H*W) call total
+            rect = _largest_true_rect(cur)  # one call total
             return (slice(z0, z1), rect[0], rect[1])
         if common[z0].sum() <= common[z1 - 1].sum():
+            count -= common[z0]
             z0 += 1
         else:
             z1 -= 1
+            count -= common[z1]
     raise empty
 
 
@@ -422,6 +462,12 @@ class MotionCorrector:
             import jax.numpy as xp
         else:
             xp = np
+        # Same plugin-seam guarantee as _dispatch_batches: only backends
+        # declaring accepts_native_dtype see non-float32 batches.
+        if not getattr(self.backend, "accepts_native_dtype", False) and (
+            sub.dtype != np.float32
+        ):
+            sub = sub.astype(np.float32)
         for _ in range(self.template_iters):
             ref = self.backend.prepare_reference(ref_frame)
             # Refinement only consumes corrected/warp_ok; dropping the
@@ -622,10 +668,12 @@ class MotionCorrector:
         (set by checkpointed streaming runs) keeps warn-only behavior
         so a resumed run stays byte-identical to an uninterrupted one.
 
-        NOTE (plugin seam): frames may arrive in their NATIVE dtype
-        (uint16 microscopy pages — half the upload bytes); backends
-        must cast to their compute dtype internally, as both in-tree
-        backends do.
+        NOTE (plugin seam): frames are passed in their NATIVE dtype
+        (uint16 microscopy pages — half the upload bytes) only to
+        backends declaring `accepts_native_dtype = True` (both in-tree
+        backends do, casting to their compute dtype internally); other
+        plugin backends — including out-of-tree ones written against the
+        original float32 seam — receive float32 batches as before.
         """
         self._rescue_seen = 0
         self._rescue_count = 0
@@ -635,10 +683,18 @@ class MotionCorrector:
         self._rescue_warned = False
         inflight: list[tuple[int, dict, Any]] = []
         accepts_cast: dict[int, bool] = {}  # per-backend, inspected once
+        native_ok: dict[int, bool] = {}
         for n, batch, idx in batches:
             backend = (
                 self._get_escalation_backend() if self._escalated else self.backend
             )
+            bkey = id(backend)
+            if bkey not in native_ok:
+                native_ok[bkey] = bool(
+                    getattr(backend, "accepts_native_dtype", False)
+                )
+            if not native_ok[bkey] and batch.dtype != np.float32:
+                batch = batch.astype(np.float32)
             dispatch = getattr(backend, "process_batch_async", None)
             kept = batch if keep_frames else None
             if dispatch is not None:
@@ -832,7 +888,10 @@ class MotionCorrector:
         with the same arguments resumes after the last checkpointed
         frame — completed chunks are neither re-decoded nor
         re-registered, and the resumed output TIFF is byte-identical to
-        an uninterrupted run (a torn tail page is truncated). Requires
+        an uninterrupted run (a torn tail page is truncated; for
+        deflate outputs the checkpoint records the zlib build and the
+        resumed run pins itself to it — a run resumed under a different
+        zlib build warns and downgrades to pixel-identical). Requires
         `output` (the corrected pixels live in the output file, not the
         checkpoint). Reference selection is deterministic, so it is
         re-derived on resume rather than stored.

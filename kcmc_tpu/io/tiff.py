@@ -122,9 +122,31 @@ def _build_native() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
         ]
         lib.kcmc_deflate_pages.restype = ctypes.c_int
+        lib.kcmc_zlib_version.argtypes = []
+        lib.kcmc_zlib_version.restype = ctypes.c_char_p
     except AttributeError:
         pass
     return lib
+
+
+def _deflate_encoder_id(pin_python: bool = False) -> str:
+    """Identity of the zlib build(s) a deflate stream will be written
+    with: recorded in resume checkpoints, because byte-identical resume
+    holds only when the resumed run compresses through the same encoder
+    (zlib output is deterministic per build+level, but zlib-ng or a
+    version skew produces valid-yet-different bytes)."""
+    py = f"py:{zlib.ZLIB_RUNTIME_VERSION}"
+    if pin_python:
+        return py
+    lib = _get_native()
+    if lib is not None and hasattr(lib, "kcmc_deflate_pages"):
+        ver = (
+            lib.kcmc_zlib_version().decode()
+            if hasattr(lib, "kcmc_zlib_version")
+            else "?"
+        )
+        return f"{py}+native:{ver}"
+    return py
 
 
 def _get_native():
@@ -507,6 +529,10 @@ class TiffWriter:
             self._ifd_ptr_pos = 4
         self._meta = None  # (H, W, dtype)
         self.n_pages = 0
+        # Set by resume() when the checkpointed stream was written by
+        # the Python zlib path: keeps the resumed bytes identical even
+        # if the native parallel encoder has become available since.
+        self._pin_python_deflate = False
 
     # struct formats per flavor: next-IFD pointer, entry-count, entry
     @property
@@ -542,17 +568,22 @@ class TiffWriter:
         """Append a (T, H, W) batch of pages.
 
         With deflate compression and the native library available, the
-        pages compress in parallel through `kcmc_deflate_pages`
-        (bitwise-identical zlib output to the per-page Python path, so
-        resume byte-identity is encoder-independent); otherwise this is
-        a plain per-page loop. The streaming drain hands whole batches
-        here, keeping compressed streaming off the single-thread zlib
-        ceiling.
+        pages compress in parallel through `kcmc_deflate_pages` —
+        bitwise-identical to the per-page Python path ONLY when both
+        link the same zlib build (checkpoints record the encoder id and
+        resume() pins/warns on mismatch; see _deflate_encoder_id);
+        otherwise this is a plain per-page loop. The streaming drain
+        hands whole batches here, keeping compressed streaming off the
+        single-thread zlib ceiling.
         """
         frames = np.asarray(frames)
         if frames.ndim != 3:
             raise ValueError(f"batch must be (T, H, W), got {frames.shape}")
-        if self.compression == "deflate" and len(frames) > 1:
+        if (
+            self.compression == "deflate"
+            and len(frames) > 1
+            and not self._pin_python_deflate
+        ):
             lib = _get_native()
             if lib is not None and hasattr(lib, "kcmc_deflate_pages"):
                 first = self._check_frame(frames[0])
@@ -647,7 +678,7 @@ class TiffWriter:
         open next-IFD pointer, page count, and page metadata.
         """
         self._f.flush()
-        return {
+        state = {
             "file_size": self._f.tell(),
             "ifd_ptr_pos": self._ifd_ptr_pos,
             "n_pages": self.n_pages,
@@ -656,6 +687,9 @@ class TiffWriter:
             if self._meta is None
             else [self._meta[0], self._meta[1], self._meta[2].str],
         }
+        if self.compression == "deflate":
+            state["encoder"] = _deflate_encoder_id(self._pin_python_deflate)
+        return state
 
     @classmethod
     def resume(cls, path, state: dict, compression: str = "none") -> "TiffWriter":
@@ -665,6 +699,13 @@ class TiffWriter:
         leave a torn page) and re-zeros the last completed page's
         next-IFD pointer, restoring the byte-exact writer state, so the
         resumed stream is indistinguishable from an uninterrupted one.
+
+        For deflate streams the checkpoint records which zlib build(s)
+        wrote the file; when the recorded encoder was the Python path,
+        the resumed writer pins itself to it (so a native encoder that
+        appeared since cannot change the bytes), and when the recorded
+        encoder is no longer reproducible (zlib version skew) a warning
+        downgrades the guarantee to pixel-identical for this resume.
         """
         if compression not in _COMP_CODES:
             raise ValueError(f"compression must be one of {sorted(_COMP_CODES)}")
@@ -704,6 +745,26 @@ class TiffWriter:
             else (int(meta[0]), int(meta[1]), np.dtype(meta[2]))
         )
         w.n_pages = int(state["n_pages"])
+        w._pin_python_deflate = False
+        if compression == "deflate":
+            recorded = state.get("encoder")
+            if recorded == _deflate_encoder_id(pin_python=True):
+                # Stream written by Python zlib only: pin the resumed
+                # writer to it so the bytes stay identical even if the
+                # native encoder is available now.
+                w._pin_python_deflate = True
+            elif recorded is not None and recorded != _deflate_encoder_id():
+                import warnings
+
+                warnings.warn(
+                    f"kcmc: resume checkpoint was written by deflate "
+                    f"encoder {recorded!r} but this run would use "
+                    f"{_deflate_encoder_id()!r}; the resumed file will "
+                    "be pixel-identical but may not be byte-identical "
+                    "to an uninterrupted run",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         return w
 
 

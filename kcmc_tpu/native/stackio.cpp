@@ -515,6 +515,13 @@ uint64_t kcmc_deflate_bound(uint64_t page_bytes) {
   return compressBound((uLong)page_bytes);
 }
 
+// Encoder provenance: the version string of the zlib this library links.
+// io/tiff.py records it in resume checkpoints — byte-identical resume of
+// a deflate stream holds only when the resumed run compresses through
+// the same zlib build (a zlib-ng or version-skewed libz produces valid
+// but different bytes).
+const char* kcmc_zlib_version(void) { return zlibVersion(); }
+
 // src: contiguous (n_pages, page_bytes); dst: n_pages * bound bytes;
 // out_sizes[i] receives page i's compressed size. level: zlib 1..9.
 // Returns 0 on success. Output is bitwise identical to Python's

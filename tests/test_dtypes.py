@@ -65,3 +65,62 @@ def test_correct_file_preserves_source_dtype(tmp_path, uint16_data):
         frames = np.asarray(ts.read(0, len(ts)))
     assert frames.shape == stack16.shape
     assert frames.max() > 30000
+
+
+def test_int32_output_boundary_does_not_wrap():
+    """ADVICE r2: float32(2**31-1) == 2**31.0, so clipping int32 targets
+    against iinfo.max in float32 wrapped boundary values to INT32_MIN on
+    the final astype. The clip bounds must be exactly representable."""
+    from kcmc_tpu.corrector import _cast_output
+    from kcmc_tpu.utils.dtypes import int_clip_bounds
+
+    arr = np.array([2.2e9, -2.2e9, 1234.6], np.float32)
+    out = _cast_output(arr, np.dtype(np.int32))
+    assert out.dtype == np.int32
+    assert out[0] > 0, f"positive saturation wrapped: {out[0]}"
+    assert out[1] < 0, f"negative saturation wrapped: {out[1]}"
+    assert out[2] == 1235
+
+    lo, hi = int_clip_bounds(np.dtype(np.int32), np.float32)
+    assert int(hi) <= np.iinfo(np.int32).max
+    assert int(lo) >= np.iinfo(np.int32).min
+    lo64, hi64 = int_clip_bounds(np.dtype(np.int64), np.float64)
+    assert int(hi64) <= np.iinfo(np.int64).max
+    assert int(lo64) >= np.iinfo(np.int64).min
+
+
+def test_device_cast_int32_boundary_does_not_wrap():
+    import jax.numpy as jnp
+
+    from kcmc_tpu.backends.jax_backend import _cast_corrected
+
+    out = np.asarray(
+        _cast_corrected(jnp.asarray([2.2e9, -2.2e9], jnp.float32), "int32")
+    )
+    assert out[0] > 0 and out[1] < 0
+
+
+def test_plugin_backend_without_native_dtype_flag_gets_float32():
+    """ADVICE r2: out-of-tree backends written against the original
+    float32 seam must not silently receive integer batches."""
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.backends import _np_kernels  # noqa: F401 (import check)
+    from kcmc_tpu.backends.numpy_backend import NumpyBackend
+
+    seen = []
+
+    class LegacyBackend(NumpyBackend):
+        # Simulate a plugin predating the native-dtype seam.
+        accepts_native_dtype = False
+
+        def process_batch(self, frames, ref, idx):
+            seen.append(np.asarray(frames).dtype)
+            return super().process_batch(frames, ref, idx)
+
+    mc = MotionCorrector(model="translation", backend="numpy", batch_size=4)
+    mc.backend = LegacyBackend(mc.config)
+    stack = (np.random.default_rng(0).uniform(0, 1000, (4, 64, 64))).astype(
+        np.uint16
+    )
+    mc.correct(stack)
+    assert seen and all(dt == np.float32 for dt in seen), seen

@@ -248,3 +248,48 @@ def test_append_batch_bigtiff(tmp_path):
         w.append_batch(stack)
     with TiffStack(p) as ts:
         np.testing.assert_array_equal(ts.read(0, 4), stack)
+
+
+def test_deflate_checkpoint_records_encoder_and_pins_python(tmp_path):
+    """ADVICE r2: resume byte-identity for deflate streams holds only
+    under the same zlib build. The checkpoint records the encoder; a
+    stream recorded as Python-zlib pins the resumed writer to the Python
+    path, and an unreproducible encoder downgrades with a warning."""
+    import warnings
+
+    from kcmc_tpu.io.tiff import TiffWriter, _deflate_encoder_id
+
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 1000, (4, 32, 32), dtype=np.uint16)
+
+    p = tmp_path / "a.tif"
+    w = TiffWriter(p, compression="deflate")
+    w.append_batch(frames[:2])
+    state = w.checkpoint_state()
+    w.close()
+    assert "encoder" in state and state["encoder"].startswith("py:")
+
+    # Recorded as Python-only: the resumed writer must pin to Python
+    # zlib even if the native encoder is available.
+    st_py = dict(state, encoder=_deflate_encoder_id(pin_python=True))
+    w2 = TiffWriter.resume(p, st_py, compression="deflate")
+    assert w2._pin_python_deflate
+    w2.append_batch(frames[2:])
+    w2.close()
+    got = read_stack(p)
+    np.testing.assert_array_equal(got, frames)
+
+    # Unreproducible encoder: resume still works, with a warning.
+    st_alien = dict(state, encoder="py:0.0-zlib-ng")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        w3 = TiffWriter.resume(p, st_alien, compression="deflate")
+        w3.close()
+    assert any("byte-identical" in str(r.message) for r in rec)
+
+    # Uncompressed streams carry no encoder key (nothing to pin).
+    p2 = tmp_path / "b.tif"
+    w4 = TiffWriter(p2)
+    w4.append(frames[0])
+    assert "encoder" not in w4.checkpoint_state()
+    w4.close()
