@@ -29,13 +29,18 @@ def test_jax_numpy_transform_parity(model):
     rmse_j = transform_rmse(rj.transforms, rel, SHAPE)
     rmse_n = transform_rmse(rn.transforms, rel, SHAPE)
     cross = transform_rmse(rj.transforms, rn.transforms, SHAPE)
-    assert rmse_j < 1.0, f"jax {model} RMSE {rmse_j:.3f}"
-    assert rmse_n < 1.0, f"numpy {model} RMSE {rmse_n:.3f}"
-    # The backends' RANSAC draws are independent, so their mutual distance
-    # is bounded by sqrt(rmse_j^2 + rmse_n^2) in expectation — the real
-    # accuracy guard is each backend's distance to ground truth above.
-    bound = 1.2 * float(np.hypot(rmse_j, rmse_n)) + 0.05
-    assert cross < bound, f"cross-backend {model} RMSE {cross:.3f} (bound {bound:.3f})"
+    # ABSOLUTE bounds pinned to ~2x the delivered accuracy (VERDICT r2
+    # #3: a self-scaling bound lets a correlated regression in both
+    # backends inflate its own tolerance). Measured at these seeds
+    # (2026-07-31): per-backend ground-truth RMSE 0.057-0.139 px
+    # (homography worst), cross-backend 0.000-0.093 px. The backends'
+    # RANSAC draws are independent, so cross-agreement is statistical
+    # (~hypot(rmse_j, rmse_n) in expectation, i.e. up to ~0.2 px at the
+    # worst delivered per-backend accuracy): 0.25 keeps headroom for a
+    # PRNG-stream change while still failing a real 2x agreement drift.
+    assert rmse_j < 0.3, f"jax {model} RMSE {rmse_j:.3f}"
+    assert rmse_n < 0.3, f"numpy {model} RMSE {rmse_n:.3f}"
+    assert cross < 0.25, f"cross-backend {model} RMSE {cross:.3f}"
 
 
 def test_descriptor_bit_parity():
@@ -82,10 +87,11 @@ def test_rigid3d_parity():
     rmse_j = transform_rmse(rj.transforms, rel, shape)
     rmse_n = transform_rmse(rn.transforms, rel, shape)
     cross = transform_rmse(rj.transforms, rn.transforms, shape)
-    assert rmse_j < 1.0, f"jax rigid3d RMSE {rmse_j:.3f}"
-    assert rmse_n < 1.0, f"numpy rigid3d RMSE {rmse_n:.3f}"
-    bound = 1.2 * float(np.hypot(rmse_j, rmse_n)) + 0.05
-    assert cross < bound, f"cross-backend rigid3d RMSE {cross:.3f} (bound {bound:.3f})"
+    # Absolute bounds at ~2-3x delivered (measured 2026-07-31: both
+    # backends 0.089 px, cross 0.000) — see the 2D parity test's note.
+    assert rmse_j < 0.3, f"jax rigid3d RMSE {rmse_j:.3f}"
+    assert rmse_n < 0.3, f"numpy rigid3d RMSE {rmse_n:.3f}"
+    assert cross < 0.25, f"cross-backend rigid3d RMSE {cross:.3f}"
 
 
 def test_descriptor_bit_parity_3d():
@@ -131,6 +137,12 @@ def test_piecewise_parity_and_recovery():
     ej = field_rmse(rj.fields, gt_rel)
     en = field_rmse(rn.fields, gt_rel)
     cross = field_rmse(rj.fields, rn.fields)
-    assert ej < 1.5, f"jax piecewise field RMSE {ej:.3f}"
-    assert en < 1.5, f"numpy piecewise field RMSE {en:.3f}"
-    assert cross < 1.0, f"cross-backend field RMSE {cross:.3f}"
+    # Absolute bounds (measured 2026-07-31: both backends 0.54 px field
+    # RMSE — representation bias of the 8x8 patch grid, see DESIGN.md —
+    # cross 0.026 px). 0.8 fails a 1.5x ground-truth regression; the
+    # cross bound is deliberately looser (~6x delivered) because patch-
+    # level RANSAC agreement is noisier than the matrix models', yet
+    # still ~4x tighter than the old 1.0 px tolerance.
+    assert ej < 0.8, f"jax piecewise field RMSE {ej:.3f}"
+    assert en < 0.8, f"numpy piecewise field RMSE {en:.3f}"
+    assert cross < 0.15, f"cross-backend field RMSE {cross:.3f}"
