@@ -1,0 +1,49 @@
+"""Generate the judged north-star workload: 512x512x10,000-frame
+synthetic-drift stack (BASELINE.json), streamed to a BigTIFF.
+
+Reproduces the RUN10K.md input: bounded random-walk translation drift
+(step 1 px, max +-10 px), 0.01 noise, uint16, written incrementally so
+the 5.2 GB output never lives in memory. Ground-truth transforms are
+saved alongside for the RMSE check.
+
+    python examples/make_judged_stack.py out.tif gt.npz [n_frames]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+from kcmc_tpu.io.tiff import TiffWriter
+from kcmc_tpu.utils import synthetic
+
+
+def main() -> None:
+    out = sys.argv[1] if len(sys.argv) > 1 else "judged10k.tif"
+    gt_path = sys.argv[2] if len(sys.argv) > 2 else "judged10k_gt.npz"
+    n = int(sys.argv[3]) if len(sys.argv) > 3 else 10_000
+    shape = (512, 512)
+
+    rng = np.random.default_rng(0)
+    scene = synthetic.render_scene(rng, shape)
+    trans = synthetic._random_walk(rng, n, 2, step=1.0, maxdev=10.0)
+    mats = np.tile(np.eye(3, dtype=np.float32), (n, 1, 1))
+    mats[:, :2, 2] = trans
+
+    t0 = time.perf_counter()
+    with TiffWriter(out, bigtiff=True) as w:
+        for t in range(n):
+            frame = synthetic._warp_scene(scene, mats[t])
+            frame = frame + rng.normal(0, 0.01, shape).astype(np.float32)
+            w.append(np.clip(frame * 40000.0, 0, 65535).astype(np.uint16))
+            if (t + 1) % 1000 == 0:
+                rate = (t + 1) / (time.perf_counter() - t0)
+                print(f"{t + 1}/{n} frames ({rate:.0f} fps)", flush=True)
+    np.savez_compressed(gt_path, transforms=mats)
+    print(f"wrote {out} + {gt_path} in {time.perf_counter() - t0:.0f}s")
+
+
+if __name__ == "__main__":
+    main()

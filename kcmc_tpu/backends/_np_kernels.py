@@ -75,10 +75,12 @@ def detect_keypoints(
     nms_size: int = 5,
     border: int = 16,
     harris_k: float = 0.04,
+    window_sigma: float = WINDOW_SIGMA,
+    cand_tile: int = CAND_TILE,
 ):
     """Returns (xy (K,2), score (K,), valid (K,)) with K = max_keypoints."""
     H, W = img.shape
-    resp = harris_response(img, k=harris_k)
+    resp = harris_response(img, k=harris_k, window_sigma=window_sigma)
     r = nms_size // 2
     padded = np.pad(resp, r, constant_values=-np.inf)
     win = np.lib.stride_tricks.sliding_window_view(padded, (nms_size, nms_size))
@@ -95,7 +97,7 @@ def detect_keypoints(
     # Tile-bucketed candidate reduction — same rule as ops/detect.py
     # (strongest surviving pixel per tile, then global top-k), so the
     # two backends select the same keypoint set.
-    T = CAND_TILE
+    T = cand_tile
     Hp, Wp = -(-H // T) * T, -(-W // T) * T
     m = np.full((Hp, Wp), -np.inf, np.float32)
     m[:H, :W] = masked

@@ -122,6 +122,8 @@ class JaxBackend:
                 nms_size=cfg.nms_size,
                 border=cfg.border,
                 harris_k=cfg.harris_k,
+                window_sigma=cfg.harris_window_sigma,
+                cand_tile=cfg.cand_tile,
             )
             desc = describe_keypoints(
                 frame, kps, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
@@ -259,6 +261,8 @@ class JaxBackend:
                 harris_k=cfg.harris_k,
                 use_pallas=use_pallas_patches,
                 smooth_sigma=cfg.blur_sigma,
+                window_sigma=cfg.harris_window_sigma,
+                cand_tile=cfg.cand_tile,
             )
             desc = describe_keypoints_batch(
                 frames,
@@ -301,6 +305,7 @@ class JaxBackend:
                         prior=cfg.patch_prior,
                         smooth_sigma=cfg.field_smooth_sigma,
                         passes=cfg.field_passes,
+                        refine_reach_scale=cfg.refine_reach_scale,
                     )
                     out["field"] = res.field
                     if flow_warp is not None:

@@ -115,6 +115,8 @@ class NumpyBackend:
             nms_size=cfg.nms_size,
             border=cfg.border,
             harris_k=cfg.harris_k,
+            window_sigma=cfg.harris_window_sigma,
+            cand_tile=cfg.cand_tile,
         )
         desc = K.describe_keypoints(
             np.asarray(ref_frame, np.float32),
@@ -165,6 +167,8 @@ class NumpyBackend:
             nms_size=cfg.nms_size,
             border=cfg.border,
             harris_k=cfg.harris_k,
+            window_sigma=cfg.harris_window_sigma,
+            cand_tile=cfg.cand_tile,
         )
         desc = K.describe_keypoints(
             frame, xy, valid, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
@@ -277,7 +281,12 @@ class NumpyBackend:
                 field[i, j] = lam * Mp[:2, 2] + (1 - lam) * g_t
         field = self._smooth_field(field, cfg.field_smooth_sigma)
 
-        for _ in range(cfg.field_passes - 1):
+        pitch = max(H / gh, W / gw)
+        for it in range(cfg.field_passes - 1):
+            # refinement reach shrink (mirror of ops/piecewise.py)
+            reach_r = max(
+                reach * cfg.refine_reach_scale ** (it + 1), 0.75 * pitch
+            )
             pred = self._sample_field_at(field, src, shape)
             resid = dst - src - pred
             gate = inl_g & ((resid**2).sum(-1) < (2.0 * thr) ** 2)
@@ -286,7 +295,7 @@ class NumpyBackend:
             for i in range(gh):
                 for j in range(gw):
                     c = np.array([cx[j], cy[i]], np.float32)
-                    member = gate & (((src - c) ** 2).sum(-1) < reach * reach)
+                    member = gate & (((src - c) ** 2).sum(-1) < reach_r * reach_r)
                     Mp, n_p, _, _ = K.ransac_estimate(
                         "translation", src, dst_resid, member, rng,
                         n_hypotheses=cfg.patch_hypotheses, threshold=thr,

@@ -21,6 +21,16 @@ class CorrectorConfig:
     nms_size: int = 5
     border: int = 16  # keep descriptor patches in-bounds
     harris_k: float = 0.04
+    # Harris structure-tensor window sigma: the detector's resolution
+    # limit — response maxima can't sit much closer than ~2*sigma, so
+    # 1.5 (the classic default) caps detection near ~2.6k keypoints on
+    # a 512^2 frame. Config 2's ~2k-matches regime runs 1.0 (measured
+    # 6.7k maxima on a dense scene) at a small noise-robustness cost.
+    harris_window_sigma: float = 1.5
+    # Candidate-reduction tile side (at most one keypoint per tile —
+    # ORB-style spatial spreading). 8 caps selection at (H/8)*(W/8)
+    # keypoints; high-K configs need 4.
+    cand_tile: int = 8
 
     # -- description -------------------------------------------------------
     oriented: bool | None = None  # None => auto: off for translation
@@ -54,8 +64,18 @@ class CorrectorConfig:
     # consensus; each pass beyond the first is a residual-refinement
     # round re-estimating every patch against the previous field's
     # prediction, turning the membership-averaging bias second-order
-    # (~10% lower field RMSE at 2 passes; see ops/piecewise.py).
-    field_passes: int = 2
+    # (see ops/piecewise.py). 3 passes with the shrinking reach below
+    # cut field RMSE 0.54 -> 0.37 px on the rich 512^2 workload and
+    # improve every measured regime (DESIGN.md "Piecewise refinement
+    # reach"); drop to 2 to shave ~15% off the piecewise stage cost.
+    field_passes: int = 3
+    # Membership-reach multiplier applied per refinement pass (floored
+    # at 0.75 patch pitch). Pass 1 needs the wide 1.5-pitch reach for
+    # robustness; refinement passes correct a small residual, where a
+    # tighter neighborhood averages less of the variation being
+    # recovered. Swept in DESIGN.md "Piecewise refinement reach":
+    # monotone improvement down to 0.5 in every regime.
+    refine_reach_scale: float = 0.5
     global_threshold: float = 8.0  # generous inlier px for the global stage
 
     # -- diagnostics -------------------------------------------------------
@@ -123,6 +143,15 @@ class CorrectorConfig:
         if self.blur_sigma <= 0.0:
             raise ValueError(
                 f"blur_sigma must be positive, got {self.blur_sigma}"
+            )
+        if self.harris_window_sigma <= 0.0:
+            raise ValueError(
+                "harris_window_sigma must be positive, got "
+                f"{self.harris_window_sigma}"
+            )
+        if self.cand_tile < 1:
+            raise ValueError(
+                f"cand_tile must be >= 1, got {self.cand_tile}"
             )
         if self.max_rotation_deg is not None and not (
             0.0 < self.max_rotation_deg < 45.0

@@ -143,6 +143,7 @@ def _select_keypoints(
     max_keypoints: int,
     threshold: float,
     border: int,
+    cand_tile: int = CAND_TILE,
 ) -> Keypoints:
     """Fixed-K keypoint selection from dense detection fields.
 
@@ -172,7 +173,7 @@ def _select_keypoints(
     # winners. Cuts the top-k from H*W candidates to (H*W)/TILE^2 with an
     # at-most-one-keypoint-per-tile cap (grid-bucketed detection, the
     # ORB-style spatial spreading), which for K << #tiles is benign.
-    T = CAND_TILE
+    T = cand_tile
     Hp, Wp = -(-H // T) * T, -(-W // T) * T
     m = jnp.pad(masked, ((0, Hp - H), (0, Wp - W)), constant_values=-jnp.inf)
     tiles = m.reshape(Hp // T, T, Wp // T, T).transpose(0, 2, 1, 3)
@@ -206,7 +207,12 @@ def _select_keypoints(
     return Keypoints(xy=xy, score=scores, valid=valid)
 
 
-@functools.partial(jax.jit, static_argnames=("max_keypoints", "nms_size", "border"))
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_keypoints", "nms_size", "border", "window_sigma", "cand_tile"
+    ),
+)
 def detect_keypoints(
     img: jnp.ndarray,
     max_keypoints: int = 512,
@@ -214,22 +220,26 @@ def detect_keypoints(
     nms_size: int = 5,
     border: int = 16,
     harris_k: float = 0.04,
+    window_sigma: float = WINDOW_SIGMA,
+    cand_tile: int = CAND_TILE,
 ) -> Keypoints:
     """Detect up to `max_keypoints` Harris corners in a (H, W) frame.
 
     Returns fixed-K arrays; `valid[i]` is False for slots whose response
     fell at/below `threshold` (relative to the frame's peak response).
     Dense corner clusters are thinned to at most one keypoint per
-    CAND_TILE x CAND_TILE tile (in addition to `nms_size` suppression) —
-    the candidate-reduction grid both backends share.
+    `cand_tile` x `cand_tile` tile (in addition to `nms_size`
+    suppression) — the candidate-reduction grid both backends share.
+    `window_sigma` is the Harris structure-tensor window: the detector's
+    density ceiling (see CorrectorConfig.harris_window_sigma).
     """
-    resp = harris_response(img, k=harris_k)
+    resp = harris_response(img, k=harris_k, window_sigma=window_sigma)
     # NMS: keep strict local maxima of the response.
     is_max = resp >= _maxpool_same(resp, nms_size)
     nms_resp = jnp.where(is_max, resp, -jnp.inf)
     ox_f, oy_f = _subpixel_fields(resp)
     return _select_keypoints(
-        nms_resp, ox_f, oy_f, max_keypoints, threshold, border
+        nms_resp, ox_f, oy_f, max_keypoints, threshold, border, cand_tile
     )
 
 
@@ -237,7 +247,8 @@ def detect_keypoints(
     jax.jit,
     static_argnames=(
         "max_keypoints", "threshold", "nms_size", "border", "harris_k",
-        "use_pallas", "smooth_sigma", "interpret",
+        "use_pallas", "smooth_sigma", "interpret", "window_sigma",
+        "cand_tile",
     ),
 )
 def detect_keypoints_batch(
@@ -250,6 +261,8 @@ def detect_keypoints_batch(
     use_pallas: bool = False,
     smooth_sigma: float | None = None,
     interpret: bool = False,
+    window_sigma: float = WINDOW_SIGMA,
+    cand_tile: int = CAND_TILE,
 ):
     """Detect keypoints over a (B, H, W) batch; fields carry a batch axis.
 
@@ -272,14 +285,15 @@ def detect_keypoints_batch(
         # border >= 1: the kernel's subpixel fields differ from the jnp
         # path on the 1-px frame boundary (zero- vs edge-extension);
         # border=0 keypoints could land there, so take the jnp route.
-        if border >= 1 and supports((H, W), nms_size, WINDOW_SIGMA, smooth_sigma):
+        if border >= 1 and supports((H, W), nms_size, window_sigma, smooth_sigma):
             out = response_fields(
                 frames, harris_k=harris_k, nms_size=nms_size,
+                window_sigma=window_sigma,
                 smooth_sigma=smooth_sigma, interpret=interpret,
             )
             kps = jax.vmap(
                 lambda nr, ox, oy: _select_keypoints(
-                    nr, ox, oy, max_keypoints, threshold, border
+                    nr, ox, oy, max_keypoints, threshold, border, cand_tile
                 )
             )(*out[:3])
             return (kps, out[3]) if smooth_sigma is not None else kps
@@ -291,6 +305,8 @@ def detect_keypoints_batch(
             nms_size=nms_size,
             border=border,
             harris_k=harris_k,
+            window_sigma=window_sigma,
+            cand_tile=cand_tile,
         )
     )(frames)
     if smooth_sigma is not None:

@@ -112,3 +112,66 @@ def test_detect_describe_vmap_over_frames(scene):
     descs = jax.vmap(describe_keypoints)(stack, kps)
     assert descs.shape == (3, 32, N_WORDS)
     np.testing.assert_array_equal(np.asarray(descs[0]), np.asarray(descs[2]))
+
+
+def test_mxu_match_exactly_equals_xor_topk_oracle():
+    """The MXU ±1-matmul + min/argmin match must reproduce the direct
+    XOR+popcount+top_k formulation bit-for-bit: same distance matrix,
+    same best index (ties -> lowest index), same runner-up value, same
+    validity under ratio/mutual/cap — including masked slots and
+    duplicate descriptors (forced distance ties)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from kcmc_tpu.ops.describe import N_BITS
+    from kcmc_tpu.ops.match import (
+        Matches,
+        hamming_matrix,
+        hamming_matrix_mxu,
+        knn_match,
+    )
+
+    rng = np.random.default_rng(11)
+    Kq, Kr, W = 96, 80, 8
+    q = rng.integers(0, 2**32, (Kq, W), dtype=np.uint32)
+    r = rng.integers(0, 2**32, (Kr, W), dtype=np.uint32)
+    # force exact-duplicate descriptors (distance-0 ties) and shared rows
+    q[10] = q[11] = r[5]
+    r[6] = r[5]
+    q[-1] = q[0]
+    qv = rng.uniform(size=Kq) < 0.9
+    rv = rng.uniform(size=Kr) < 0.9
+    qj, rj = jnp.asarray(q), jnp.asarray(r)
+    qvj, rvj = jnp.asarray(qv), jnp.asarray(rv)
+
+    D_xor = np.asarray(hamming_matrix(qj, rj, qvj, rvj))
+    D_mxu = np.asarray(hamming_matrix_mxu(qj, rj, qvj, rvj))
+    np.testing.assert_array_equal(D_xor, D_mxu)
+
+    def oracle(ratio=0.85, max_dist=80, mutual=True):
+        Di = jnp.asarray(D_xor).astype(jnp.int32)
+        neg2, idx2 = lax.top_k(-Di, 2)
+        best, second, idx = -neg2[:, 0], -neg2[:, 1], idx2[:, 0]
+        ok = (best < max_dist) & (
+            best.astype(jnp.float32) < ratio * second.astype(jnp.float32)
+        )
+        if mutual:
+            rev = jnp.argmin(Di, axis=0)
+            ok = ok & (rev[idx] == jnp.arange(Kq))
+        ok = ok & qvj & (best < jnp.int32(N_BITS + 1))
+        return Matches(idx.astype(jnp.int32), best, second, ok)
+
+    for mutual in (True, False):
+        for ratio, max_dist in ((0.85, 80), (1.0, 257)):
+            got = knn_match(
+                qj, rj, qvj, rvj, ratio=ratio, max_dist=max_dist, mutual=mutual
+            )
+            want = oracle(ratio=ratio, max_dist=max_dist, mutual=mutual)
+            np.testing.assert_array_equal(np.asarray(got.idx), np.asarray(want.idx))
+            np.testing.assert_array_equal(np.asarray(got.dist), np.asarray(want.dist))
+            np.testing.assert_array_equal(
+                np.asarray(got.second), np.asarray(want.second)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(got.valid), np.asarray(want.valid)
+            )

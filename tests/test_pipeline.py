@@ -131,28 +131,58 @@ def test_input_validation():
 
 
 def test_affine_nominal_2k_matches_scale():
-    """Config 2 at its nominal scale (~2k matches/frame): a dense scene
-    with max_keypoints=2048 must yield >1k surviving matches per frame
-    and recover the drift to sub-pixel RMSE (BASELINE.json configs[1])."""
+    """Config 2 at its nominal scale (~2k matches/frame, BASELINE.json
+    configs[1]): a dense sharp scene with K=4096 keypoints, a finer
+    Harris window (the detector's density ceiling — 1.5 caps maxima at
+    ~2.6k on 512^2) and a 4-px candidate tile must yield >=1800
+    SURVIVING matches per frame (measured ~2.5k) and recover the drift
+    to sub-pixel RMSE."""
     data = synthetic.make_drift_stack(
         n_frames=2, shape=(512, 512), model="affine", max_drift=6.0,
-        seed=33, n_blobs=6000,
+        seed=33, n_blobs=12000, sigma_range=(0.7, 1.4),
     )
     mc = MotionCorrector(
-        model="affine", backend="jax", batch_size=2, max_keypoints=2048
+        model="affine", backend="jax", batch_size=2, max_keypoints=4096,
+        nms_size=3, harris_window_sigma=1.2, cand_tile=4,
     )
     res = mc.correct(data.stack)
     n_kp = np.asarray(res.diagnostics["n_keypoints"])
     n_matches = np.asarray(res.diagnostics["n_matches"])
-    assert n_kp.min() > 1800, f"dense scene should near-fill K=2048: {n_kp}"
-    assert n_matches[1:].min() > 1000, f"nominal-scale matching: {n_matches}"
+    n_inliers = np.asarray(res.diagnostics["n_inliers"])
+    assert n_kp.min() > 3800, f"dense scene should near-fill K=4096: {n_kp}"
+    assert n_matches[1:].min() >= 1800, f"nominal-scale matching: {n_matches}"
+    # matches must be real correspondences, not ratio-test leakage
+    assert n_inliers[1:].min() >= 1600, f"consensus inliers: {n_inliers}"
     rel = relative_transforms(data.transforms)
     rmse = transform_rmse(res.transforms, rel, (512, 512))
     assert rmse < 0.5, f"affine@2k RMSE {rmse:.3f}"
 
 
+def test_affine_nominal_2k_cross_backend_parity():
+    """Config 2's high-K regime agrees across backends (the judged
+    metric is CPU-parity RMSE; the detector knobs and MXU matcher must
+    not perturb it). Small frame keeps the NumPy per-frame loop fast."""
+    data = synthetic.make_drift_stack(
+        n_frames=3, shape=(256, 256), model="affine", max_drift=5.0,
+        seed=34, n_blobs=3000, sigma_range=(0.7, 1.4),
+    )
+    kw = dict(
+        model="affine", batch_size=3, max_keypoints=1024, nms_size=3,
+        harris_window_sigma=1.2, cand_tile=4,
+    )
+    rj = MotionCorrector(backend="jax", **kw).correct(data.stack)
+    rn = MotionCorrector(backend="numpy", **kw).correct(data.stack)
+    rel = relative_transforms(data.transforms)
+    rmse_j = transform_rmse(rj.transforms, rel, (256, 256))
+    rmse_n = transform_rmse(rn.transforms, rel, (256, 256))
+    cross = transform_rmse(rj.transforms, rn.transforms, (256, 256))
+    assert rmse_j < 0.3, f"jax high-K RMSE {rmse_j:.3f}"
+    assert rmse_n < 0.3, f"numpy high-K RMSE {rmse_n:.3f}"
+    assert cross < 0.25, f"cross-backend high-K RMSE {cross:.3f}"
+
+
 def test_piecewise_residual_passes_improve_field():
-    """field_passes=2 (default) must not be worse than a single pass on
+    """Multi-pass refinement (default field_passes=3) must not be worse than a single pass on
     a seeded stack — the residual pass exists to cut the membership-
     averaging bias (deterministic: same keys, same data)."""
     data = synthetic.make_piecewise_stack(

@@ -259,3 +259,61 @@ mc.correct_file({str(src)!r}, output={str(tmp_path / 'out.tif')!r},
     )
     assert res.timing["restored_frames"] > 0
     assert res.transforms.shape == (32, 3, 3)
+
+
+def test_streaming_sharded_mesh_resume_byte_identical(tmp_path, monkeypatch):
+    """VERDICT r2 #4: the streaming path under a device mesh. A sharded
+    `correct_file` run (frames data-parallel over an 8-device mesh,
+    reference all-gathered) with a mid-run kill + checkpoint resume must
+    produce the byte-identical output TIFF AND transforms of a
+    single-device uninterrupted run — RANSAC keys fold global frame
+    indices, so results are device-count-independent by design."""
+    from kcmc_tpu.io import ChunkedStackLoader
+    from kcmc_tpu.io.tiff import write_stack
+    from kcmc_tpu.parallel import make_mesh
+    from kcmc_tpu.utils.checkpoint import load_stream_checkpoint
+
+    data = synthetic.make_drift_stack(
+        n_frames=40, shape=(96, 96), model="translation", seed=11
+    )
+    u16 = np.clip(data.stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, u16)
+
+    orig = ChunkedStackLoader._read
+
+    def run(output, mesh=None, checkpoint=None, poison=None):
+        mc = MotionCorrector(
+            model="translation", backend="jax", batch_size=8, mesh=mesh
+        )
+        if poison is not None:
+            monkeypatch.setattr(
+                ChunkedStackLoader, "_read",
+                lambda self, lo, hi: poison(orig, self, lo, hi),
+            )
+        else:
+            monkeypatch.setattr(ChunkedStackLoader, "_read", orig)
+        return mc.correct_file(
+            str(src), output=str(output), chunk_size=8,
+            compression="deflate",
+            checkpoint=checkpoint and str(checkpoint),
+            checkpoint_every=8,
+        )
+
+    ref = run(tmp_path / "ref.tif")  # single-device, uninterrupted
+
+    mesh = make_mesh(8)
+    ckpt = tmp_path / "run.ckpt.npz"
+    out = tmp_path / "out.tif"
+    # allow 3 chunk reads: with batch==chunk==8 and dispatch depth 3,
+    # the first drain (and so the first checkpoint) happens at the 3rd
+    # dispatch; the kill then fires on the 4th read.
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        run(out, mesh=mesh, checkpoint=ckpt, poison=_PoisonAfter(3))
+    meta, _segments = load_stream_checkpoint(str(ckpt))
+    assert 0 < meta["done"] < 40  # partial progress checkpointed
+
+    res = run(out, mesh=mesh, checkpoint=ckpt)  # sharded resume
+    assert res.timing["restored_frames"] == meta["done"]
+    assert (tmp_path / "ref.tif").read_bytes() == out.read_bytes()
+    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
