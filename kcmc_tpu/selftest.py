@@ -36,6 +36,61 @@ def _record(name, ok, detail):
     return {"name": name, "ok": bool(ok), "detail": detail}
 
 
+def _check_match_mxu(K=4096):
+    """MXU ±1-matmul Hamming + min/argmin 2-NN vs the XOR+popcount+top_k
+    formulation, ON DEVICE at config-2 scale. The CPU suite asserts this
+    bit-exactly in f32; this check validates the bf16 MXU lowering on
+    the real chip, where a matmul that silently truncated would flip
+    distance bits."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from kcmc_tpu.ops.match import Matches, hamming_matrix, knn_match
+
+    rng = np.random.default_rng(17)
+    q_h = rng.integers(0, 2**32, (K, 8), dtype=np.uint32)
+    r_h = rng.integers(0, 2**32, (K, 8), dtype=np.uint32)
+    # Plant true correspondences (random descriptors sit ~128 bits
+    # apart and never pass the 80-bit cap): half the queries are a ref
+    # descriptor with a few flipped bits, so the ratio/mutual validity
+    # path is exercised for real, not vacuously all-False.
+    perm = rng.permutation(K)[: K // 2]
+    noise = np.zeros((K // 2, 8), np.uint32)
+    flips = rng.integers(0, 256, size=(K // 2, 6))
+    np.bitwise_or.at(
+        noise, (np.arange(K // 2)[:, None].repeat(6, 1), flips // 32),
+        np.uint32(1) << (flips % 32).astype(np.uint32),
+    )
+    q_h[: K // 2] = r_h[perm] ^ noise
+    q = jnp.asarray(q_h)
+    r = jnp.asarray(r_h)
+    qv = jnp.asarray(rng.uniform(size=K) < 0.95)
+    rv = jnp.asarray(rng.uniform(size=K) < 0.95)
+
+    got = knn_match(q, r, qv, rv, ratio=0.85, max_dist=80, mutual=True)
+
+    @jax.jit
+    def oracle():
+        Di = hamming_matrix(q, r, qv, rv).astype(jnp.int32)
+        neg2, idx2 = lax.top_k(-Di, 2)
+        best, second, idx = -neg2[:, 0], -neg2[:, 1], idx2[:, 0]
+        ok = (best < 80) & (best.astype(jnp.float32) < 0.85 * second.astype(jnp.float32))
+        rev = jnp.argmin(Di, axis=0)
+        ok = ok & (rev[idx] == jnp.arange(K)) & qv & (best < 257)
+        return Matches(idx.astype(jnp.int32), best, second, ok)
+
+    want = oracle()
+    eq = {
+        f: bool(jnp.array_equal(getattr(got, f), getattr(want, f)))
+        for f in ("idx", "dist", "second", "valid")
+    }
+    return _record(
+        "match_mxu_vs_xor_topk", all(eq.values()),
+        f"K={K} field_eq={eq} n_valid={int(jnp.sum(got.valid))}"
+    )
+
+
 def _check_detect2d(size):
     import jax.numpy as jnp
 
@@ -352,6 +407,7 @@ def run_selftest(size: int = 512, size3d=(32, 256, 256)) -> list[dict]:
             "describe2d_pallas_vs_jnp[oriented=True]",
             lambda: _check_describe2d(size, oriented=True),
         ),
+        ("match_mxu_vs_xor_topk", lambda: _check_match_mxu()),
         ("warp_translation_pallas_vs_gather", lambda: _check_warp_translation(size)),
         ("warp_separable_vs_gather", lambda: _check_warp_separable(size)),
         ("warp_homography_vs_gather", lambda: _check_warp_homography(size)),
