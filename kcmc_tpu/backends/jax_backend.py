@@ -445,8 +445,18 @@ class JaxBackend:
         """
         cfg = self.config
         on_tpu = self._on_accelerator()
+        from kcmc_tpu.ops.pallas_warp import supports as pallas_warp_fits
+
+        # The whole-frame Pallas translation kernel VMEM-OOMs at compile
+        # time beyond ~512^2 (see pallas_warp.supports); "auto" falls
+        # through to the separable pass chain (still gather-free) for
+        # larger frames. An explicit warp="pallas" request is honored
+        # as asked — the compile error is then the honest answer.
         use_pallas = cfg.warp == "pallas" or (
-            cfg.warp == "auto" and cfg.model == "translation" and on_tpu
+            cfg.warp == "auto"
+            and cfg.model == "translation"
+            and on_tpu
+            and pallas_warp_fits(shape)
         )
         if use_pallas:
             from kcmc_tpu.ops.pallas_warp import warp_batch_translation
@@ -457,7 +467,7 @@ class JaxBackend:
             )
         use_separable = cfg.warp == "separable" or (
             cfg.warp == "auto"
-            and cfg.model in ("rigid", "similarity", "affine")
+            and cfg.model in ("translation", "rigid", "similarity", "affine")
             and on_tpu
         )
         if use_separable:

@@ -245,6 +245,17 @@ def describe_keypoints_batch(
     fused detection kernel's free-ride output) so the blur isn't
     recomputed here.
     """
+    r = ROT_RADIUS if oriented else PATCH_RADIUS
+    P = 2 * r + 2
+    if use_pallas:
+        # Frames past the resident-frame kernel's VMEM budget (≈2048^2)
+        # take the XLA gather path: measured 17x faster there than the
+        # Element-indexed slab variant (DESIGN.md "Large-frame patch
+        # extraction"), and the whole-frame kernel would die at compile
+        # time with a scoped-vmem OOM.
+        from kcmc_tpu.ops.pallas_patch import supports as _patch_fits
+
+        use_pallas = _patch_fits(frames.shape[1:], P)
     if not use_pallas:
         def one(f, k, s=None):
             return describe_keypoints(
@@ -256,9 +267,6 @@ def describe_keypoints_batch(
         return jax.vmap(one)(frames, kps, smooth)
 
     from kcmc_tpu.ops.pallas_patch import extract_blended
-
-    r = ROT_RADIUS if oriented else PATCH_RADIUS
-    P = 2 * r + 2
     if smooth is None:
         smooth = jax.vmap(lambda f: gaussian_blur(f, blur_sigma))(frames)
     padded = jnp.pad(smooth, ((0, 0), (r + 1, r + 1), (r + 1, r + 1)), mode="edge")

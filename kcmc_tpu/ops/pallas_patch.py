@@ -26,7 +26,8 @@ orientation moments into the cut, emitting keypoint-FIRST patches so
 nothing downstream needs the (P, P, K) relayout. `extract_patches` is
 the raw-patch primitive (standalone utility; not on the product path
 since the blend moved in-kernel, kept for raw-patch consumers and as
-the direct oracle check of the slab/roll addressing).
+the direct oracle check of the slab/roll addressing; resident-frame
+layout only — gate on `supports()` for large frames).
 """
 
 from __future__ import annotations
@@ -58,10 +59,54 @@ def _smem_batch_limit(n_scalar_arrays: int, K: int, KB: int) -> int:
     return max(1, _SMEM_SCALAR_BUDGET // (n_scalar_arrays * Kp * 4))
 
 
+# The 2D kernels keep one whole padded frame resident in VMEM per grid
+# program (keypoints are scattered, so the frame block is the natural
+# unit), and Pallas double-buffers input blocks — so the scoped
+# footprint is ~2x the padded frame. Measured: 38.8 MB scoped-vmem OOM
+# at 2048^2, where the padded frame is 20.3 MB (ratio 1.9x). The gate
+# below uses the 2x-buffered estimate against a 14 MB budget (16 MB
+# physical minus slack): 512^2 -> 4 MB, 1024^2 -> 12 MB (both measured
+# working), 1440^2 -> 21 MB (correctly rejected), 2048^2 -> 41 MB.
+_VMEM_FRAME_BUDGET = 14 * 1024 * 1024
+
+
+def _slab_rows(P: int) -> int:
+    """Aligned slab rows covering P + the 8-alignment residual — the
+    single source of truth shared by the kernels, the wrappers' padding,
+    the VMEM gate, and the HBM chunk estimate."""
+    return ((P + 7) // 8) * 8 + 8
+
+
+def _slab_dims(P: int, Wp: int) -> tuple[int, int]:
+    """(S, Wpp): `_slab_rows` plus the lane-padded width every 2D
+    wrapper pads to."""
+    return _slab_rows(P), -(-(Wp + _WIN) // 128) * 128
+
+
+def supports(shape: tuple[int, int], P: int) -> bool:
+    """Whether the whole-frame (resident-frame) 2D extraction layout
+    fits VMEM for a (H, W) frame and patch size P (callers pad by
+    (P - 2) // 2 + 1). When False, `extract_blended_planes` switches to
+    the per-keypoint Element-indexed slab layout automatically (the
+    BLENDED entry points work at any frame size; the raw
+    `extract_patches` primitive is resident-frame only and callers must
+    gate on this)."""
+    H, W = shape
+    r1 = (P - 2) // 2 + 1
+    Hp, Wp = H + 2 * r1, W + 2 * r1
+    return _frame_fits(Hp, Wp, P)
+
+
+def _frame_fits(Hp: int, Wp: int, P: int) -> bool:
+    S, Wpp = _slab_dims(P, Wp)
+    Hpp = Hp + S - P
+    return 2 * Hpp * Wpp * 4 <= _VMEM_FRAME_BUDGET
+
+
 def _patch_kernel(oy_ref, ox_ref, src_ref, out_ref, *, P: int, KB: int):
     b = pl.program_id(0)
     kb = pl.program_id(1)
-    S = ((P + 7) // 8) * 8 + 8  # aligned slab rows covering P + residual
+    S = _slab_rows(P)
     for i in range(KB):
         k = kb * KB + i
         y0 = oy_ref[b, k]
@@ -114,7 +159,7 @@ def _blended_kernel(
     """
     b = pl.program_id(0)
     kb = pl.program_id(1)
-    S = ((P + 7) // 8) * 8 + 8
+    S = _slab_rows(P)
     # Scalar stores to VMEM are unsupported: accumulate the per-keypoint
     # moment scalars into (KB, 1) vectors (iota row-select) and store once.
     row = jax.lax.broadcasted_iota(jnp.int32, (KB, 1), 0)
@@ -214,6 +259,17 @@ def extract_blended_planes(
     """
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
+    if not _frame_fits(Hp, Wp, P):
+        # Large frames (≈2048^2+): the resident-frame layout VMEM-OOMs
+        # at compile time; per-keypoint Element-indexed slabs instead.
+        # NOTE: the slab layout is exact but measured much slower than
+        # the XLA gather describe path at 2048^2 (DESIGN.md) — the
+        # production describe route gates on `supports()` and prefers
+        # the gather there; this fallback keeps the kernel API total.
+        return _extract_blended_planes_slab(
+            padded, oy, ox, fx, fy, P,
+            with_moments=with_moments, interpret=interpret,
+        )
     KB = _KB
     bc = _smem_batch_limit(2, K, KB)
     if B > bc:  # chunk the batch to keep scalar prefetch within SMEM
@@ -239,8 +295,7 @@ def extract_blended_planes(
         fx = jnp.concatenate([fx, zf], axis=1)
         fy = jnp.concatenate([fy, zf], axis=1)
     Kp = oy.shape[1]
-    S = ((P + 7) // 8) * 8 + 8
-    Wpp = -(-(Wp + _WIN) // 128) * 128
+    S, Wpp = _slab_dims(P, Wp)
     padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
     Hpp = Hp + S - P
 
@@ -279,6 +334,169 @@ def extract_blended_planes(
     )(
         oy.astype(jnp.int32), ox.astype(jnp.int32),
         fx, fy, mm_in, padded.astype(jnp.float32),
+    )
+    if with_moments:
+        return pb[:, :K], m10[:, :K], m01[:, :K]
+    return pb[:, :K]
+
+
+def _blended_slab_kernel(*refs, P: int, KB: int, with_moments: bool):
+    """2D slab variant of `_blended_kernel` for frames too large to sit
+    whole in VMEM: each keypoint's (S, _WIN) slab arrives as its own
+    Element-indexed input block (sublane start 8-aligned, lane start
+    128-aligned — exactly the alignment the whole-frame kernel's
+    aligned-floor reads use), so VMEM holds KB tiny slabs, never the
+    frame. Same roll/cut/blend/moment math as the resident-frame
+    kernel."""
+    # prefetch: oy8, ox128 (index maps), ry, rx (kernel); then KB slabs,
+    # fx, fy, mm, outputs.
+    oy8r, ox128r, ryr, rxr = refs[:4]
+    slabs = refs[4 : 4 + KB]
+    fx_ref, fy_ref, mm_ref = refs[4 + KB : 7 + KB]
+    pb_ref, m10_ref, m01_ref = refs[7 + KB :]
+    b = pl.program_id(0)
+    kb = pl.program_id(1)
+    S = _slab_rows(P)
+    row = jax.lax.broadcasted_iota(jnp.int32, (KB, 1), 0)
+    acc_x = jnp.zeros((KB, 1), jnp.float32)
+    acc_y = jnp.zeros((KB, 1), jnp.float32)
+    for i in range(KB):
+        k = kb * KB + i
+        slab = slabs[i][0]  # (S, _WIN)
+        slab = pltpu.roll(slab, S - ryr[b, k], 0)
+        slab = pltpu.roll(slab, _WIN - rxr[b, k], 1)
+        patch = slab[:P, :P]
+        fx = fx_ref[i, 0]
+        fy = fy_ref[i, 0]
+        w00 = (1.0 - fy) * (1.0 - fx)
+        w01 = (1.0 - fy) * fx
+        w10 = fy * (1.0 - fx)
+        w11 = fy * fx
+        pb_ref[i] = (
+            w00 * patch[: P - 1, : P - 1]
+            + w01 * patch[: P - 1, 1:]
+            + w10 * patch[1:, : P - 1]
+            + w11 * patch[1:, 1:]
+        )
+        if with_moments:
+            rx = fx >= 0.5
+            ry = fy >= 0.5
+            wx = jnp.where(
+                ry,
+                jnp.where(rx, mm_ref[3], mm_ref[2]),
+                jnp.where(rx, mm_ref[1], mm_ref[0]),
+            )
+            wy = jnp.where(
+                ry,
+                jnp.where(rx, mm_ref[7], mm_ref[6]),
+                jnp.where(rx, mm_ref[5], mm_ref[4]),
+            )
+            acc_x = jnp.where(row == i, jnp.sum(patch * wx), acc_x)
+            acc_y = jnp.where(row == i, jnp.sum(patch * wy), acc_y)
+    m10_ref[:, :] = acc_x
+    m01_ref[:, :] = acc_y
+
+
+def _extract_blended_planes_slab(
+    padded, oy, ox, fx, fy, P: int, with_moments: bool, interpret: bool
+):
+    """Slab-blocked implementation behind extract_blended_planes for
+    frames past the whole-frame VMEM budget. Identical outputs."""
+    B, Hp, Wp = padded.shape
+    K = oy.shape[1]
+    KB = 8  # slabs per program: KB * S * _WIN * 4 B ≈ 0.4-0.8 MB
+    # The KB slab inputs are the same padded array passed KB times (one
+    # Element-indexed BlockSpec each); the runtime materializes them as
+    # separate buffers, so chunk the batch to keep KB copies of the
+    # padded chunk within ~1.5 GB of HBM alongside the SMEM limit.
+    S0, Wpp0 = _slab_dims(P, Wp)
+    frame_bytes = (Hp + S0 - P) * Wpp0 * 4
+    bc = min(
+        _smem_batch_limit(4, K, KB),
+        max(1, (3 << 29) // (KB * frame_bytes)),
+    )
+    if B > bc:
+        outs = [
+            _extract_blended_planes_slab(
+                padded[i : i + bc], oy[i : i + bc], ox[i : i + bc],
+                fx[i : i + bc], fy[i : i + bc], P,
+                with_moments=with_moments, interpret=interpret,
+            )
+            for i in range(0, B, bc)
+        ]
+        if with_moments:
+            return tuple(
+                jnp.concatenate([o[j] for o in outs]) for j in range(3)
+            )
+        return jnp.concatenate(outs)
+    if K % KB:
+        pad = KB - K % KB
+        z = jnp.zeros((B, pad), oy.dtype)
+        zf = jnp.zeros((B, pad, 1), jnp.float32)
+        oy = jnp.concatenate([oy, z], axis=1)
+        ox = jnp.concatenate([ox, z], axis=1)
+        fx = jnp.concatenate([fx, zf], axis=1)
+        fy = jnp.concatenate([fy, zf], axis=1)
+    Kp = oy.shape[1]
+    S, Wpp = _slab_dims(P, Wp)
+    padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
+    Hpp = Hp + S - P
+
+    oy = oy.astype(jnp.int32)
+    ox = ox.astype(jnp.int32)
+    oy8 = oy // 8
+    ry = oy - oy8 * 8
+    ox128 = ox // 128
+    rx = ox - ox128 * 128
+
+    Pb = P - 1
+    mm = _moment_maps(P)
+    mm_in = jnp.asarray(
+        np.concatenate([mm[:, :, 0].reshape(4, P, P), mm[:, :, 1].reshape(4, P, P)])
+    )
+
+    def slab_spec(j):
+        return pl.BlockSpec(
+            (pl.Element(1), pl.Element(S), pl.Element(_WIN)),
+            lambda b, kb, oy8r, ox128r, ryr, rxr, j=j: (
+                b, oy8r[b, kb * KB + j] * 8, ox128r[b, kb * KB + j] * 128
+            ),
+        )
+
+    frac_spec = pl.BlockSpec(
+        (None, KB, 1), lambda b, kb, oy8r, ox128r, ryr, rxr: (b, kb, 0)
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, Kp // KB),
+        in_specs=[slab_spec(j) for j in range(KB)]
+        + [
+            frac_spec,
+            frac_spec,
+            pl.BlockSpec((8, P, P), lambda b, kb, oy8r, ox128r, ryr, rxr: (0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, KB, Pb, Pb), lambda b, kb, oy8r, ox128r, ryr, rxr: (b, kb, 0, 0)),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, oy8r, ox128r, ryr, rxr: (b, kb, 0)),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, oy8r, ox128r, ryr, rxr: (b, kb, 0)),
+        ],
+    )
+    kernel = functools.partial(
+        _blended_slab_kernel, P=P, KB=KB, with_moments=with_moments
+    )
+    pb, m10, m01 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        oy8, ox128, ry, rx,
+        *([padded.astype(jnp.float32)] * KB),
+        fx, fy, mm_in,
     )
     if with_moments:
         return pb[:, :K], m10[:, :K], m01[:, :K]
@@ -459,8 +677,7 @@ def extract_patches(
     # The kernel reads an 8-aligned row slab at or before each origin and
     # a 128-aligned lane window at or before it; give the frame the
     # bottom/right margins those aligned reads can overrun.
-    S = ((P + 7) // 8) * 8 + 8
-    Wpp = -(-(Wp + _WIN) // 128) * 128
+    S, Wpp = _slab_dims(P, Wp)
     padded = jnp.pad(
         padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge"
     )

