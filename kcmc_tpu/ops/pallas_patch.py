@@ -83,6 +83,34 @@ def _slab_dims(P: int, Wp: int) -> tuple[int, int]:
     return _slab_rows(P), -(-(Wp + _WIN) // 128) * 128
 
 
+def _chunk_batch(fn, bc: int, B: int, arrays, with_moments: bool):
+    """Shared batch-chunking scaffold for the blended extract variants:
+    recurse `fn` over `bc`-frame slices of `arrays`, concatenating the
+    (pb,) or (pb, m10, m01) outputs."""
+    outs = [fn(*(a[i : i + bc] for a in arrays)) for i in range(0, B, bc)]
+    if with_moments:
+        return tuple(jnp.concatenate([o[j] for o in outs]) for j in range(3))
+    return jnp.concatenate(outs)
+
+
+def _pad_keypoint_axis(KB: int, oy, ox, fx, fy):
+    """Zero-pad the keypoint axis up to a KB multiple (the wrappers
+    slice the tail back off)."""
+    K = oy.shape[1]
+    if K % KB == 0:
+        return oy, ox, fx, fy
+    pad = KB - K % KB
+    B = oy.shape[0]
+    z = jnp.zeros((B, pad), oy.dtype)
+    zf = jnp.zeros((B, pad, 1), jnp.float32)
+    return (
+        jnp.concatenate([oy, z], axis=1),
+        jnp.concatenate([ox, z], axis=1),
+        jnp.concatenate([fx, zf], axis=1),
+        jnp.concatenate([fy, zf], axis=1),
+    )
+
+
 def supports(shape: tuple[int, int], P: int) -> bool:
     """Whether the whole-frame (resident-frame) 2D extraction layout
     fits VMEM for a (H, W) frame and patch size P (callers pad by
@@ -273,27 +301,13 @@ def extract_blended_planes(
     KB = _KB
     bc = _smem_batch_limit(2, K, KB)
     if B > bc:  # chunk the batch to keep scalar prefetch within SMEM
-        outs = [
-            extract_blended_planes(
-                padded[i : i + bc], oy[i : i + bc], ox[i : i + bc],
-                fx[i : i + bc], fy[i : i + bc], P,
-                with_moments=with_moments, interpret=interpret,
-            )
-            for i in range(0, B, bc)
-        ]
-        if with_moments:
-            return tuple(
-                jnp.concatenate([o[j] for o in outs]) for j in range(3)
-            )
-        return jnp.concatenate(outs)
-    if K % KB:
-        pad = KB - K % KB
-        z = jnp.zeros((B, pad), oy.dtype)
-        zf = jnp.zeros((B, pad, 1), jnp.float32)
-        oy = jnp.concatenate([oy, z], axis=1)
-        ox = jnp.concatenate([ox, z], axis=1)
-        fx = jnp.concatenate([fx, zf], axis=1)
-        fy = jnp.concatenate([fy, zf], axis=1)
+        return _chunk_batch(
+            lambda *a: extract_blended_planes(
+                *a, P, with_moments=with_moments, interpret=interpret
+            ),
+            bc, B, (padded, oy, ox, fx, fy), with_moments,
+        )
+    oy, ox, fx, fy = _pad_keypoint_axis(KB, oy, ox, fx, fy)
     Kp = oy.shape[1]
     S, Wpp = _slab_dims(P, Wp)
     padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
@@ -416,27 +430,13 @@ def _extract_blended_planes_slab(
         max(1, (3 << 29) // (KB * frame_bytes)),
     )
     if B > bc:
-        outs = [
-            _extract_blended_planes_slab(
-                padded[i : i + bc], oy[i : i + bc], ox[i : i + bc],
-                fx[i : i + bc], fy[i : i + bc], P,
-                with_moments=with_moments, interpret=interpret,
-            )
-            for i in range(0, B, bc)
-        ]
-        if with_moments:
-            return tuple(
-                jnp.concatenate([o[j] for o in outs]) for j in range(3)
-            )
-        return jnp.concatenate(outs)
-    if K % KB:
-        pad = KB - K % KB
-        z = jnp.zeros((B, pad), oy.dtype)
-        zf = jnp.zeros((B, pad, 1), jnp.float32)
-        oy = jnp.concatenate([oy, z], axis=1)
-        ox = jnp.concatenate([ox, z], axis=1)
-        fx = jnp.concatenate([fx, zf], axis=1)
-        fy = jnp.concatenate([fy, zf], axis=1)
+        return _chunk_batch(
+            lambda *a: _extract_blended_planes_slab(
+                *a, P, with_moments=with_moments, interpret=interpret
+            ),
+            bc, B, (padded, oy, ox, fx, fy), with_moments,
+        )
+    oy, ox, fx, fy = _pad_keypoint_axis(KB, oy, ox, fx, fy)
     Kp = oy.shape[1]
     S, Wpp = _slab_dims(P, Wp)
     padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
