@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from kcmc_tpu.ops.detect import Keypoints
+from kcmc_tpu.ops.detect import Keypoints, sorted_top_k
 from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 
 
@@ -146,7 +146,7 @@ def _select_keypoints_3d(
 
     n_tiles = tile_val.size
     k = min(max_keypoints, n_tiles)
-    scores, cand = lax.top_k(tile_val.reshape(-1), k)
+    scores, cand = sorted_top_k(tile_val.reshape(-1), k)
     if k < max_keypoints:
         pad = max_keypoints - k
         scores = jnp.concatenate([scores, jnp.full((pad,), -jnp.inf)])
