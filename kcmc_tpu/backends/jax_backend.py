@@ -70,6 +70,16 @@ def _cast_corrected(corrected: jnp.ndarray, dtype_name: str) -> jnp.ndarray:
     return jnp.clip(jnp.rint(corrected), lo, hi).astype(dt)
 
 
+def _sanitize_nonfinite(frames: jnp.ndarray) -> jnp.ndarray:
+    """Replace non-finite pixels with each frame's finite mean (the
+    `sanitize_input` config knob; see config.py for the rationale)."""
+    finite = jnp.isfinite(frames)
+    axes = tuple(range(1, frames.ndim))
+    n = jnp.maximum(jnp.sum(finite, axis=axes, keepdims=True), 1)
+    mean = jnp.sum(jnp.where(finite, frames, 0.0), axis=axes, keepdims=True) / n
+    return jnp.where(finite, frames, mean)
+
+
 @functools.partial(jax.jit, static_argnames=("shape",))
 def _coverage_matrix(transforms: jnp.ndarray, shape) -> jnp.ndarray:
     from kcmc_tpu.ops.warp import coverage_mask
@@ -114,6 +124,8 @@ class JaxBackend:
     def prepare_reference(self, ref_frame: np.ndarray) -> dict:
         cfg = self.config
         frame = jnp.asarray(ref_frame, jnp.float32)
+        if cfg.sanitize_input:
+            frame = _sanitize_nonfinite(frame[None])[0]
         if frame.ndim == 2:
             kps = detect_keypoints(
                 frame,
@@ -249,6 +261,8 @@ class JaxBackend:
             # Frames upload in their native dtype (uint16 stacks halve
             # the host->device bytes); all math runs in float32.
             frames = frames.astype(jnp.float32)
+            if cfg.sanitize_input:
+                frames = _sanitize_nonfinite(frames)
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
             # smooth (the descriptor-stage blur) rides along with the
             # fused Pallas detection kernel's resident slab.
@@ -361,6 +375,8 @@ class JaxBackend:
 
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
             frames = frames.astype(jnp.float32)  # native-dtype upload
+            if cfg.sanitize_input:
+                frames = _sanitize_nonfinite(frames)
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
             # smooth (the descriptor-stage blur) rides along with the
             # fused detection kernel's resident slab, as in 2D.
@@ -401,6 +417,11 @@ class JaxBackend:
         """
         cfg = self.config
         frames = jnp.asarray(frames, jnp.float32)
+        if cfg.sanitize_input:
+            # The batch program sanitized its own input; the rescue
+            # path re-warps the RAW host frames, so the fully-finite
+            # output guarantee must be re-applied here too.
+            frames = _sanitize_nonfinite(frames)
         if cfg.model == "piecewise":
             from kcmc_tpu.ops.piecewise import upsample_field
 

@@ -84,6 +84,16 @@ def coverage_masks_np(shape, out: dict) -> np.ndarray:
     return np.stack([fn(shape, M) for M in Ms])
 
 
+def _sanitize_nonfinite_np(frame: np.ndarray) -> np.ndarray:
+    """Replace non-finite pixels with the frame's finite mean (mirror
+    of the jax backend's `sanitize_input` path, for parity)."""
+    finite = np.isfinite(frame)
+    if finite.all():
+        return frame
+    mean = frame[finite].mean() if finite.any() else 0.0
+    return np.where(finite, frame, np.float32(mean))
+
+
 @register_backend("numpy")
 class NumpyBackend:
     name = "numpy"
@@ -96,8 +106,11 @@ class NumpyBackend:
 
     def prepare_reference(self, ref_frame: np.ndarray) -> dict:
         cfg = self.config
+        ref_frame = np.asarray(ref_frame, np.float32)
+        if cfg.sanitize_input:
+            ref_frame = _sanitize_nonfinite_np(ref_frame)
         if ref_frame.ndim == 3:
-            frame = np.asarray(ref_frame, np.float32)
+            frame = ref_frame
             xyz, score, valid = K.detect_keypoints_3d(
                 frame,
                 max_keypoints=cfg.max_keypoints,
@@ -109,7 +122,7 @@ class NumpyBackend:
             )
             return {"xy": xyz, "desc": desc, "valid": valid, "frame": frame}
         xy, score, valid = K.detect_keypoints(
-            np.asarray(ref_frame, np.float32),
+            ref_frame,
             max_keypoints=cfg.max_keypoints,
             threshold=cfg.detect_threshold,
             nms_size=cfg.nms_size,
@@ -119,16 +132,13 @@ class NumpyBackend:
             cand_tile=cfg.cand_tile,
         )
         desc = K.describe_keypoints(
-            np.asarray(ref_frame, np.float32),
+            ref_frame,
             xy,
             valid,
             oriented=cfg.resolved_oriented(),
             blur_sigma=cfg.blur_sigma,
         )
-        return {
-            "xy": xy, "desc": desc, "valid": valid,
-            "frame": np.asarray(ref_frame, np.float32),
-        }
+        return {"xy": xy, "desc": desc, "valid": valid, "frame": ref_frame}
 
     def process_batch(
         self, frames: np.ndarray, ref: dict, frame_indices: np.ndarray
@@ -157,6 +167,8 @@ class NumpyBackend:
 
     def _process_one(self, frame, gidx, ref, out):
         cfg = self.config
+        if cfg.sanitize_input:
+            frame = _sanitize_nonfinite_np(frame)
         if frame.ndim == 3:
             self._process_one_3d(frame, gidx, ref, out)
             return
