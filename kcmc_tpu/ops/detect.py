@@ -111,7 +111,16 @@ def sorted_top_k(vals: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     lowering is 13x slower at detection shapes on the v5e (measured
     1.08 vs 0.08 ms/frame at n=4096/k=512, worse at k=4096). A stable
     descending sort returns the identical values AND tie order (lowest
-    index first). Shared by the 2D and 3D keypoint selectors."""
+    index first). Shared by the 2D and 3D keypoint selectors.
+
+    Negative result (round 3, DESIGN.md "Large-frame support"): a
+    grouped two-stage split (batched 4096-wide group sorts, then a
+    merge sort of the g*k prefix survivors — bit-identical by a
+    prefix-exclusion argument) measured EQUAL to this single sort at
+    n=16k-65k under interleaved sustained timing (~0.3-0.4 ms/frame
+    both ways at batch 8); an apparent 6x win was a cold-measurement
+    artifact. The single sort stays: same speed, less machinery.
+    """
     neg, idx = lax.sort_key_val(
         -vals, jnp.arange(vals.shape[0], dtype=jnp.int32)
     )
@@ -295,13 +304,28 @@ def detect_keypoints_batch(
     if smooth_sigma is not None and smooth_sigma <= 0.0:
         raise ValueError(f"smooth_sigma must be positive, got {smooth_sigma}")
     if use_pallas:
-        from kcmc_tpu.ops.pallas_detect import response_fields, supports
+        from kcmc_tpu.ops.pallas_detect import (
+            response_fields,
+            response_fields_paneled,
+            supports,
+            supports_paneled,
+        )
 
         # border >= 1: the kernel's subpixel fields differ from the jnp
         # path on the 1-px frame boundary (zero- vs edge-extension);
         # border=0 keypoints could land there, so take the jnp route.
-        if border >= 1 and supports((H, W), nms_size, window_sigma, smooth_sigma):
-            out = response_fields(
+        # Frames wider than the kernel's lane budget run the paneled
+        # wrapper instead (border must then also exclude the panel
+        # wrapper's frame-edge band — supports_paneled checks it).
+        whole = border >= 1 and supports(
+            (H, W), nms_size, window_sigma, smooth_sigma
+        )
+        paneled = not whole and supports_paneled(
+            nms_size, window_sigma, smooth_sigma, border
+        )
+        if whole or paneled:
+            fields = response_fields if whole else response_fields_paneled
+            out = fields(
                 frames, harris_k=harris_k, nms_size=nms_size,
                 window_sigma=window_sigma,
                 smooth_sigma=smooth_sigma, interpret=interpret,

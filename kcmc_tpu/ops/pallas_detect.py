@@ -69,31 +69,65 @@ _SM = (0.25, 0.5, 0.25)
 _DF = (0.5, 0.0, -0.5)
 
 
+def _reach(
+    nms_size: int, window_sigma: float, smooth_sigma: float | None
+) -> int:
+    """Influence radius of the fused pass: conv + NMS + subpixel (and
+    the optional descriptor-blur free ride)."""
+    blur_r = max(1, int(3.0 * window_sigma + 0.5))
+    reach = 2 + blur_r + nms_size // 2 + 1
+    if smooth_sigma is not None:
+        reach = max(reach, max(1, int(3.0 * smooth_sigma + 0.5)))
+    return reach
+
+
 def supports(
     shape: tuple[int, int],
     nms_size: int = 5,
     window_sigma: float = WINDOW_SIGMA,
     smooth_sigma: float | None = None,
 ) -> bool:
-    """Whether the strip kernel can run this configuration.
+    """Whether the strip kernel can run this configuration whole-width.
 
     Two gates, both of which the caller must respect by falling back to
-    the jnp path: (a) VMEM — the per-lane budget of six (96, Wp)
-    scratch slabs plus double-buffered in/out strips is ~6 KB, so Wp
-    beyond ~2048 lanes overflows ~16 MB of physical VMEM at compile
-    time; (b) halo — the conv + NMS + subpixel (and optional smooth)
-    reach must fit the slab's `_HALO` margin.
+    the paneled wrapper (`supports_paneled`) or the jnp path: (a) VMEM —
+    the per-lane budget of six (96, Wp) scratch slabs plus
+    double-buffered in/out strips is ~6 KB, so Wp beyond ~2048 lanes
+    overflows ~16 MB of physical VMEM at compile time; (b) halo — the
+    conv + NMS + subpixel (and optional smooth) reach must fit the
+    slab's `_HALO` margin.
     """
     Wp = -(-max(shape[1] + _HALO, 128) // 128) * 128
     if Wp > 2048:
         return False
-    blur_r = max(1, int(3.0 * window_sigma + 0.5))
-    reach = 2 + blur_r + nms_size // 2 + 1
-    if smooth_sigma is not None:
-        if smooth_sigma <= 0.0:
-            return False
-        reach = max(reach, max(1, int(3.0 * smooth_sigma + 0.5)))
-    return reach <= _HALO
+    if smooth_sigma is not None and smooth_sigma <= 0.0:
+        return False
+    return _reach(nms_size, window_sigma, smooth_sigma) <= _HALO
+
+
+def supports_paneled(
+    nms_size: int = 5,
+    window_sigma: float = WINDOW_SIGMA,
+    smooth_sigma: float | None = None,
+    border: int = 16,
+) -> bool:
+    """Whether `response_fields_paneled` covers this configuration.
+
+    Size-unbounded by design (no shape argument): the wrapper handles
+    any width by adding panels and any height via the strip grid; only
+    the filter reach and the border gate it. The paneled wrapper feeds
+    the true frame's left/right edges to the kernel as in-panel ZERO
+    CONTENT rather than as the frame boundary, so within `_reach`
+    columns of those edges nms_resp/ox/oy differ from the whole-frame
+    semantics (zeros-as-content vs -inf NMS padding + real-region
+    re-masking; the convolutions themselves are identical — zero
+    content and zero SAME padding are the same thing). Selection must
+    therefore exclude that band: border >= reach.
+    """
+    if smooth_sigma is not None and smooth_sigma <= 0.0:
+        return False
+    reach = _reach(nms_size, window_sigma, smooth_sigma)
+    return reach <= _HALO and border >= reach
 
 
 def _roll(a, dy: int, dx: int):
@@ -303,3 +337,66 @@ def response_fields(
         interpret=interpret,
     )(padded, padded, padded)
     return tuple(o[:, :H] for o in outs)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "harris_k", "nms_size", "window_sigma", "smooth_sigma",
+        "max_panel_w", "interpret",
+    ),
+)
+def response_fields_paneled(
+    frames: jnp.ndarray,
+    harris_k: float = 0.04,
+    nms_size: int = 5,
+    window_sigma: float = WINDOW_SIGMA,
+    smooth_sigma: float | None = None,
+    max_panel_w: int = 2032,
+    interpret: bool = False,
+):
+    """`response_fields` for frames wider than the strip kernel's
+    ~2048-lane VMEM gate: overlapping COLUMN PANELS stacked into the
+    batch axis, one kernel launch, stitch, discard the `_HALO` overlap.
+
+    Semantics: within each panel's kept core the computed values are
+    identical to the whole-frame kernel's — every value depends only on
+    content within `_reach` (<= `_HALO`) columns, all present in the
+    panel. The one divergence is the true frame's left/right edge band
+    (zeros-as-content vs boundary semantics, see `supports_paneled`),
+    which callers exclude via `border >= reach`. The descriptor-blur
+    free-ride output is exactly identical everywhere (pure convolution:
+    zero content == zero SAME padding). Overlap overhead is
+    2 * _HALO / core per panel (32/1024 = 3.1% at 2048 wide, where two
+    1024-core panels are used).
+
+    `max_panel_w` is the widest panel the strip kernel accepts (tests
+    shrink it to force multi-panel runs at small sizes).
+    """
+    B, H, W = frames.shape
+    M = _HALO
+    # Largest lane-aligned kept core a panel can carry (aligned panel
+    # slicing; the 2*M is the discarded overlap margin).
+    core_cap = ((max_panel_w - 2 * M) // 128) * 128
+    if core_cap <= 0:
+        raise ValueError(f"max_panel_w={max_panel_w} leaves no panel core")
+    n_panels = -(-W // core_cap)
+    core = min(core_cap, -(-(-(-W // n_panels)) // 128) * 128)
+    n_panels = -(-W // core)
+    Pw = core + 2 * M
+    padded = jnp.pad(frames, ((0, 0), (0, 0), (M, n_panels * core + M - W)))
+    panels = jnp.stack(
+        [padded[:, :, p * core : p * core + Pw] for p in range(n_panels)],
+        axis=1,
+    ).reshape(B * n_panels, H, Pw)
+    outs = response_fields(
+        panels, harris_k=harris_k, nms_size=nms_size,
+        window_sigma=window_sigma, smooth_sigma=smooth_sigma,
+        interpret=interpret,
+    )
+
+    def stitch(o):
+        o = o.reshape(B, n_panels, H, Pw)[:, :, :, M : M + core]
+        return o.transpose(0, 2, 1, 3).reshape(B, H, n_panels * core)[:, :, :W]
+
+    return tuple(stitch(o) for o in outs)

@@ -91,12 +91,12 @@ def _check_match_mxu(K=4096):
     )
 
 
-def _check_detect2d(size):
+def _check_detect2d(size, shape=None, label="detect2d_pallas_vs_jnp", n=2):
     import jax.numpy as jnp
 
     from kcmc_tpu.ops.detect import detect_keypoints_batch
 
-    frames = jnp.asarray(_scene((size, size)))
+    frames = jnp.asarray(_scene(shape or (size, size), n=n))
     kw = dict(
         max_keypoints=512, threshold=1e-4, nms_size=5, border=16,
         harris_k=0.04, smooth_sigma=2.0,
@@ -109,9 +109,23 @@ def _check_detect2d(size):
     dsmooth = float(np.abs(np.asarray(sj) - np.asarray(sp)).max())
     ok = valid_eq and dxy < 1e-3 and dsmooth < 1e-4
     return _record(
-        "detect2d_pallas_vs_jnp",
+        label,
         ok,
         f"valid_eq={valid_eq} max|dxy|={dxy:.2e} max|dsmooth|={dsmooth:.2e}",
+    )
+
+
+def _check_detect2d_paneled():
+    """The column-paneled wide-frame route, ON CHIP at 2048^2 — the
+    whole-frame kernel's supports() is False here, so this exercises the
+    panel stacking/stitch path end to end through detect (Mosaic compile
+    at the production wide size plus keypoint parity vs the jnp path)."""
+    from kcmc_tpu.ops.pallas_detect import supports, supports_paneled
+
+    assert not supports((2048, 2048), smooth_sigma=2.0)
+    assert supports_paneled(smooth_sigma=2.0, border=16)
+    return _check_detect2d(
+        0, shape=(2048, 2048), label="detect2d_paneled_vs_jnp", n=1
     )
 
 
@@ -399,6 +413,7 @@ def run_selftest(size: int = 512, size3d=(32, 256, 256)) -> list[dict]:
     # check keeps a stable identity in the JSON summary across rounds
     checks = [
         ("detect2d_pallas_vs_jnp", lambda: _check_detect2d(size)),
+        ("detect2d_paneled_vs_jnp", _check_detect2d_paneled),
         (
             "describe2d_pallas_vs_jnp[oriented=False]",
             lambda: _check_describe2d(size, oriented=False),

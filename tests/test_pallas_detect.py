@@ -123,6 +123,52 @@ def test_wide_frames_rejected_by_supports():
     assert not supports((512, 512), smooth_sigma=0.0)  # degenerate blur
 
 
+def test_paneled_fields_match_whole_frame_kernel():
+    """Column-paneled wide-frame wrapper == whole-frame kernel, exactly,
+    away from the true frame edge band (zeros-as-content there); the
+    smooth free-ride is exactly identical everywhere."""
+    from kcmc_tpu.ops.pallas_detect import (
+        _reach,
+        response_fields,
+        response_fields_paneled,
+    )
+
+    frames = _frames((64, 300))
+    whole = response_fields(frames, smooth_sigma=2.0, interpret=True)
+    # max_panel_w=160 -> 128-wide cores -> 3 panels at W=300.
+    paneled = response_fields_paneled(
+        frames, smooth_sigma=2.0, max_panel_w=160, interpret=True
+    )
+    r = _reach(5, 1.5, 2.0)
+    band = np.s_[:, :, r:-r]
+    for w, p in zip(whole[:3], paneled[:3]):
+        np.testing.assert_array_equal(np.asarray(w)[band], np.asarray(p)[band])
+    np.testing.assert_array_equal(
+        np.asarray(whole[3]), np.asarray(paneled[3])
+    )
+
+
+def test_wide_frame_detect_uses_paneled_path():
+    """W past the strip kernel's lane budget: detect_keypoints_batch
+    takes the paneled Pallas route and agrees with the jnp path."""
+    frames = _frames((48, 2100), n=1)
+    kw = dict(max_keypoints=96, threshold=1e-4, border=16, harris_k=0.04)
+    kj = detect_keypoints_batch(frames, **kw, use_pallas=False)
+    kp = detect_keypoints_batch(frames, **kw, use_pallas=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(kj.valid), np.asarray(kp.valid))
+    both = np.asarray(kj.valid & kp.valid)
+    assert np.abs(np.asarray(kj.xy) - np.asarray(kp.xy))[both].max() < 1e-3
+
+
+def test_supports_paneled_gates():
+    from kcmc_tpu.ops.pallas_detect import supports_paneled
+
+    assert supports_paneled(border=16)
+    assert not supports_paneled(border=4)  # frame-edge band exposed
+    assert not supports_paneled(nms_size=19, border=16)  # halo
+    assert not supports_paneled(smooth_sigma=0.0, border=16)
+
+
 def test_describe_accepts_precomputed_smooth():
     """Threading detect's smooth into describe changes nothing."""
     from kcmc_tpu.ops.describe import describe_keypoints_batch
