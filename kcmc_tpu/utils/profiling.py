@@ -36,6 +36,16 @@ def honest_time(
     fresh compile otherwise lands in the device's cold-clock window and
     reads 2-3x high (measured on this image's TPU — the inflation decays
     over ~0.5 s of sustained execution, not a fixed iteration count).
+
+    CAVEAT for A/B kernel comparisons: even with this warmup, the FIRST
+    honest_time call of a process (or after any idle gap) can read
+    5-10x high at the sub-millisecond scale (measured round 3: a 65k
+    sort timed 6.1 ms cold vs 0.36 ms sustained — an apparent "6x
+    optimization" that was pure artifact; DESIGN.md "Large-frame
+    support", negative result). Comparing two variants honestly needs
+    several seconds of sustained pre-warming of BOTH, then interleaved
+    repeated loops taking min-of; and only an end-to-end delta confirms
+    a win.
     """
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,118 @@
+"""Trajectory smoothing: stabilization-style correction.
+
+`MotionCorrector.correct` removes ALL motion — every frame is pinned to
+the reference. For stabilization workloads (handheld / stage-walk
+video, long drifting acquisitions) the goal is different: remove the
+high-frequency jitter but FOLLOW the intentional motion, so the output
+pans/zooms smoothly instead of fighting a large accumulated drift (and
+losing field of view to it). The standard decomposition (same family as
+OpenCV vidstab / MeshFlow parameter smoothing): low-pass the recovered
+per-frame motion trajectory, and re-apply only the residual.
+
+    res = mc.correct(stack)                       # full registration
+    stab = smooth_trajectory(res.transforms, sigma=15)
+    stabilized = apply_correction(stack, stab)    # jitter-free pan
+
+Given full-correction warps M_t (output->source maps, the repo-wide
+convention) and their temporal low-pass M̃_t, the stabilizing warp is
+
+    S_t = M_t @ inv(M̃_t)
+
+-- undo the SMOOTHED correction after applying the full one, leaving
+the smooth path in and taking the jitter out. Two invariants make this
+the right composition: an already-smooth trajectory gives S_t == I
+(footage untouched), and sigma -> inf recovers full registration up to
+the mean pose. Matrix entries are smoothed directly (exact for the
+translation family; for rotational jitter the induced scale error is
+1 - cos(dtheta) ~ 1e-4 at the ~1 degree jitter scale this targets —
+stabilizing warps need not be exactly rigid, they are just warps);
+homographies are re-normalized to M[2,2] = 1 after smoothing.
+
+Counterpart of a motion-correction framework's stabilization mode
+(SURVEY.md §0 names video stabilization as a use of the pipeline
+family; reference source unavailable — contract from BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _gaussian_taps(sigma: float) -> np.ndarray:
+    r = max(1, int(3.0 * sigma + 0.5))
+    x = np.arange(-r, r + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return k / k.sum()
+
+
+def _smooth_along_t(arr: np.ndarray, sigma: float) -> np.ndarray:
+    """Gaussian low-pass along axis 0 with odd-reflect padding.
+
+    Odd reflection (p[-k] = 2*p[0] - p[k]) extends the trajectory
+    C^1-continuously, so a path arriving at the boundary with nonzero
+    velocity is extrapolated straight through it instead of kinking
+    into a mirrored V (plain "reflect" at sigma=8 over a 30-px/240-frame
+    sinusoid bends the smoothed endpoint ~5 px off the true path; odd
+    reflection leaves O(sigma^2 * curvature))."""
+    taps = _gaussian_taps(sigma)
+    r = len(taps) // 2
+    T = arr.shape[0]
+    flat = arr.reshape(T, -1).astype(np.float64)
+    # for T == 1 there is nothing to smooth
+    if T == 1:
+        return arr.astype(np.float64)
+    pad = np.pad(flat, ((r, r), (0, 0)), mode="reflect", reflect_type="odd")
+    out = np.empty_like(flat)
+    for j in range(flat.shape[1]):
+        out[:, j] = np.convolve(pad[:, j], taps, mode="valid")
+    return out.reshape(arr.shape)
+
+
+def smooth_trajectory(
+    transforms: np.ndarray | None = None,
+    fields: np.ndarray | None = None,
+    sigma: float = 15.0,
+) -> np.ndarray:
+    """Stabilizing transforms/fields from a recovered motion trajectory.
+
+    Pass exactly one of:
+
+    * `transforms` — (T, 3, 3) or (T, 4, 4) full-correction warps from
+      `CorrectionResult.transforms` (any matrix model, 2D or rigid3d).
+      Returns same-shape stabilizing warps S_t = M_t @ inv(smooth(M)_t)
+      for `apply_correction`.
+    * `fields` — (T, gh, gw, 2) piecewise displacement fields from
+      `CorrectionResult.fields`. Displacement fields compose additively
+      (to first order in the displacement), so the stabilizing field is
+      the high-pass residual F_t - smooth(F)_t. Returns same shape.
+
+    `sigma` is the temporal Gaussian's scale IN FRAMES: motion slower
+    than ~sigma frames is kept, faster is removed. Boundary handling is
+    odd reflection — the path is extrapolated slope-preservingly
+    through the ends instead of sliding toward the sequence mean.
+    """
+    if (transforms is None) == (fields is None):
+        raise ValueError("pass exactly one of transforms= or fields=")
+    if sigma <= 0.0:
+        raise ValueError(f"sigma must be positive, got {sigma}")
+    if fields is not None:
+        fields = np.asarray(fields)
+        if fields.ndim != 4 or fields.shape[-1] != 2:
+            raise ValueError(f"fields must be (T, gh, gw, 2), got {fields.shape}")
+        sm = _smooth_along_t(fields, sigma)
+        return (fields - sm).astype(np.float32)
+
+    M = np.asarray(transforms)
+    d = M.shape[-1]
+    if M.ndim != 3 or M.shape[-2] != d or d not in (3, 4):
+        raise ValueError(
+            f"transforms must be (T, 3, 3) or (T, 4, 4), got {M.shape}"
+        )
+    sm = _smooth_along_t(M, sigma)
+    # Projective entries drift off unit scale under averaging; renorm.
+    sm = sm / sm[:, -1:, -1:]
+    # Smoothing preserves the affine last row exactly (constant input
+    # rows stay constant under a normalized kernel); inv() is then a
+    # valid warp of the same kind.
+    stab = np.einsum("tij,tjk->tik", M.astype(np.float64), np.linalg.inv(sm))
+    return stab.astype(np.float32)

@@ -151,6 +151,13 @@ def test_paneled_fields_match_whole_frame_kernel():
 def test_wide_frame_detect_uses_paneled_path():
     """W past the strip kernel's lane budget: detect_keypoints_batch
     takes the paneled Pallas route and agrees with the jnp path."""
+    from kcmc_tpu.ops.pallas_detect import supports, supports_paneled
+
+    # Guard the premise: this width really is beyond the whole-frame
+    # kernel and inside the paneled gate — otherwise the comparison
+    # below would vacuously run the jnp path twice.
+    assert not supports((48, 2100))
+    assert supports_paneled(border=16)
     frames = _frames((48, 2100), n=1)
     kw = dict(max_keypoints=96, threshold=1e-4, border=16, harris_k=0.04)
     kj = detect_keypoints_batch(frames, **kw, use_pallas=False)
