@@ -36,6 +36,7 @@ def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only
             "MotionCorrector",
             "CorrectionResult",
             "apply_correction",
+            "apply_correction_file",
             "common_valid_region",
         ):
             from kcmc_tpu import corrector

@@ -3,11 +3,18 @@
     python -m kcmc_tpu info stack.tif
     python -m kcmc_tpu correct stack.tif -o corrected.tif \
         --model affine --transforms transforms.npz --progress
+    python -m kcmc_tpu correct structural.tif --transforms reg.npz
+    python -m kcmc_tpu apply functional.tif reg.npz -o func_corrected.tif
+    python -m kcmc_tpu stabilize video.tif -o stabilized.tif --sigma 15
 
 `correct` streams: chunks decode in a background thread (native TIFF
 decoder), register on the accelerator, and corrected frames append to
 the output TIFF incrementally — constant host memory regardless of
-stack length.
+stack length. Without `-o` it is registration-only (no corrected-frame
+transfers at all — the fast first pass of the `apply`/`stabilize`
+workflows). `apply` resamples any same-shape stack through a saved
+registration (multi-channel microscopy); `stabilize` removes motion
+faster than ~sigma frames and follows the rest.
 """
 
 from __future__ import annotations
@@ -76,6 +83,10 @@ def _cmd_correct(args) -> int:
         checkpoint=args.checkpoint or None,
         checkpoint_every=args.checkpoint_every,
         stall_abort=args.stall_exit or None,
+        # No -o: the CLI discards corrected pixels (only --transforms
+        # and the summary are written), so skip computing their
+        # device->host transfer entirely — registration-only streaming.
+        emit_frames=args.output is not None,
     )
 
     if args.transforms:
@@ -120,9 +131,11 @@ def _cmd_correct(args) -> int:
     if res.timing.get("warp_escalated"):
         summary["warp_escalated"] = True
     if "template_corr" in res.diagnostics:
+        # nan-aware: registration-only runs NaN out frames whose QC
+        # would have been measured against an unrescued zeroed warp
         corr = res.diagnostics["template_corr"]
-        summary["template_corr_mean"] = round(float(np.mean(corr)), 4)
-        summary["template_corr_min"] = round(float(np.min(corr)), 4)
+        summary["template_corr_mean"] = round(float(np.nanmean(corr)), 4)
+        summary["template_corr_min"] = round(float(np.nanmin(corr)), 4)
     print(json.dumps(summary))
     return 0
 
@@ -196,6 +209,80 @@ def _correct_volumetric(args) -> int:
         summary["template_corr_mean"] = round(
             float(np.mean(res.diagnostics["template_corr"])), 4
         )
+    print(json.dumps(summary))
+    return 0
+
+
+def _cmd_apply(args) -> int:
+    """Apply previously-recovered transforms to another stack file —
+    the multi-channel workflow's pass 2 (register the structural
+    channel with `correct --transforms reg.npz`, apply to each
+    functional channel's file)."""
+    from kcmc_tpu import apply_correction_file
+
+    data = np.load(args.transforms)
+    if "transforms" in data:
+        kind = {"transforms": data["transforms"]}
+    elif "fields" in data:
+        kind = {"fields": data["fields"]}
+    else:
+        raise SystemExit(
+            f"{args.transforms} contains neither 'transforms' nor 'fields' "
+            "— was it written by `correct --transforms`? (keys: "
+            f"{sorted(data.keys())})"
+        )
+    apply_correction_file(
+        args.stack,
+        args.output,
+        **kind,
+        compression=args.compression,
+        output_dtype=args.output_dtype,
+        n_threads=args.io_threads,
+        progress=args.progress,
+    )
+    print(json.dumps({"output": args.output, "applied": args.transforms}))
+    return 0
+
+
+def _cmd_stabilize(args) -> int:
+    """Two-pass stabilization: registration-only streaming pass (no
+    corrected-frame transfers), temporal low-pass of the trajectory,
+    then stream the ORIGINAL frames through the stabilizing warps."""
+    from kcmc_tpu import MotionCorrector, apply_correction_file, smooth_trajectory
+
+    ref, overrides = _parse_reference_and_overrides(args)
+    mc = MotionCorrector(
+        model=args.model, backend=args.backend, reference=ref, **overrides
+    )
+    res = mc.correct_file(
+        args.stack,
+        progress=args.progress,
+        n_threads=args.io_threads,
+        emit_frames=False,
+    )
+    if res.transforms is not None:
+        stab = {"transforms": smooth_trajectory(res.transforms, sigma=args.sigma)}
+    else:
+        stab = {"fields": smooth_trajectory(fields=res.fields, sigma=args.sigma)}
+    apply_correction_file(
+        args.stack,
+        args.output,
+        **stab,
+        compression=args.compression,
+        output_dtype=args.output_dtype,
+        n_threads=args.io_threads,
+        progress=args.progress,
+    )
+    summary = {
+        "model": args.model,
+        "sigma_frames": args.sigma,
+        "output": args.output,
+        "mean_inliers": float(np.mean(res.diagnostics["n_inliers"]))
+        if "n_inliers" in res.diagnostics
+        else None,
+    }
+    if args.transforms:
+        np.savez(args.transforms, **stab, **dict(res.diagnostics))
     print(json.dumps(summary))
     return 0
 
@@ -275,6 +362,54 @@ def main(argv=None) -> int:
     )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
+
+    p = sub.add_parser(
+        "apply",
+        help="apply recovered transforms to another stack file "
+        "(multi-channel pass 2)",
+    )
+    p.add_argument("stack", help="input multi-page TIFF to resample")
+    p.add_argument("transforms", help=".npz from `correct --transforms`")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--compression", default="none",
+                   choices=["none", "deflate", "packbits"])
+    p.add_argument("--output-dtype", default="input")
+    p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument("--progress", action="store_true")
+    p.set_defaults(fn=_cmd_apply)
+
+    p = sub.add_parser(
+        "stabilize",
+        help="remove jitter but follow intentional motion "
+        "(register, low-pass the trajectory, re-apply the residual)",
+    )
+    p.add_argument("stack", help="input multi-page TIFF")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument(
+        "--sigma", type=float, default=15.0,
+        help="temporal scale IN FRAMES: slower motion is kept (default 15)",
+    )
+    p.add_argument(
+        "--model", default="translation",
+        choices=["translation", "rigid", "similarity", "affine",
+                 "homography", "piecewise"],
+    )
+    p.add_argument("--backend", default="jax")
+    p.add_argument("--reference", default="0")
+    p.add_argument("--transforms",
+                   help=".npz for the stabilizing transforms + diagnostics")
+    p.add_argument("--batch-size", type=int, default=0)
+    p.add_argument("--max-keypoints", type=int, default=0)
+    p.add_argument("--hypotheses", type=int, default=0)
+    p.add_argument("--warp", default="",
+                   choices=["", "auto", "jnp", "pallas", "separable"])
+    p.add_argument("--quality", action="store_true")
+    p.add_argument("--compression", default="none",
+                   choices=["none", "deflate", "packbits"])
+    p.add_argument("--output-dtype", default="input")
+    p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument("--progress", action="store_true")
+    p.set_defaults(fn=_cmd_stabilize)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -167,7 +167,8 @@ class JaxBackend:
         return jax.tree.map(np.asarray, out)
 
     def process_batch_async(
-        self, frames, ref: dict, frame_indices, to_host=True, cast_dtype=None
+        self, frames, ref: dict, frame_indices, to_host=True, cast_dtype=None,
+        emit_frames=True,
     ) -> dict:
         """Dispatch one batch; return the *device* output arrays without
         blocking. With `to_host` (the orchestrator's host-fed path) the
@@ -182,7 +183,14 @@ class JaxBackend:
         (integer targets) additionally rounds/clips/casts the corrected
         frames on device BEFORE the device->host copy — for a uint16
         stack the two together halve the tunnel traffic in each
-        direction."""
+        direction.
+
+        `emit_frames=False` (registration-only runs: transform export,
+        stabilization pass 1) drops the corrected frames from the
+        returned dict so their device->host copy — the dominant
+        transfer — never happens. The warp still executes on device
+        (it is part of the compiled program, and the quality metrics
+        read it); only the transfer is skipped."""
         shape = tuple(frames.shape[1:])
         fn = self._get_batch_fn(shape)
         frames_j = jnp.asarray(frames)
@@ -211,6 +219,9 @@ class JaxBackend:
             out["coverage"] = jnp.mean(
                 mask.astype(jnp.float32), axis=tuple(range(1, mask.ndim))
             )
+        if not emit_frames and "corrected" in out:
+            out = dict(out)  # quality metrics above already read it
+            del out["corrected"]
         if cast_dtype is not None and "corrected" in out:
             dt = np.dtype(cast_dtype)
             if np.issubdtype(dt, np.integer):

@@ -198,10 +198,85 @@ def apply_correction(
     return np.concatenate(outs)
 
 
+def apply_correction_file(
+    path,
+    output: str,
+    transforms: np.ndarray | None = None,
+    fields: np.ndarray | None = None,
+    chunk_size: int = 256,
+    compression: str = "none",
+    output_dtype: str | np.dtype = "input",
+    n_threads: int = 0,
+    progress: bool = False,
+) -> None:
+    """Streaming `apply_correction`: TIFF in, corrected TIFF out,
+    constant host memory.
+
+    Completes the file-scale versions of the two-pass workflows:
+
+    * multi-channel — register the structural channel
+      (`correct_file(..., emit_frames=False)` or with transforms saved),
+      then apply its transforms to each functional channel's file;
+    * stabilization — register, `smooth_trajectory` the transforms,
+      apply the stabilizers back to the ORIGINAL file
+      (`python -m kcmc_tpu stabilize` wires exactly this).
+
+    `transforms`/`fields` must cover every page of `path` (page t gets
+    transforms[t]). 2D stacks only — the volumetric path is in-memory
+    (see the CLI's rigid3d handling). Output dtype semantics match
+    `apply_correction`; BigTIFF engages automatically past 4 GiB.
+    """
+    from kcmc_tpu.io import ChunkedStackLoader, TiffStack
+    from kcmc_tpu.io.tiff import TiffWriter
+
+    if (transforms is None) == (fields is None):
+        raise ValueError("pass exactly one of transforms= or fields=")
+    ref = transforms if transforms is not None else fields
+    with TiffStack(path, n_threads=n_threads) as ts:
+        if len(ref) != len(ts):
+            raise ValueError(
+                f"{path} has {len(ts)} pages but {len(ref)} transforms/fields"
+            )
+        if len(ts.frame_shape) != 2:
+            raise ValueError("apply_correction_file covers 2D stacks only")
+        out_dt = _resolve_apply_dtype(output_dtype, ts)
+        writer = TiffWriter(
+            output, compression=compression,
+            bigtiff=_wants_bigtiff(len(ts), ts.frame_shape, out_dt),
+        )
+        loader = ChunkedStackLoader(ts, chunk_size=chunk_size)
+        chunks = iter(loader)  # background-threaded decode prefetch
+        try:
+            for lo, hi, chunk in chunks:
+                got = apply_correction(
+                    np.asarray(chunk),
+                    transforms=None if transforms is None else transforms[lo:hi],
+                    fields=None if fields is None else fields[lo:hi],
+                    output_dtype=out_dt,
+                )
+                writer.append_batch(got, n_threads=n_threads)
+                if progress:
+                    print(f"[kcmc] applied {hi}/{len(ts)}", flush=True)
+        finally:
+            chunks.close()  # stop + join the prefetch thread
+            writer.close()
+
+
 def _resolve_apply_dtype(output_dtype, stack) -> np.dtype:
     if isinstance(output_dtype, str) and output_dtype == "input":
         return np.dtype(stack.dtype)
     return np.dtype(output_dtype)
+
+
+def _wants_bigtiff(n_frames: int, frame_shape, out_dt: np.dtype) -> bool:
+    """BigTIFF for outputs past classic TIFF's 4 GiB offset ceiling.
+    The estimate counts pixel data (+1% — packbits EXPANDS
+    incompressible data by up to ~0.8%, and a false-positive BigTIFF is
+    free) plus per-page IFD overhead (~215 B written; 256 covers
+    padding). Shared by `correct_file` and `apply_correction_file`."""
+    frame_bytes = int(np.prod(frame_shape)) * out_dt.itemsize
+    est = n_frames * (frame_bytes + frame_bytes // 100 + 256)
+    return est + (1 << 20) >= 2**32
 
 
 _APPLY_FN_CACHE: dict = {}
@@ -643,6 +718,7 @@ class MotionCorrector:
     def _dispatch_batches(
         self, batches, ref, drain, depth: int = 3, to_host=True,
         keep_frames=False, cast_dtype=None, allow_escalation=True,
+        emit_frames=True,
     ):
         """Pipelined dispatch: keep `depth` batches in flight so the
         host->device upload of batch i+1, the compute of batch i, and
@@ -682,7 +758,7 @@ class MotionCorrector:
         self._escalation_allowed = allow_escalation
         self._rescue_warned = False
         inflight: list[tuple[int, dict, Any]] = []
-        accepts_cast: dict[int, bool] = {}  # per-backend, inspected once
+        accepts_cast: dict = {}  # per-backend kwarg support, inspected once
         native_ok: dict[int, bool] = {}
         for n, batch, idx in batches:
             backend = (
@@ -712,12 +788,27 @@ class MotionCorrector:
                         )
                     if accepts_cast[key]:
                         kw["cast_dtype"] = cast_dtype
+                if not emit_frames:
+                    key = ("emit", id(backend))
+                    if key not in accepts_cast:
+                        accepts_cast[key] = self._dispatch_accepts(
+                            dispatch, "emit_frames"
+                        )
+                    if accepts_cast[key]:
+                        kw["emit_frames"] = False
                 out = dispatch(batch, ref, idx, **kw)
+                if not emit_frames and "corrected" in out:
+                    # backends without the emit_frames seam still drop
+                    # the frames here (no D2H saving, same results)
+                    out = {k: v for k, v in out.items() if k != "corrected"}
                 inflight.append((n, out, kept))
                 if len(inflight) >= depth:
                     drain(inflight.pop(0))
             else:
-                drain((n, backend.process_batch(batch, ref, idx), kept))
+                out = backend.process_batch(batch, ref, idx)
+                if not emit_frames and "corrected" in out:
+                    out = {k: v for k, v in out.items() if k != "corrected"}
+                drain((n, out, kept))
         for entry in inflight:
             drain(entry)
 
@@ -853,6 +944,7 @@ class MotionCorrector:
         checkpoint: str | None = None,
         checkpoint_every: int = 512,
         stall_abort: float | None = None,
+        emit_frames: bool = True,
     ) -> CorrectionResult:
         """Stream-correct a multi-page TIFF stack.
 
@@ -881,6 +973,14 @@ class MotionCorrector:
         as --stall-exit. Set it well above your first batch's compile
         time (~2 min at 512x512 on TPU).
 
+        `emit_frames=False` is REGISTRATION-ONLY streaming: recover
+        transforms/diagnostics without materializing corrected frames —
+        no output file, no corrected-frame device->host transfer (the
+        dominant data movement), constant small host memory. The
+        natural pass 1 of a stabilization or multi-channel workflow
+        (follow with `apply_correction_file`). Incompatible with
+        `output=`.
+
         `checkpoint`: path to a resume checkpoint (.npz). Every
         `checkpoint_every` processed frames (rounded to batches), the
         recovered transforms/diagnostics AND the output TIFF's exact
@@ -908,6 +1008,11 @@ class MotionCorrector:
             raise ValueError(
                 "checkpoint requires output= (corrected frames are "
                 "persisted in the output TIFF, not the checkpoint)"
+            )
+        if not emit_frames and output is not None:
+            raise ValueError(
+                "emit_frames=False is registration-only; it cannot be "
+                "combined with output= (which asks for corrected frames)"
             )
         if stall_abort is not None and stall_abort <= 0:
             raise ValueError(
@@ -992,18 +1097,11 @@ class MotionCorrector:
                         writer, start, outs, n_parts = None, 0, [], 0
                 # signature mismatch: stale checkpoint, restart
             if writer is None and output:
-                # BigTIFF for outputs past classic TIFF's 4 GiB offset
-                # ceiling (e.g. the 512x512x10k-frame judged stack at
-                # uint16 is 5 GB); both decoders read it back. The
-                # estimate counts pixel data (+1% — packbits EXPANDS
-                # incompressible data by up to ~0.8%, and a false-
-                # positive BigTIFF is free) plus per-page IFD overhead
-                # (~215 B written; 256 covers padding).
-                frame_bytes = int(np.prod(ts.frame_shape)) * out_dt.itemsize
-                est = len(ts) * (frame_bytes + frame_bytes // 100 + 256)
+                # BigTIFF sizing (e.g. the 512x512x10k-frame judged
+                # stack at uint16 is 5 GB); both decoders read it back.
                 writer = TiffWriter(
                     output, compression=compression,
-                    bigtiff=est + (1 << 20) >= 2**32,
+                    bigtiff=_wants_bigtiff(len(ts), ts.frame_shape, out_dt),
                 )
             restored = start
 
@@ -1036,8 +1134,17 @@ class MotionCorrector:
             def drain(entry):
                 n, out, batch = entry
                 host = {k: np.asarray(v)[:n] for k, v in out.items()}
-                if cfg.rescue_warp:
+                if cfg.rescue_warp and emit_frames:
                     self._rescue_flagged(host, batch, n, ref)
+                elif "template_corr" in host and "warp_ok" in host:
+                    # Registration-only: out-of-bound frames were never
+                    # rescue-rewarped, so their on-device template_corr
+                    # was measured against a bounded-kernel-ZEROED frame
+                    # — garbage. NaN beats a silently-wrong QC value
+                    # (with -o the rescue path reports the real one).
+                    host["template_corr"] = np.where(
+                        host["warp_ok"], host["template_corr"], np.nan
+                    )
                 corrected = host.pop("corrected", None)
                 if corrected is not None:
                     corrected = _cast_output(corrected, out_dt)
@@ -1085,8 +1192,9 @@ class MotionCorrector:
             try:
                 with timer.stage("register_batches"):
                     self._dispatch_batches(
-                        batch_gen, ref, drain, keep_frames=cfg.rescue_warp,
-                        cast_dtype=cast,
+                        batch_gen, ref, drain,
+                        keep_frames=cfg.rescue_warp and emit_frames,
+                        cast_dtype=cast, emit_frames=emit_frames,
                         # checkpointed runs stay on one warp kernel so a
                         # resume is byte-identical to an uninterrupted
                         # run (escalation's kernel switch is visible at

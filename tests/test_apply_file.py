@@ -1,0 +1,183 @@
+"""Registration-only streaming, apply_correction_file, and the
+apply/stabilize CLI commands (the file-scale two-pass workflows)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector, apply_correction, apply_correction_file
+from kcmc_tpu.io import read_stack, write_stack
+from kcmc_tpu.utils import synthetic
+
+SHAPE = (96, 96)
+
+
+def _make_input(tmp_path, n_frames=6, model="translation"):
+    data = synthetic.make_drift_stack(
+        n_frames=n_frames, shape=SHAPE, model=model, max_drift=4.0, seed=11
+    )
+    path = tmp_path / "in.tif"
+    write_stack(path, data.stack)
+    return data, path
+
+
+def test_emit_frames_false_registers_without_frames(tmp_path):
+    data, path = _make_input(tmp_path)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=3)
+    full = mc.correct_file(path)
+    reg = mc.correct_file(path, emit_frames=False)
+    assert reg.corrected.shape[0] == 0
+    np.testing.assert_allclose(reg.transforms, full.transforms, atol=1e-6)
+    # Diagnostics still flow (minus the pixel-level rescue rewrite).
+    assert "n_inliers" in reg.diagnostics
+    np.testing.assert_array_equal(
+        reg.diagnostics["n_inliers"], full.diagnostics["n_inliers"]
+    )
+
+
+def test_emit_frames_false_quality_metrics_still_computed(tmp_path):
+    data, path = _make_input(tmp_path)
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=3, quality_metrics=True
+    )
+    reg = mc.correct_file(path, emit_frames=False)
+    assert reg.corrected.shape[0] == 0
+    assert (reg.diagnostics["template_corr"] > 0.5).all()
+
+
+def test_emit_frames_false_rejects_output(tmp_path):
+    data, path = _make_input(tmp_path)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=3)
+    with pytest.raises(ValueError, match="registration-only"):
+        mc.correct_file(path, output=str(tmp_path / "o.tif"), emit_frames=False)
+
+
+def test_emit_frames_false_numpy_backend(tmp_path):
+    """Backends without the emit_frames seam drop frames in the
+    orchestrator — same transforms, empty corrected."""
+    data, path = _make_input(tmp_path)
+    mc = MotionCorrector(model="translation", backend="numpy", batch_size=3)
+    reg = mc.correct_file(path, emit_frames=False)
+    full = mc.correct_file(path)
+    assert reg.corrected.shape[0] == 0
+    np.testing.assert_allclose(reg.transforms, full.transforms, atol=1e-6)
+
+
+def test_emit_frames_false_nans_unrescued_quality(tmp_path):
+    """Registration-only runs cannot rescue out-of-bound frames, so
+    their template_corr (measured on a bounded-kernel-zeroed frame)
+    must come back NaN, not as a silently-wrong score."""
+    from kcmc_tpu.utils.synthetic import render_scene, _warp_scene
+
+    rng = np.random.default_rng(5)
+    scene = render_scene(rng, (256, 256), n_blobs=220)
+    shifts = [(0.0, 0.0), (140.0, -20.0), (3.0, 2.0)]  # 140 > the ±128 bound
+    mats = np.tile(np.eye(3, dtype=np.float32), (len(shifts), 1, 1))
+    mats[:, :2, 2] = shifts
+    stack = np.stack([_warp_scene(scene, m) for m in mats]).astype(np.float32)
+    path = tmp_path / "big.tif"
+    write_stack(path, stack)
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=3, warp="pallas",
+        quality_metrics=True, rescue_warp=True,
+    )
+    reg = mc.correct_file(path, emit_frames=False)
+    ok = np.asarray(reg.diagnostics["warp_ok"], bool)
+    corr = np.asarray(reg.diagnostics["template_corr"])
+    assert not ok[1] and ok[0] and ok[2]
+    assert np.isnan(corr[1]) and np.isfinite(corr[[0, 2]]).all()
+    # The full (rescuing) run reports a real score for the same frame.
+    full = mc.correct_file(path)
+    assert np.isfinite(full.diagnostics["template_corr"]).all()
+
+
+def test_apply_correction_file_matches_in_memory(tmp_path):
+    data, path = _make_input(tmp_path)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=3)
+    res = mc.correct_file(path, emit_frames=False)
+    out = tmp_path / "applied.tif"
+    apply_correction_file(
+        path, str(out), transforms=res.transforms, chunk_size=4
+    )
+    want = apply_correction(
+        data.stack, res.transforms, output_dtype=data.stack.dtype
+    )
+    np.testing.assert_array_equal(read_stack(out), want)
+
+
+def test_apply_correction_file_validation(tmp_path):
+    data, path = _make_input(tmp_path)
+    out = str(tmp_path / "o.tif")
+    with pytest.raises(ValueError, match="exactly one"):
+        apply_correction_file(path, out)
+    with pytest.raises(ValueError, match="pages"):
+        apply_correction_file(
+            path, out, transforms=np.tile(np.eye(3), (3, 1, 1))
+        )
+
+
+def _run_cli(args, timeout=600):
+    script = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import kcmc_tpu.__main__ as m; import sys; sys.exit(m.main(%r))"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", script % (args,)],
+        capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_cli_register_then_apply(tmp_path):
+    """correct without -o (registration-only) -> apply to a second
+    channel: the multi-channel file workflow end to end."""
+    data, path = _make_input(tmp_path)
+    # A "functional channel": different contrast, same motion.
+    func = tmp_path / "func.tif"
+    write_stack(func, (data.stack * 0.5 + 7.0).astype(np.float32))
+    tpath = tmp_path / "reg.npz"
+    out = _run_cli([
+        "correct", str(path), "--transforms", str(tpath),
+        "--model", "translation", "--batch-size", "3",
+    ])
+    assert out.returncode == 0, out.stderr
+    assert json.loads(out.stdout.strip().splitlines()[-1])["output"] is None
+
+    opath = tmp_path / "func_corr.tif"
+    out = _run_cli(["apply", str(func), str(tpath), "-o", str(opath)])
+    assert out.returncode == 0, out.stderr
+    got = read_stack(opath)
+    want = apply_correction(
+        (data.stack * 0.5 + 7.0).astype(np.float32),
+        np.load(tpath)["transforms"],
+        output_dtype="float32",
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_cli_apply_rejects_wrong_npz(tmp_path):
+    data, path = _make_input(tmp_path)
+    bad = tmp_path / "bad.npz"
+    np.savez(bad, unrelated=np.zeros(3))
+    out = _run_cli(["apply", str(path), str(bad), "-o", str(tmp_path / "o.tif")])
+    assert out.returncode != 0
+    assert "neither 'transforms' nor 'fields'" in out.stderr
+
+
+def test_cli_stabilize(tmp_path):
+    data, path = _make_input(tmp_path, n_frames=12)
+    opath = tmp_path / "stab.tif"
+    out = _run_cli([
+        "stabilize", str(path), "-o", str(opath), "--sigma", "3",
+        "--batch-size", "4",
+    ])
+    assert out.returncode == 0, out.stderr
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    assert summary["sigma_frames"] == 3.0
+    got = read_stack(opath)
+    assert got.shape == data.stack.shape
+    # Stabilized footage shakes less than the raw footage.
+    shake = lambda s: np.abs(np.diff(np.asarray(s, np.float32), axis=0)).mean()
+    assert shake(got) < shake(data.stack)
