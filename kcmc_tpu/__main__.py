@@ -60,6 +60,8 @@ def _parse_reference_and_overrides(args):
         overrides["warp"] = args.warp
     if args.quality:
         overrides["quality_metrics"] = True
+    if getattr(args, "template_update", 0):
+        overrides["template_update_every"] = args.template_update
     return ref, overrides
 
 
@@ -84,8 +86,10 @@ def _cmd_correct(args) -> int:
         checkpoint_every=args.checkpoint_every,
         stall_abort=args.stall_exit or None,
         # No -o: the CLI discards corrected pixels (only --transforms
-        # and the summary are written), so skip computing their
-        # device->host transfer entirely — registration-only streaming.
+        # and the summary are written), so skip their device->host
+        # transfer entirely — registration-only streaming (with
+        # --template-update, only each update's averaging window
+        # transfers).
         emit_frames=args.output is not None,
     )
 
@@ -347,6 +351,14 @@ def main(argv=None) -> int:
     p.add_argument(
         "--quality", action="store_true",
         help="report per-frame template correlation (registration QC)",
+    )
+    p.add_argument(
+        "--template-update", type=int, default=0,
+        help="rolling template updates every N frames (long recordings "
+        "whose scene bleaches/changes; 0 = off). Updates land at fixed "
+        "frame boundaries, so results are batch/chunk/resume invariant; "
+        "checkpoint saves defer to window-safe positions (at worst one "
+        "N-frame period apart)",
     )
     p.add_argument(
         "--checkpoint", default="",

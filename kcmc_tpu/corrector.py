@@ -461,6 +461,28 @@ class MotionCorrector:
         than any single frame, so registration against it is more
         accurate on low-SNR stacks. Standard practice in microscopy
         motion correction.
+    template_update_every:
+        ROLLING template updates for long recordings (0 = off). Scenes
+        change over hours — bleaching, remodeling, focus creep — and a
+        template frozen at frame 0 slowly loses matches against them.
+        Every `template_update_every` frames the template becomes
+        (1 - alpha) * template + alpha * mean(last `template_window`
+        successfully corrected frames), and the reference descriptors
+        are re-extracted (NoRMCorre-style template tracking; updated
+        frames are already aligned to the original template, so
+        transforms stay in one global frame of reference). Update
+        boundaries are FIXED frame indices, so results are independent
+        of batch size and — with `correct_file(checkpoint=)`, which
+        stores the evolving template and restricts its saves to
+        window-safe cursor positions — of kill/resume points.
+        Registration-only streaming (`emit_frames=False`) composes:
+        only each segment's averaging window transfers to host.
+        Rolling runs also skip the integer device-side output cast
+        (the template must blend unrounded float32 pixels, or the
+        transforms would depend on the output pixel format).
+    template_update_alpha:
+        Blend weight of the new window mean in each rolling update
+        (default 0.5; 1.0 replaces the template outright).
     config / **overrides:
         A full CorrectorConfig, or keyword overrides applied on top of
         the defaults (e.g. `MotionCorrector(model="affine", n_hypotheses=256)`).
@@ -475,6 +497,8 @@ class MotionCorrector:
         reference_window: int = 16,
         template_iters: int = 0,
         template_window: int | None = None,
+        template_update_every: int = 0,
+        template_update_alpha: float = 0.5,
         mesh=None,
         **overrides,
     ):
@@ -491,6 +515,18 @@ class MotionCorrector:
             if template_window is not None
             else max(reference_window, 32)
         )
+        if template_update_every < 0:
+            raise ValueError(
+                f"template_update_every must be >= 0 frames, got "
+                f"{template_update_every}"
+            )
+        if not 0.0 < template_update_alpha <= 1.0:
+            raise ValueError(
+                f"template_update_alpha must be in (0, 1], got "
+                f"{template_update_alpha}"
+            )
+        self.template_update_every = template_update_every
+        self.template_update_alpha = template_update_alpha
         # Out-of-bound warp telemetry (reset per dispatch run).
         self._escalation_backend = None
         self._rescue_seen = 0
@@ -568,6 +604,50 @@ class MotionCorrector:
             ref_frame = np.mean(frames, axis=0, dtype=np.float32)
         return ref_frame
 
+    def _rolled_template(
+        self, ref_frame: np.ndarray, tail_corrected, tail_ok, window: int
+    ) -> np.ndarray:
+        """One rolling update: blend the mean of the last `window`
+        frames' successfully-warped corrected pixels into the template
+        (`template_update_every` semantics; see the class docstring).
+        The window is sliced FRAME-exactly here so the memory and
+        streaming paths (whose buffers trim at batch granularity) blend
+        identical frame sets. Keeps the template unchanged when every
+        frame in the window was out of warp bounds."""
+        if not tail_corrected:
+            return ref_frame
+        frames = np.concatenate(
+            [np.asarray(c, np.float32) for c in tail_corrected]
+        )[-window:]
+        ok = np.concatenate(
+            [np.asarray(k, bool) for k in tail_ok]
+        )[-window:]
+        frames = frames[ok]
+        if len(frames) == 0:
+            return ref_frame
+        mean = np.mean(frames, axis=0, dtype=np.float32)
+        a = self.template_update_alpha
+        return (1.0 - a) * np.asarray(ref_frame, np.float32) + a * mean
+
+    def _template_tail(self, outs: list[dict], window: int):
+        """(corrected, warp_ok) arrays covering the last `window` frames
+        recorded in `outs` (host or device arrays; converted by the
+        blender)."""
+        tail_c, tail_ok, have = [], [], 0
+        for host in reversed(outs):
+            c = host.get("corrected")
+            if c is None:
+                continue
+            k = host.get("warp_ok")
+            k = np.ones(len(c), bool) if k is None else np.asarray(k, bool)
+            take = min(len(c), window - have)
+            tail_c.append(np.asarray(c[len(c) - take :], np.float32))
+            tail_ok.append(k[len(k) - take :])
+            have += take
+            if have >= window:
+                break
+        return list(reversed(tail_c)), list(reversed(tail_ok))
+
     def correct(
         self,
         stack: np.ndarray,
@@ -644,10 +724,16 @@ class MotionCorrector:
             else self._resolve_output_dtype(output_dtype, stack.dtype)
         )
         # Integer targets cast on device before the device->host copy
-        # (half the tunnel bytes for uint16 stacks).
+        # (half the tunnel bytes for uint16 stacks). Rolling-template
+        # runs keep frames float32 end to end instead (host-cast after
+        # the merge) so the template blends UNROUNDED pixels — the
+        # recovered transforms must not depend on the output pixel
+        # format.
         cast = (
             out_dt
-            if out_dt is not None and np.issubdtype(out_dt, np.integer)
+            if out_dt is not None
+            and np.issubdtype(out_dt, np.integer)
+            and self.template_update_every <= 0
             else None
         )
 
@@ -658,18 +744,29 @@ class MotionCorrector:
                 self._rescue_flagged(host, batch, n, ref)
             outs.append(host)
 
-        def batches():
-            for lo in range(start_frame, T, B):
-                hi = min(lo + B, T)
+        def batches(slo, shi):
+            for lo in range(slo, shi, B):
+                hi = min(lo + B, shi)
                 yield self._pad_batch(stack[lo:hi], np.arange(lo, hi), B, xp=xp)
                 if progress:
                     print(f"[kcmc] frames {hi}/{T}", flush=True)
 
+        segs = self._segment_bounds(start_frame, T)
         with timer.stage("register_batches"):
-            self._dispatch_batches(
-                batches(), ref, drain, to_host=not device_outputs,
-                keep_frames=do_rescue, cast_dtype=cast,
-            )
+            for si, (slo, shi) in enumerate(segs):
+                self._dispatch_batches(
+                    batches(slo, shi), ref, drain,
+                    to_host=not device_outputs,
+                    keep_frames=do_rescue, cast_dtype=cast,
+                    reset_telemetry=si == 0,
+                )
+                if si < len(segs) - 1:  # rolling template update
+                    W = min(self.template_window, shi - slo)
+                    tail_c, tail_ok = self._template_tail(outs, W)
+                    ref_frame = self._rolled_template(
+                        ref_frame, tail_c, tail_ok, W
+                    )
+                    ref = self.backend.prepare_reference(ref_frame)
 
         if device_outputs:
             import jax.numpy as jnp
@@ -703,6 +800,22 @@ class MotionCorrector:
             return np.dtype(input_dtype)
         return np.dtype(output_dtype)
 
+    def _segment_bounds(self, start: int, stop: int) -> list[tuple[int, int]]:
+        """Frame ranges between rolling-template update boundaries.
+
+        Boundaries sit at ABSOLUTE multiples of `template_update_every`
+        (not offsets from `start`), so chunked/resumed runs update the
+        template at the same frame indices as a one-shot run."""
+        E = self.template_update_every
+        if E <= 0:
+            return [(start, stop)]
+        bounds, lo = [], start
+        while lo < stop:
+            hi = min(stop, (lo // E + 1) * E)
+            bounds.append((lo, hi))
+            lo = hi
+        return bounds
+
     @staticmethod
     def _pad_batch(batch, idx, B, xp=np):
         """Pad a tail batch to the compiled batch size; returns
@@ -718,7 +831,7 @@ class MotionCorrector:
     def _dispatch_batches(
         self, batches, ref, drain, depth: int = 3, to_host=True,
         keep_frames=False, cast_dtype=None, allow_escalation=True,
-        emit_frames=True,
+        emit_frames=True, reset_telemetry=True,
     ):
         """Pipelined dispatch: keep `depth` batches in flight so the
         host->device upload of batch i+1, the compute of batch i, and
@@ -751,12 +864,17 @@ class MotionCorrector:
         plugin backends — including out-of-tree ones written against the
         original float32 seam — receive float32 batches as before.
         """
-        self._rescue_seen = 0
-        self._rescue_count = 0
-        self._rescue_window = []
-        self._escalated = False
-        self._escalation_allowed = allow_escalation
-        self._rescue_warned = False
+        if reset_telemetry:
+            # reset_telemetry=False: a segmented run (rolling template
+            # updates) keeps the out-of-bound counters — and any
+            # escalation decision — across its segment calls, matching
+            # a single-dispatch run's policy behavior.
+            self._rescue_seen = 0
+            self._rescue_count = 0
+            self._rescue_window = []
+            self._escalated = False
+            self._escalation_allowed = allow_escalation
+            self._rescue_warned = False
         inflight: list[tuple[int, dict, Any]] = []
         accepts_cast: dict = {}  # per-backend kwarg support, inspected once
         native_ok: dict[int, bool] = {}
@@ -979,10 +1097,16 @@ class MotionCorrector:
         dominant data movement), constant small host memory. The
         natural pass 1 of a stabilization or multi-channel workflow
         (follow with `apply_correction_file`). Incompatible with
-        `output=`.
+        `output=`. Composes with rolling template updates: only each
+        segment's last `template_window` corrected frames transfer to
+        host (the update's averaging window); the rest stay
+        registration-only.
 
         `checkpoint`: path to a resume checkpoint (.npz). Every
-        `checkpoint_every` processed frames (rounded to batches), the
+        `checkpoint_every` processed frames (rounded to batches; with
+        rolling template updates, saves additionally wait for the next
+        window-safe cursor — at worst one `template_update_every`
+        period between saves), the
         recovered transforms/diagnostics AND the output TIFF's exact
         append cursor are persisted atomically; a killed run re-invoked
         with the same arguments resumes after the last checkpointed
@@ -1076,6 +1200,8 @@ class MotionCorrector:
                     "reference": _fingerprint(self.reference),
                     "reference_window": self.reference_window,
                     "template_iters": self.template_iters,
+                    "template_update_every": self.template_update_every,
+                    "template_update_alpha": self.template_update_alpha,
                     "template_window": self.template_window,
                     "output_dtype": str(out_dt),
                     "compression": compression,
@@ -1091,6 +1217,12 @@ class MotionCorrector:
                         start = int(meta["done"])
                         outs = segments
                         n_parts = int(meta.get("n_parts", 0))
+                        tmpl = meta.get("arrays", {}).get("template")
+                        if tmpl is not None:
+                            # rolling-template runs: resume with the
+                            # template as it stood at the saved boundary
+                            ref_frame = np.asarray(tmpl, np.float32)
+                            ref = self.backend.prepare_reference(ref_frame)
                     except OSError:
                         # output file vanished/shorter than the cursor:
                         # restart from scratch
@@ -1125,27 +1257,65 @@ class MotionCorrector:
                     },
                     outs[cursor["seg_saved"] :],
                     cursor["part"],
+                    arrays=(
+                        {"template": np.asarray(ref_frame, np.float32)}
+                        if self.template_update_every > 0
+                        else None
+                    ),
                 )
                 if len(outs) > cursor["seg_saved"]:
                     cursor["part"] += 1
                 cursor["seg_saved"] = len(outs)
                 cursor["saved"] = cursor["done"]
 
+            roll = self.template_update_every > 0
+            tail: list[dict] = []  # last-window (corrected, warp_ok) pairs
+
+            E = self.template_update_every
+            W_roll = min(self.template_window, E) if roll else 0
+
             def drain(entry):
                 n, out, batch = entry
                 host = {k: np.asarray(v)[:n] for k, v in out.items()}
-                if cfg.rescue_warp and emit_frames:
+                tail_src = host
+                if cfg.rescue_warp and batch is not None and emit_frames:
                     self._rescue_flagged(host, batch, n, ref)
-                elif "template_corr" in host and "warp_ok" in host:
-                    # Registration-only: out-of-bound frames were never
-                    # rescue-rewarped, so their on-device template_corr
-                    # was measured against a bounded-kernel-ZEROED frame
-                    # — garbage. NaN beats a silently-wrong QC value
-                    # (with -o the rescue path reports the real one).
-                    host["template_corr"] = np.where(
-                        host["warp_ok"], host["template_corr"], np.nan
-                    )
+                else:
+                    if cfg.rescue_warp and batch is not None:
+                        # Averaging-window span of a REGISTRATION-ONLY
+                        # rolling run: the template must blend
+                        # exact-warped pixels, but the run's host
+                        # diagnostics must stay uniform with its
+                        # frame-free spans (no warp_rescued key, NaN
+                        # QC) — rescue a scratch copy for the tail
+                        # only. (_rescue_flagged replaces, never
+                        # mutates, the arrays it fixes.)
+                        tail_src = dict(host)
+                        self._rescue_flagged(tail_src, batch, n, ref)
+                    if "template_corr" in host and "warp_ok" in host:
+                        # Out-of-bound frames were never rescue-
+                        # rewarped here, so their on-device
+                        # template_corr was measured against a bounded-
+                        # kernel-ZEROED frame — garbage. NaN beats a
+                        # silently-wrong QC value (with -o the rescue
+                        # path reports the real one).
+                        host["template_corr"] = np.where(
+                            host["warp_ok"], host["template_corr"], np.nan
+                        )
                 corrected = host.pop("corrected", None)
+                if roll and corrected is not None:
+                    # rolling-template window: PRE-cast float32 pixels
+                    # (post-rescue), trimmed at batch granularity —
+                    # _rolled_template slices frame-exactly.
+                    tail.append({
+                        "corrected": tail_src.get("corrected", corrected),
+                        "warp_ok": tail_src.get(
+                            "warp_ok", np.ones(len(corrected), bool)
+                        ),
+                    })
+                    have = sum(len(t["corrected"]) for t in tail)
+                    while have - len(tail[0]["corrected"]) >= W_roll:
+                        have -= len(tail.pop(0)["corrected"])
                 if corrected is not None:
                     corrected = _cast_output(corrected, out_dt)
                 if writer is not None and corrected is not None:
@@ -1153,19 +1323,29 @@ class MotionCorrector:
                     # through the native encoder when available,
                     # honoring the caller's IO thread budget
                     writer.append_batch(corrected, n_threads=n_threads)
-                elif corrected is not None:
+                elif corrected is not None and emit_frames:
                     host["corrected"] = corrected
+                # else: window-only frames (registration-only rolling
+                # runs) fed the tail buffer above and are dropped
                 outs.append(host)
                 cursor["done"] += n
+                # Rolling runs may save mid-segment only OUTSIDE the
+                # next boundary's averaging window — a resume landing
+                # inside the window could not rebuild the frames
+                # already written before the kill — and never AT the
+                # boundary itself (the segment loop saves there, after
+                # the template update; a drain-side save would pair the
+                # boundary cursor with the pre-update template).
+                # Boundary saves cover the rest.
+                safe = not roll or 0 < cursor["done"] % E <= E - W_roll
                 if (
-                    checkpoint is not None
+                    safe
+                    and checkpoint is not None
                     and cursor["done"] - cursor["saved"] >= checkpoint_every
                 ):
                     save_ckpt()
 
-            loader = ChunkedStackLoader(ts, chunk_size=chunk, start=start)
-
-            def batches():
+            def batches(loader):
                 chunks = iter(loader)
                 try:
                     for lo, hi, frames in chunks:
@@ -1182,25 +1362,78 @@ class MotionCorrector:
                 finally:
                     chunks.close()  # stop + join the prefetch thread
 
-            batch_gen = batches()
-            cast = out_dt if np.issubdtype(out_dt, np.integer) else None
+            # Integer device-side cast halves D2H bytes — except on
+            # rolling runs, whose template must blend UNROUNDED f32
+            # pixels (transforms must not depend on the output format);
+            # they host-cast in drain instead.
+            cast = (
+                out_dt
+                if np.issubdtype(out_dt, np.integer) and not roll
+                else None
+            )
             watchdog = (
                 _StallWatchdog(stall_abort, lambda: cursor["done"], len(ts))
                 if stall_abort
                 else None
             )
+            seg_bounds = self._segment_bounds(start, len(ts))
+            batch_gen = None
+            first_span = True
             try:
                 with timer.stage("register_batches"):
-                    self._dispatch_batches(
-                        batch_gen, ref, drain,
-                        keep_frames=cfg.rescue_warp and emit_frames,
-                        cast_dtype=cast, emit_frames=emit_frames,
-                        # checkpointed runs stay on one warp kernel so a
-                        # resume is byte-identical to an uninterrupted
-                        # run (escalation's kernel switch is visible at
-                        # the interpolation level for in-bound frames)
-                        allow_escalation=checkpoint is None,
-                    )
+                    for si, (slo, shi) in enumerate(seg_bounds):
+                        last_seg = si == len(seg_bounds) - 1
+                        # Registration-only rolling runs transfer ONLY
+                        # each segment's averaging window to the host:
+                        # the leading span stays frame-free, the
+                        # trailing `template_window` frames feed the
+                        # update. The final segment has no update.
+                        if roll and not emit_frames and not last_seg:
+                            W = min(self.template_window, shi - slo)
+                            spans = (
+                                [(slo, shi - W, False), (shi - W, shi, True)]
+                                if shi - W > slo
+                                else [(slo, shi, True)]
+                            )
+                        else:
+                            spans = [(slo, shi, emit_frames)]
+                        for lo2, hi2, emit2 in spans:
+                            loader = ChunkedStackLoader(
+                                ts, chunk_size=chunk, start=lo2, stop=hi2
+                            )
+                            batch_gen = batches(loader)
+                            try:
+                                self._dispatch_batches(
+                                    batch_gen, ref, drain,
+                                    keep_frames=cfg.rescue_warp and emit2,
+                                    cast_dtype=cast, emit_frames=emit2,
+                                    # checkpointed runs stay on one warp
+                                    # kernel so a resume is byte-
+                                    # identical to an uninterrupted run
+                                    # (escalation's kernel switch is
+                                    # visible at the interpolation
+                                    # level for in-bound frames)
+                                    allow_escalation=checkpoint is None,
+                                    reset_telemetry=first_span,
+                                )
+                            finally:
+                                batch_gen.close()
+                                batch_gen = None
+                            first_span = False
+                        if roll and not last_seg:
+                            # rolling template update at the boundary,
+                            # then checkpoint (resume restores exactly
+                            # this template at exactly this frame)
+                            ref_frame = self._rolled_template(
+                                ref_frame,
+                                [t["corrected"] for t in tail],
+                                [t["warp_ok"] for t in tail],
+                                min(self.template_window, shi - slo),
+                            )
+                            tail.clear()
+                            ref = self.backend.prepare_reference(ref_frame)
+                            if checkpoint is not None:
+                                save_ckpt()
                 if checkpoint is not None and cursor["done"] > cursor["saved"]:
                     save_ckpt()
             finally:
@@ -1210,7 +1443,8 @@ class MotionCorrector:
                 # context closes the native handle it reads through
                 # (closing the generator triggers the loader iterator's
                 # stop/join cleanup even when an exception unwinds).
-                batch_gen.close()
+                if batch_gen is not None:
+                    batch_gen.close()
                 if writer is not None:
                     writer.close()
 

@@ -48,7 +48,8 @@ def _split_segments(arrays: dict) -> list[dict]:
 
 
 def save_stream_checkpoint(
-    path: str, meta: dict, new_segments: list[dict], part_index: int
+    path: str, meta: dict, new_segments: list[dict], part_index: int,
+    arrays: dict | None = None,
 ) -> None:
     """Persist one streaming-resume checkpoint increment.
 
@@ -60,13 +61,16 @@ def save_stream_checkpoint(
     A crash between the two writes leaves the old meta pointing at the
     old part count; the orphan part is simply overwritten next time.
     Used by MotionCorrector.correct_file.
+
+    `arrays`: extra ndarrays stored alongside the meta record (e.g. the
+    evolving rolling template); returned under meta["arrays"] on load.
     """
     if new_segments:
         _atomic_savez(
             _part_path(path, part_index), **_segment_arrays(new_segments)
         )
         meta = dict(meta, n_parts=part_index + 1)
-    _atomic_savez(path, meta=json.dumps(meta))
+    _atomic_savez(path, meta=json.dumps(meta), **(arrays or {}))
 
 
 def _part_path(path: str, i: int) -> str:
@@ -81,6 +85,9 @@ def load_stream_checkpoint(path: str):
     try:
         with np.load(path, allow_pickle=False) as z:
             meta = json.loads(str(z["meta"]))
+            extra = {k: z[k] for k in z.files if k != "meta"}
+        if extra:
+            meta["arrays"] = extra
         segments: list[dict] = []
         for p in range(int(meta.get("n_parts", 0))):
             with np.load(_part_path(path, p), allow_pickle=False) as z:

@@ -1,0 +1,213 @@
+"""Rolling template updates (template_update_every): long recordings
+whose scene slowly changes.
+
+Contract under test: the template tracks the scene (registration keeps
+working where a frozen frame-0 template loses its matches), updates
+happen at ABSOLUTE frame boundaries (results independent of batch size
+and of the memory vs streaming path), and checkpoint resume restores
+the evolving template for byte-identical streaming output.
+"""
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.io import ChunkedStackLoader
+from kcmc_tpu.io.tiff import write_stack
+from kcmc_tpu.utils import synthetic
+from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+SHAPE = (128, 128)
+T = 48
+
+
+def _morphing_stack(seed=3):
+    """Scene A cross-fades to a completely different scene B while the
+    whole stack drifts — frame-0 keypoints no longer exist by the end."""
+    rng = np.random.default_rng(seed)
+    a = synthetic.render_scene(rng, SHAPE, n_blobs=120)
+    b = synthetic.render_scene(rng, SHAPE, n_blobs=120)
+    drift = np.cumsum(rng.uniform(-1.2, 1.2, size=(T, 2)), axis=0)
+    mats = np.tile(np.eye(3, dtype=np.float32), (T, 1, 1))
+    mats[:, :2, 2] = drift
+    frames = []
+    for t in range(T):
+        w = t / (T - 1)
+        frames.append(synthetic._warp_scene((1 - w) * a + w * b, mats[t]))
+    return np.stack(frames).astype(np.float32), mats
+
+
+@pytest.fixture(scope="module")
+def morphing():
+    return _morphing_stack()
+
+
+def _rmse(transforms, mats):
+    return transform_rmse(transforms, relative_transforms(mats), SHAPE)
+
+
+def test_rolling_template_tracks_scene_change(morphing):
+    stack, mats = morphing
+    static = MotionCorrector(
+        model="translation", backend="jax", batch_size=8
+    ).correct(stack)
+    rolling = MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        template_update_every=8, template_window=8,
+        template_update_alpha=0.7,
+    ).correct(stack)
+    # By the cross-fade's end the frozen template has lost its scene;
+    # the rolling template still matches it.
+    tail = np.s_[T - 8 :]
+    static_tail = np.asarray(static.diagnostics["n_inliers"][tail])
+    rolling_tail = np.asarray(rolling.diagnostics["n_inliers"][tail])
+    assert rolling_tail.min() > 2 * max(static_tail.min(), 1)
+    assert _rmse(rolling.transforms, mats) < 0.25
+    assert _rmse(rolling.transforms, mats) < 0.5 * _rmse(
+        static.transforms, mats
+    )
+
+
+def test_update_boundaries_are_batch_size_invariant(morphing):
+    stack, mats = morphing
+    mk = lambda B: MotionCorrector(
+        model="translation", backend="jax", batch_size=B,
+        template_update_every=8, template_window=8,
+    ).correct(stack)
+    np.testing.assert_allclose(
+        mk(4).transforms, mk(8).transforms, atol=1e-5
+    )
+
+
+def test_correct_file_matches_in_memory(morphing, tmp_path):
+    stack, mats = morphing
+    path = tmp_path / "morph.tif"
+    write_stack(path, stack)
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        template_update_every=8, template_window=8,
+    )
+    mem = mk().correct(stack)
+    stream = mk().correct_file(path, chunk_size=16)
+    np.testing.assert_allclose(stream.transforms, mem.transforms, atol=1e-5)
+
+
+def test_window_not_batch_aligned_paths_agree(morphing, tmp_path):
+    """template_window smaller than (and unaligned with) the batch:
+    the streaming tail buffer trims at batch granularity but the blend
+    must slice frame-exactly, or memory/streaming templates diverge."""
+    stack, _ = morphing
+    path = tmp_path / "morph.tif"
+    write_stack(path, stack)
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        template_update_every=8, template_window=6,
+    )
+    mem = mk().correct(stack)
+    stream = mk().correct_file(path, chunk_size=16)
+    np.testing.assert_allclose(stream.transforms, mem.transforms, atol=1e-5)
+
+
+def test_transforms_independent_of_output_dtype(morphing, tmp_path):
+    """The rolling template must blend unrounded float32 pixels: a
+    uint16 output format must not perturb the recovered transforms."""
+    stack, _ = morphing
+    u16 = np.clip(stack * 40000, 0, 65535).astype(np.uint16)
+    path = tmp_path / "morph16.tif"
+    write_stack(path, u16)
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        template_update_every=8, template_window=8,
+    )
+    as_u16 = mk().correct_file(
+        path, output=str(tmp_path / "o16.tif"), output_dtype="input"
+    )
+    as_f32 = mk().correct_file(
+        path, output=str(tmp_path / "of.tif"), output_dtype="float32"
+    )
+    np.testing.assert_allclose(
+        as_u16.transforms, as_f32.transforms, atol=1e-6
+    )
+    mem = mk().correct(u16)  # default float32 output
+    np.testing.assert_allclose(mem.transforms, as_f32.transforms, atol=1e-5)
+
+
+def test_registration_only_composes_with_rolling_updates(morphing, tmp_path):
+    """emit_frames=False + rolling updates: identical transforms to the
+    frame-emitting run (only the averaging windows transfer), with no
+    corrected frames returned."""
+    stack, _ = morphing
+    path = tmp_path / "m.tif"
+    write_stack(path, stack)
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        template_update_every=8, template_window=8,
+    )
+    full = mk().correct_file(path, chunk_size=16)
+    reg = mk().correct_file(path, chunk_size=16, emit_frames=False)
+    assert reg.corrected.shape[0] == 0
+    np.testing.assert_allclose(reg.transforms, full.transforms, atol=1e-5)
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="template_update_every"):
+        MotionCorrector(template_update_every=-1)
+    with pytest.raises(ValueError, match="template_update_alpha"):
+        MotionCorrector(template_update_alpha=0.0)
+
+
+class _PoisonAfter:
+    def __init__(self, allow):
+        self.allow = allow
+        self.calls = 0
+
+    def __call__(self, orig, loader, lo, hi):
+        self.calls += 1
+        if self.calls > self.allow:
+            raise RuntimeError("simulated kill")
+        return orig(loader, lo, hi)
+
+
+def test_rolling_resume_byte_identical(morphing, tmp_path, monkeypatch):
+    """Kill mid-run + resume with rolling updates on: the checkpoint
+    restores the evolving template, and the resumed output TIFF is
+    byte-identical to an uninterrupted run's."""
+    from kcmc_tpu.utils.checkpoint import load_stream_checkpoint
+
+    stack, _ = morphing
+    u16 = np.clip(stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, u16)
+    orig = ChunkedStackLoader._read
+
+    def run(output, checkpoint=None, poison=None):
+        mc = MotionCorrector(
+            model="translation", backend="jax", batch_size=4,
+            template_update_every=8, template_window=8,
+        )
+        if poison is not None:
+            monkeypatch.setattr(
+                ChunkedStackLoader, "_read",
+                lambda self, lo, hi: poison(orig, self, lo, hi),
+            )
+        else:
+            monkeypatch.setattr(ChunkedStackLoader, "_read", orig)
+        return mc.correct_file(
+            str(src), output=str(output), chunk_size=8,
+            checkpoint=checkpoint and str(checkpoint),
+        )
+
+    ref = run(tmp_path / "ref.tif")
+
+    ckpt = tmp_path / "run.ckpt.npz"
+    out = tmp_path / "out.tif"
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        run(out, checkpoint=ckpt, poison=_PoisonAfter(2))
+    meta, _ = load_stream_checkpoint(str(ckpt))
+    assert 0 < meta["done"] < T
+    assert meta["done"] % 8 == 0  # saves snap to update boundaries
+    assert meta["arrays"]["template"].shape == SHAPE
+
+    res = run(out, checkpoint=ckpt)
+    assert (tmp_path / "ref.tif").read_bytes() == out.read_bytes()
+    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
