@@ -149,6 +149,26 @@ def test_registration_only_composes_with_rolling_updates(morphing, tmp_path):
     np.testing.assert_allclose(reg.transforms, full.transforms, atol=1e-5)
 
 
+def test_sharded_rolling_matches_single_device(morphing):
+    """Rolling updates re-prepare the reference mid-run; the sharded
+    path must re-shard it and keep reproducing single-device results."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from kcmc_tpu.parallel import make_mesh
+
+    stack, mats = morphing
+    mk = lambda mesh: MotionCorrector(
+        model="translation", backend="jax", batch_size=8, mesh=mesh,
+        template_update_every=8, template_window=8,
+    )
+    r1 = mk(None).correct(stack)
+    r8 = mk(make_mesh(8)).correct(stack)
+    np.testing.assert_allclose(r8.transforms, r1.transforms, atol=1e-4)
+    assert _rmse(r8.transforms, mats) < 0.25
+
+
 def test_constructor_validation():
     with pytest.raises(ValueError, match="template_update_every"):
         MotionCorrector(template_update_every=-1)
