@@ -42,10 +42,10 @@ def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only
             from kcmc_tpu import corrector
 
             return getattr(corrector, name)
-        if name == "smooth_trajectory":
-            from kcmc_tpu.utils.trajectory import smooth_trajectory
+        if name in ("smooth_trajectory", "interpolate_failed"):
+            from kcmc_tpu.utils import trajectory
 
-            return smooth_trajectory
+            return getattr(trajectory, name)
         if name in ("available_backends", "get_backend", "register_backend"):
             import kcmc_tpu.backends as _b
 

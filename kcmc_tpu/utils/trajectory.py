@@ -116,3 +116,57 @@ def smooth_trajectory(
     # valid warp of the same kind.
     stab = np.einsum("tij,tjk->tik", M.astype(np.float64), np.linalg.inv(sm))
     return stab.astype(np.float32)
+
+
+def interpolate_failed(
+    transforms: np.ndarray, good: np.ndarray
+) -> np.ndarray:
+    """Replace failed frames' transforms by interpolating their
+    neighbors' motion.
+
+    A frame whose registration failed — stimulation artifact, shutter
+    blank, a dropped camera frame — comes back with a meaningless
+    transform (a blank frame consensus-defaults to identity, which
+    mid-drift re-introduces the full motion into that one frame). Real
+    motion is continuous, so the standard repair interpolates the
+    trajectory across the gap:
+
+        good = res.diagnostics["n_inliers"] >= 20
+        fixed = interpolate_failed(res.transforms, good)
+        corrected = apply_correction(stack, fixed)   # re-warp
+
+    `transforms`: (T, 3, 3) or (T, 4, 4); `good`: (T,) boolean mask of
+    trustworthy frames. Failed runs interior to the sequence are
+    linearly interpolated entry-wise between the flanking good frames
+    (exact for translation; the standard small-motion approximation for
+    the rotational/projective entries, with homographies renormalized);
+    failed runs at the ends copy the nearest good transform. Raises if
+    no frame is good. Good frames pass through bit-unchanged.
+    """
+    M = np.asarray(transforms)
+    good = np.asarray(good, bool)
+    d = M.shape[-1]
+    if M.ndim != 3 or M.shape[-2] != d or d not in (3, 4):
+        raise ValueError(
+            f"transforms must be (T, 3, 3) or (T, 4, 4), got {M.shape}"
+        )
+    if good.shape != (len(M),):
+        raise ValueError(
+            f"good mask must be ({len(M)},), got {good.shape}"
+        )
+    if good.all():
+        return M.copy()
+    if not good.any():
+        raise ValueError("no good frames to interpolate from")
+    t = np.arange(len(M), dtype=np.float64)
+    tg = t[good]
+    flat = M.reshape(len(M), -1).astype(np.float64)
+    out = flat.copy()
+    for j in range(flat.shape[1]):
+        # np.interp clamps beyond the first/last good frame = nearest
+        # extrapolation at the ends.
+        out[~good, j] = np.interp(t[~good], tg, flat[good, j])
+    out = out.reshape(M.shape)
+    out = out / out[:, -1:, -1:]  # homography renorm; affine rows exact
+    out[good] = M[good]  # good frames bit-unchanged
+    return out.astype(M.dtype, copy=False)

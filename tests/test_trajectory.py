@@ -136,6 +136,77 @@ def test_single_frame_and_validation():
         smooth_trajectory(np.zeros((4, 2, 3)))
 
 
+def test_interpolate_failed_linear_gap():
+    from kcmc_tpu import interpolate_failed
+
+    T = 10
+    Ms = np.stack([_translation(2.0 * t, -t) for t in range(T)])
+    good = np.ones(T, bool)
+    good[[3, 4, 7]] = False
+    garbage = Ms.copy()
+    garbage[[3, 4, 7]] = np.eye(3)  # what a blank frame really returns
+    fixed = interpolate_failed(garbage, good)
+    # Linear drift: interpolation recovers the exact transforms.
+    np.testing.assert_allclose(fixed, Ms, atol=1e-9)
+    # Good frames pass through bit-unchanged.
+    np.testing.assert_array_equal(fixed[good], garbage[good])
+
+
+def test_interpolate_failed_end_runs_copy_nearest():
+    from kcmc_tpu import interpolate_failed
+
+    Ms = np.stack([_translation(t, 0.0) for t in range(6)])
+    good = np.array([False, False, True, True, True, False])
+    bad = Ms.copy()
+    bad[[0, 1, 5]] = np.eye(3)
+    fixed = interpolate_failed(bad, good)
+    np.testing.assert_allclose(fixed[0], Ms[2])
+    np.testing.assert_allclose(fixed[1], Ms[2])
+    np.testing.assert_allclose(fixed[5], Ms[4])
+
+
+def test_interpolate_failed_validation():
+    from kcmc_tpu import interpolate_failed
+
+    Ms = np.tile(np.eye(3), (4, 1, 1))
+    with pytest.raises(ValueError, match="no good frames"):
+        interpolate_failed(Ms, np.zeros(4, bool))
+    with pytest.raises(ValueError, match="good mask"):
+        interpolate_failed(Ms, np.ones(3, bool))
+    np.testing.assert_array_equal(
+        interpolate_failed(Ms, np.ones(4, bool)), Ms
+    )
+
+
+def test_interpolate_failed_pipeline_recipe():
+    """The documented repair: a blank (artifact) frame mid-drift gets
+    its motion back from the neighbors instead of identity."""
+    from kcmc_tpu import MotionCorrector, interpolate_failed
+    from kcmc_tpu.utils import synthetic
+    from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+    data = synthetic.make_drift_stack(
+        n_frames=10, shape=(96, 96), model="translation", max_drift=6.0,
+        seed=9,
+    )
+    stack = np.array(data.stack)
+    stack[5] = 0.0  # shutter blank
+    res = MotionCorrector(
+        model="translation", backend="jax", batch_size=5
+    ).correct(stack)
+    good = np.asarray(res.diagnostics["n_inliers"]) >= 10
+    assert not good[5] and good.sum() == 9
+    fixed = interpolate_failed(res.transforms, good)
+    gt = relative_transforms(data.transforms)
+    # The blank frame's repaired transform lands near the true motion
+    # (identity would be ~ the full accumulated drift off).
+    err_fixed = np.abs(fixed[5, :2, 2] - gt[5, :2, 2]).max()
+    err_identity = np.abs(res.transforms[5, :2, 2] - gt[5, :2, 2]).max()
+    assert err_fixed < 2.0 and err_fixed < 0.5 * err_identity
+    rmse = transform_rmse(fixed, gt, (96, 96))
+    assert rmse < 1.0
+
+
 def test_apply_correction_integration():
     """Stabilizers feed apply_correction like any other transforms."""
     from kcmc_tpu import apply_correction
