@@ -38,6 +38,18 @@ from __future__ import annotations
 import numpy as np
 
 
+def _check_transforms(transforms) -> np.ndarray:
+    """Validate a (T, 3, 3) / (T, 4, 4) trajectory (shared by every
+    public entry point here)."""
+    M = np.asarray(transforms)
+    d = M.shape[-1] if M.ndim == 3 else 0
+    if M.ndim != 3 or M.shape[-2] != d or d not in (3, 4):
+        raise ValueError(
+            f"transforms must be (T, 3, 3) or (T, 4, 4), got {M.shape}"
+        )
+    return M
+
+
 def _gaussian_taps(sigma: float) -> np.ndarray:
     r = max(1, int(3.0 * sigma + 0.5))
     x = np.arange(-r, r + 1, dtype=np.float64)
@@ -102,12 +114,7 @@ def smooth_trajectory(
         sm = _smooth_along_t(fields, sigma)
         return (fields - sm).astype(np.float32)
 
-    M = np.asarray(transforms)
-    d = M.shape[-1]
-    if M.ndim != 3 or M.shape[-2] != d or d not in (3, 4):
-        raise ValueError(
-            f"transforms must be (T, 3, 3) or (T, 4, 4), got {M.shape}"
-        )
+    M = _check_transforms(transforms)
     sm = _smooth_along_t(M, sigma)
     # Projective entries drift off unit scale under averaging; renorm.
     sm = sm / sm[:, -1:, -1:]
@@ -143,13 +150,8 @@ def interpolate_failed(
     failed runs at the ends copy the nearest good transform. Raises if
     no frame is good. Good frames pass through bit-unchanged.
     """
-    M = np.asarray(transforms)
+    M = _check_transforms(transforms)
     good = np.asarray(good, bool)
-    d = M.shape[-1]
-    if M.ndim != 3 or M.shape[-2] != d or d not in (3, 4):
-        raise ValueError(
-            f"transforms must be (T, 3, 3) or (T, 4, 4), got {M.shape}"
-        )
     if good.shape != (len(M),):
         raise ValueError(
             f"good mask must be ({len(M)},), got {good.shape}"
