@@ -157,6 +157,24 @@ def test_cli_register_then_apply(tmp_path):
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+def test_cli_stabilize_piecewise_fields(tmp_path):
+    """The fields branch of stabilize: piecewise registration feeds the
+    temporal high-pass + streaming field apply."""
+    from kcmc_tpu.utils.synthetic import make_piecewise_stack
+
+    data = make_piecewise_stack(n_frames=8, shape=(96, 96), seed=2)
+    path = tmp_path / "pw.tif"
+    write_stack(path, data.stack)
+    opath = tmp_path / "stab.tif"
+    out = _run_cli([
+        "stabilize", str(path), "-o", str(opath), "--sigma", "2",
+        "--model", "piecewise", "--batch-size", "4",
+    ])
+    assert out.returncode == 0, out.stderr
+    got = read_stack(opath)
+    assert got.shape == data.stack.shape and np.isfinite(got).all()
+
+
 def test_cli_apply_rejects_wrong_npz(tmp_path):
     data, path = _make_input(tmp_path)
     bad = tmp_path / "bad.npz"
