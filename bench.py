@@ -130,9 +130,9 @@ def run_bench_device(
     done = (n_frames // batch) * batch
     checks, sweeps = [], []
     # Clock/tunnel noise makes single runs swing +-25%; the judged value
-    # is the best of three timed sweeps (each is a full dispatch train
+    # is the MEDIAN of three timed sweeps (each is a full dispatch train
     # with a forced completion barrier, so every sweep is real sustained
-    # work) — but ALL three sweep rates are recorded in the result so
+    # work) — and ALL three sweep rates are recorded in the result so
     # round-over-round drift is attributable to noise vs regression.
     for rep in range(3):
         last = None
@@ -161,7 +161,9 @@ def run_bench_device(
         got if key == "field" else None,
     )
     return {
-        "fps": max(sweeps),
+        # Headline = MEDIAN sweep (sturdier than max against one lucky
+        # sweep); all sweep rates still land in sweeps_fps for audit.
+        "fps": float(np.median(sweeps)),
         "seconds": dt,
         "rmse_px": rmse,
         "n_frames": done,

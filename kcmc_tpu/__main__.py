@@ -138,8 +138,15 @@ def _cmd_correct(args) -> int:
         # nan-aware: registration-only runs NaN out frames whose QC
         # would have been measured against an unrescued zeroed warp
         corr = res.diagnostics["template_corr"]
-        summary["template_corr_mean"] = round(float(np.nanmean(corr)), 4)
-        summary["template_corr_min"] = round(float(np.nanmin(corr)), 4)
+        if np.isnan(corr).all():
+            # registration-only run where every frame was out of warp
+            # bounds: nanmean/nanmin would warn and json.dumps would
+            # emit a bare NaN token (non-standard JSON) — emit null
+            summary["template_corr_mean"] = None
+            summary["template_corr_min"] = None
+        else:
+            summary["template_corr_mean"] = round(float(np.nanmean(corr)), 4)
+            summary["template_corr_min"] = round(float(np.nanmin(corr)), 4)
     print(json.dumps(summary))
     return 0
 

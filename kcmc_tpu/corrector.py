@@ -678,7 +678,12 @@ class MotionCorrector:
         `start_frame`/`end_frame` bound the processed range while keeping
         *global* frame indices (RANSAC keys fold in the global index, so
         chunked and one-shot runs produce identical transforms) — this is
-        what utils/checkpoint.py's resume manager builds on.
+        what utils/checkpoint.py's resume manager builds on. Caveat:
+        with `template_update_every > 0` a fresh `correct(start_frame=N)`
+        call starts from the *initial* template, not the evolved one, so
+        rolling-template runs are chunk-invariant only through
+        `correct_file(checkpoint=)`, which persists the evolving
+        template across resumes.
         """
         on_device = hasattr(stack, "devices")  # jax.Array (any backend)
         if not on_device:
@@ -1432,7 +1437,20 @@ class MotionCorrector:
                             )
                             tail.clear()
                             ref = self.backend.prepare_reference(ref_frame)
-                            if checkpoint is not None:
+                            # Boundaries are always window-safe resume
+                            # points (a resume replays the full
+                            # averaging window before the next
+                            # boundary), so honor the requested cadence
+                            # instead of saving at every boundary —
+                            # with small template_update_every an
+                            # unconditional save would multiply
+                            # checkpoint IO (and part files) far beyond
+                            # checkpoint_every.
+                            if (
+                                checkpoint is not None
+                                and cursor["done"] - cursor["saved"]
+                                >= checkpoint_every
+                            ):
                                 save_ckpt()
                 if checkpoint is not None and cursor["done"] > cursor["saved"]:
                     save_ckpt()

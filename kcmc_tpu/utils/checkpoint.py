@@ -111,6 +111,20 @@ class ResumableCorrector:
     """
 
     def __init__(self, corrector, path: str, chunk_frames: int = 512):
+        if getattr(corrector, "template_update_every", 0) > 0:
+            # Each resumed chunk calls correct(start_frame=done), which
+            # starts from the INITIAL template — the evolving template
+            # is not persisted here, so the merged result would
+            # silently diverge from a one-shot run. correct_file's
+            # checkpoint path carries the template; use that instead.
+            raise ValueError(
+                "ResumableCorrector does not support rolling template "
+                "updates (template_update_every > 0): a resumed chunk "
+                "would restart from the initial template and diverge "
+                "from a one-shot run. Use "
+                "MotionCorrector.correct_file(checkpoint=...), which "
+                "persists the evolving template."
+            )
         self.corrector = corrector
         self.path = path
         self.chunk_frames = int(chunk_frames)

@@ -80,3 +80,19 @@ def test_stale_checkpoint_is_discarded(tmp_path, data):
     res = rc2.correct(data.stack)
     assert res.timing["restored_frames"] == 0
     assert res.transforms.shape == (10, 3, 3)
+
+
+def test_resume_manager_rejects_rolling_templates(tmp_path):
+    """ResumableCorrector restarts each chunk from the initial template,
+    so rolling updates would silently diverge from a one-shot run — the
+    constructor must refuse and point at correct_file(checkpoint=)."""
+    import pytest
+
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.checkpoint import ResumableCorrector
+
+    mc = MotionCorrector(
+        model="translation", backend="jax", template_update_every=8
+    )
+    with pytest.raises(ValueError, match="template_update_every"):
+        ResumableCorrector(mc, str(tmp_path / "c.npz"))

@@ -215,6 +215,9 @@ def test_rolling_resume_byte_identical(morphing, tmp_path, monkeypatch):
         return mc.correct_file(
             str(src), output=str(output), chunk_size=8,
             checkpoint=checkpoint and str(checkpoint),
+            # boundary saves honor the requested cadence (they no
+            # longer fire unconditionally at every template boundary)
+            checkpoint_every=8,
         )
 
     ref = run(tmp_path / "ref.tif")
@@ -231,3 +234,28 @@ def test_rolling_resume_byte_identical(morphing, tmp_path, monkeypatch):
     res = run(out, checkpoint=ckpt)
     assert (tmp_path / "ref.tif").read_bytes() == out.read_bytes()
     np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
+
+
+def test_boundary_saves_honor_checkpoint_every(morphing, tmp_path):
+    """With small template_update_every and a large checkpoint_every,
+    boundary saves are gated on the requested cadence instead of firing
+    at every boundary (T/E part files for one run would multiply the
+    checkpoint IO far beyond what the caller asked for)."""
+    stack, _ = morphing
+    u16 = np.clip(stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, u16)
+
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        template_update_every=8, template_window=8,
+    )
+    ckpt = tmp_path / "run.ckpt.npz"
+    mc.correct_file(
+        str(src), output=str(tmp_path / "out.tif"), chunk_size=8,
+        checkpoint=str(ckpt), checkpoint_every=1000,
+    )
+    # T=48, E=8 -> 5 interior boundaries. Old behavior: one part file
+    # per boundary plus the final save. New: only the final save.
+    parts = sorted(tmp_path.glob("run.ckpt.npz.part*.npz"))
+    assert len(parts) == 1, [p.name for p in parts]
