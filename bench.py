@@ -226,7 +226,15 @@ def main() -> None:
     ap.add_argument("--model", default="translation")
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--host-io", action="store_true")
-    ap.add_argument("--all", action="store_true")
+    ap.add_argument(
+        "--all", action="store_true",
+        help="extend the per-config rows with the extra table configs "
+        "(rigid, similarity) beyond the five contract workloads",
+    )
+    ap.add_argument(
+        "--flagship-only", action="store_true",
+        help="time only the flagship config (skip the per-config rows)",
+    )
     ap.add_argument(
         "--stages", action="store_true",
         help="also print the per-stage incremental cost breakdown (stderr)",
@@ -259,17 +267,30 @@ def main() -> None:
     )
 
     configs = None
-    if args.all:
+    # (--host-io is tunnel-bound at single-digit fps on this image —
+    # running seven configs through it would take hours for a
+    # diagnostic number, so per-config rows are device-path only.)
+    if args.host_io and args.all:
+        print(
+            "[bench] --all ignored with --host-io (per-config rows are "
+            "device-path only)",
+            file=sys.stderr,
+        )
+    if not args.flagship_only and not args.host_io:
+        # The five BASELINE.json contract workloads run in the DEFAULT
+        # invocation, so the driver-captured artifact is self-contained
+        # evidence for every judged config (a per-config regression is
+        # visible round over round, not just in a builder-run table).
         # Unified protocol: every sub-config runs the SAME sweep length
         # as the flagship run (short sub-runs read ~20% low under the
         # tunneled platform's clock ramp); a 32x256x256 rigid3d volume is
         # 8x the pixels of a 512x512 frame, so its sweep is frames//8 for
-        # equal pixel work.
-        configs = {}
-        for label, model, kw in (
-            ("rigid", "rigid", {}),
-            ("similarity", "similarity", {}),
-            ("affine", "affine", {}),
+        # equal pixel work. --all extends the rows with the extra
+        # README-table configs (rigid, similarity, plain affine).
+        # keyed by the flagship's actual model — a --model override must
+        # not mislabel its numbers as the translation contract row
+        configs = {args.model: _config_row(r)}
+        rows = [
             # Config 2 (BASELINE configs[1]): a true ~2k surviving
             # matches/frame — dense sharp scene, K=4096 keypoints,
             # finer Harris window + candidate tile (the detector's
@@ -282,9 +303,16 @@ def main() -> None:
                 "harris_window_sigma": 1.2, "cand_tile": 4,
                 "batch": 32,
             }),
-            ("homography", "homography", {}),
             ("piecewise", "piecewise", {}),
-        ):
+            ("homography", "homography", {}),
+        ]
+        if args.all:
+            rows = [
+                ("rigid", "rigid", {}),
+                ("similarity", "similarity", {}),
+                ("affine", "affine", {}),
+            ] + rows
+        for label, model, kw in rows:
             batch = kw.pop("batch", args.batch)
             rr = _run_with_retry(run, args.frames, args.size, model, batch, **kw)
             configs[label] = _config_row(rr)

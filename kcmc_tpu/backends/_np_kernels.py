@@ -208,6 +208,10 @@ def knn_match(
 ):
     """Same rules as ops/match.py; returns (idx, dist, second, valid)."""
     BIG = 1 << 16
+    # Zero descriptors are the invalid sentinel — same rule as
+    # ops/match.py's knn_match (flat patches / masked slots never match).
+    q_valid = q_valid & (q_desc != 0).any(-1)
+    r_valid = r_valid & (r_desc != 0).any(-1)
     x = q_desc[:, None, :] ^ r_desc[None, :, :]
     D = _popcount(x).sum(-1).astype(np.int64)
     mask = q_valid[:, None] & r_valid[None, :]

@@ -268,12 +268,30 @@ class JaxBackend:
             model = get_model(cfg.model)
             batch_warp = self._resolve_batch_warp(shape)
 
+        banded_geom = None
+        if cfg.match_radius is not None:
+            from kcmc_tpu.ops.match_banded import make_geometry
+
+            banded_geom = make_geometry(
+                shape, cfg.match_radius, cfg.max_keypoints,
+                cfg.max_keypoints, tile=cfg.match_tile,
+                slack=cfg.match_slack, nms_tile=cfg.cand_tile,
+            )
+
         def local(frames, ref_xy, ref_desc, ref_valid, indices):
             # Frames upload in their native dtype (uint16 stacks halve
             # the host->device bytes); all math runs in float32.
             frames = frames.astype(jnp.float32)
             if cfg.sanitize_input:
                 frames = _sanitize_nonfinite(frames)
+            if banded_geom is not None:
+                from kcmc_tpu.ops.match_banded import build_banded_ref
+
+                # Template keypoints bucketed once per batch, shared by
+                # every frame's banded match (outside the vmap below).
+                bref = build_banded_ref(
+                    banded_geom, ref_xy, ref_desc, ref_valid
+                )
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
             # smooth (the descriptor-stage blur) rides along with the
             # fused Pallas detection kernel's resident slab.
@@ -299,15 +317,29 @@ class JaxBackend:
             )
 
             def tail(frame, kp, d, key):
-                m = knn_match(
-                    d,
-                    ref_desc,
-                    kp.valid,
-                    ref_valid,
-                    ratio=cfg.ratio,
-                    max_dist=cfg.max_hamming,
-                    mutual=cfg.mutual,
-                )
+                if banded_geom is not None:
+                    from kcmc_tpu.ops.match_banded import banded_match
+
+                    m = banded_match(
+                        banded_geom,
+                        bref,
+                        d,
+                        kp.xy,
+                        kp.valid,
+                        ratio=cfg.ratio,
+                        max_dist=cfg.max_hamming,
+                        mutual=cfg.mutual,
+                    )
+                else:
+                    m = knn_match(
+                        d,
+                        ref_desc,
+                        kp.valid,
+                        ref_valid,
+                        ratio=cfg.ratio,
+                        max_dist=cfg.max_hamming,
+                        mutual=cfg.mutual,
+                    )
                 # Correspondences: reference keypoint -> frame position.
                 src = ref_xy[m.idx]
                 dst = kp.xy

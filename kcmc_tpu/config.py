@@ -40,6 +40,27 @@ class CorrectorConfig:
     ratio: float = 0.85
     max_hamming: int = 80
     mutual: bool = True
+    # Spatially-banded matching (2D models): restrict each frame
+    # keypoint's candidates to reference keypoints within this motion
+    # radius (px). Motion-correction drift is bounded, so a radius a
+    # little above the worst expected per-frame displacement recovers
+    # the same matches as the dense (K, K) Hamming matrix at a fraction
+    # of its compute and HBM — the dense matrix is what caps batch size
+    # in the high-K (~2k matches/frame) regime. None = dense matching
+    # (always correct for unbounded motion). Frames drifting beyond the
+    # radius lose their matches and fail consensus visibly (n_inliers
+    # collapses) rather than silently mis-registering.
+    match_radius: float | None = None
+    # Query tile side for the banded matcher, px. Larger tiles = better
+    # MXU utilization per matmul but a proportionally wider candidate
+    # window; 64 keeps full 128-row MXU tiles at the high-K densities
+    # where banding pays.
+    match_tile: int = 64
+    # Capacity headroom for the banded matcher's fixed-size spatial
+    # buckets, as a multiple of the mean bucket occupancy. Keypoints
+    # beyond a bucket's capacity are dropped (masked, never resized);
+    # 2.0 keeps drops rare for detector-spread keypoints.
+    match_slack: float = 2.0
 
     # -- consensus ---------------------------------------------------------
     n_hypotheses: int = 128
@@ -172,6 +193,25 @@ class CorrectorConfig:
                 "max_rotation_deg must be in (0, 45) — beyond that the "
                 "separable shear decomposition degrades; use warp='jnp' "
                 f"for extreme rotations (got {self.max_rotation_deg})"
+            )
+        if self.match_radius is not None:
+            if self.match_radius <= 0:
+                raise ValueError(
+                    f"match_radius must be positive, got {self.match_radius}"
+                )
+            if self.model == "rigid3d":
+                raise ValueError(
+                    "match_radius (banded matching) supports 2D models "
+                    "only; rigid3d uses the dense matcher"
+                )
+        if self.match_tile < 16 or self.match_tile % 4:
+            raise ValueError(
+                "match_tile must be >= 16 and a multiple of 4 (sub-"
+                f"bucket sides are tile//4 or tile//2), got {self.match_tile}"
+            )
+        if self.match_slack < 1.0:
+            raise ValueError(
+                f"match_slack must be >= 1.0, got {self.match_slack}"
             )
         if self.field_passes < 1:
             raise ValueError(

@@ -279,17 +279,63 @@ def describe_keypoints_batch(
         angles = jnp.arctan2(m01[..., 0], m10[..., 0])  # (B, K)
         bins = _quantize_bins(angles)
         flat = pb.reshape(B, K, -1)  # (B, K, L) keypoint-first
-        vals = jnp.zeros((B, K, PATTERN.shape[0] * 2), jnp.float32)
-        for b in range(N_ORIENT_BINS):
-            sel = jnp.asarray(_SEL_ROT[b])  # (L, 512)
-            mask = (bins == b).astype(jnp.float32)[..., None]
-            vals = vals + mask * _onehot_select(flat, sel)
+        vals = jax.vmap(_binned_select)(flat, bins, kps.valid)
     else:
         pb = extract_blended(padded, kps.xy, P, interpret=interpret)
         flat = pb.reshape(B, K, -1)
         vals = _onehot_select(flat, jnp.asarray(_SEL_UPRIGHT))
 
     return _finalize_descriptors(vals, kps.valid)
+
+
+def _binned_select(flat: jnp.ndarray, bins: jnp.ndarray, valid) -> jnp.ndarray:
+    """Oriented one-hot selection, dispatched by bin: (K, L) patch
+    values + (K,) orientation bins -> (K, 512) selected sample values.
+
+    The earlier formulation ran ALL N_ORIENT_BINS constant matmuls over
+    the full keypoint set and masked-accumulated — N_BINS x the matmul
+    FLOPs and N_BINS (K, 512) intermediates of HBM traffic for work
+    where each keypoint needs exactly ONE bin's matrix. Measured at
+    K=4096, batch 32 on the v5e: 70 ms/batch, 66% of the whole config-2
+    pipeline. This is the classic expert-dispatch shape: one stable
+    argsort groups keypoints by bin, each bin's segment (fixed capacity
+    2K/N_BINS + slack, rounded to 8) runs ONE (cap, L) x (L, 512)
+    matmul against its own selection matrix, results scatter back to
+    keypoint order — ~N_BINS/2 x less MXU work and HBM traffic, and
+    every selected value goes through the same hi+lo two-pass as
+    `_onehot_select`, so the result is bit-identical per element.
+
+    Keypoints beyond a bin's capacity are dropped: their descriptor
+    stays all-zero, which is the matchers' invalid sentinel (knn_match
+    and banded_match reject zero descriptors outright, so a dropped
+    keypoint can never inject a spurious low-popcount match). With
+    capacity 2x the uniform share, drops need >2x orientation
+    concentration; scenes that anisotropic lose a few of their weakest
+    keypoints (stable argsort keeps detection-score order within a
+    bin, so the strongest stay).
+    """
+    K, L = flat.shape
+    nb = N_ORIENT_BINS
+    cap = min(K, max(32, -(-2 * K // (nb * 8)) * 8))
+    b_eff = jnp.where(valid, bins, nb)  # invalid slots: sentinel bin
+    order = jnp.argsort(b_eff)  # stable: score order kept within bins
+    sb = b_eff[order]
+    arange_nb = jnp.arange(nb, dtype=sb.dtype)
+    starts = jnp.searchsorted(sb, arange_nb, side="left")
+    ends = jnp.searchsorted(sb, arange_nb, side="right")
+    slots = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    ok = slots < ends[:, None]
+    rows_idx = order[jnp.minimum(slots, K - 1)]  # (nb, cap)
+    rows = flat[rows_idx]  # (nb, cap, L)
+    # Same split-precision passes as _onehot_select, batched over bins.
+    hi = rows.astype(jnp.bfloat16).astype(jnp.float32)
+    lo = rows - hi
+    sel = jnp.asarray(_SEL_ROT)  # (nb, L, 512)
+    out = jnp.matmul(hi, sel) + jnp.matmul(lo, sel)  # (nb, cap, 512)
+    dest = jnp.where(ok, rows_idx, K).reshape(-1)
+    vals = jnp.zeros((K + 1, out.shape[-1]), jnp.float32)
+    vals = vals.at[dest].set(out.reshape(nb * cap, -1))
+    return vals[:K]
 
 
 def _onehot_select(flat: jnp.ndarray, sel: jnp.ndarray) -> jnp.ndarray:

@@ -111,6 +111,14 @@ def knn_match(
     slot a stable top-2 would return first, and the runner-up VALUE
     (all the ratio test consumes) is the same either way.
     """
+    # All-zero descriptors are the invalid sentinel (_finalize_descriptors
+    # zeroes masked slots; bin-capacity-dropped keypoints and perfectly
+    # flat patches also produce them) — they must not match: an all-zero
+    # query's distance to a reference is just the reference's popcount,
+    # which is near zero for low-texture references and would pass every
+    # test as a spurious correspondence.
+    q_valid = q_valid & jnp.any(q_desc != 0, axis=-1)
+    r_valid = r_valid & jnp.any(r_desc != 0, axis=-1)
     Di = hamming_matrix_mxu(q_desc, r_desc, q_valid, r_valid).astype(jnp.int32)
     Kq, Kr = Di.shape
     best = jnp.min(Di, axis=-1)

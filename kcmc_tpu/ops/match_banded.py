@@ -1,0 +1,394 @@
+"""Spatially-banded KNN matching: exploit bounded drift to skip the
+dense (K, K) Hamming matrix.
+
+Motion correction drift is bounded — a frame keypoint can only match
+reference keypoints within the drift radius R (SURVEY.md §2, the KNN
+matcher row; `BASELINE.json configs[1]`'s ~2k-matches regime is where
+the dense matrix hurts: at K=4096 it costs K² = 16.7M descriptor pairs
+and ~2 GB of HBM per 32-frame batch, capping both the match stage and
+the pipeline's batch overlap). The banded matcher restricts each query
+keypoint to the reference keypoints within a window that covers its
+±R motion envelope:
+
+* Reference keypoints are bucketed ONCE per batch into fixed-capacity
+  spatial sub-buckets (static shapes: capacity overflow drops the
+  rarest excess keypoints, masked not resized).
+* Query keypoints are bucketed per frame into `tile`-sized tiles by a
+  single stable argsort over tile ids — all queries in a tile share one
+  candidate set, so the Hamming work stays one MXU matmul per tile:
+  (C_q, N_BITS) x (N_BITS, C_cand), batched over tiles. With the
+  default geometry at K=4096 on 512² that is ~4x fewer descriptor
+  pairs and ~4x less HBM than the dense matrix, at full M=128 MXU
+  tile utilization.
+
+When to use (measured, DESIGN.md "Banded matching" round 4): at
+K<=4096 the dense matcher is ALREADY faster wall-clock on the v5e
+(0.62 vs 0.95 ms/frame — the dense matmul is MXU-efficient and the
+banded form pays bucketing/reduction overhead), so `match_radius` is
+off by default. Banding is the SCALE path: the dense (B, K, K) matrix
+is HBM-infeasible past K~8192 (34 GB at K=16384, batch 32), while the
+banded candidate set grows linearly in K.
+* The candidate window of tile t covers [t·S - pad, (t+1)·S + pad)
+  per axis with pad = ceil(R / sub)·sub ≥ R, so every reference
+  keypoint within R of ANY query in the tile is a candidate — recall
+  loss comes only from capacity overflow (bounded by the `slack`
+  knob), never from geometry.
+* The mutual-nearest test runs over the same banded universe: for each
+  reference keypoint, its best query across the (statically known ≤4)
+  tiles whose window contains its sub-bucket. This is the banded
+  semantic — a reference keypoint's competitors are the queries within
+  its motion envelope, which is exactly the set that could legitimately
+  claim it.
+
+Returns the same `Matches` contract as the dense `ops.match.knn_match`,
+in original query-slot order, so the backend's tail is agnostic to
+which matcher ran.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from kcmc_tpu.ops.describe import N_BITS
+from kcmc_tpu.ops.match import Matches, _BIG, unpack_pm1
+
+_IBIG = jnp.int32(1 << 16)  # sentinel distance (> N_BITS), int32 flavor
+
+
+class BandedGeometry(NamedTuple):
+    """Static banded-matcher geometry for one (shape, radius, K) tuple.
+
+    Everything here is plain Python/NumPy — computed at trace time, baked
+    into the compiled program as constants.
+    """
+
+    shape: tuple  # (H, W)
+    tile: int  # query tile side, px
+    sub: int  # reference sub-bucket side, px
+    th: int  # query tile grid rows
+    tw: int  # query tile grid cols
+    gh: int  # ref sub-bucket grid rows
+    gw: int  # ref sub-bucket grid cols
+    cq: int  # query slots per tile
+    csub: int  # ref slots per sub-bucket
+    n_win: int  # candidate window side, in sub-buckets
+    window_sub: np.ndarray  # (T, n_win²) int32 sub-bucket id per window slot
+    window_ok: np.ndarray  # (T, n_win²) bool — window slot inside the grid
+    rev_tile: np.ndarray  # (G, S) int32 serving tile ids per sub-bucket
+    rev_wpos: np.ndarray  # (G, S) int32 window position of the sub-bucket
+    rev_ok: np.ndarray  # (G, S) bool
+
+
+def make_geometry(
+    shape: tuple,
+    radius: float,
+    n_query: int,
+    n_ref: int,
+    tile: int = 64,
+    slack: float = 2.0,
+    nms_tile: int | None = None,
+) -> BandedGeometry:
+    """Derive the static geometry: grid sizes, capacities, the per-tile
+    candidate window, and the per-sub-bucket reverse (serving-tile) map.
+
+    Bucket capacities are `slack` times the mean occupancy (keypoints
+    beyond a bucket's capacity are dropped — the bounded recall-loss
+    knob). `nms_tile` (the detector's spatial-spreading tile: at most
+    one keypoint per nms_tile² cell) caps capacities at the hard
+    occupancy bound NMS guarantees, shrinking buckets for free when the
+    statistical estimate overshoots it.
+    """
+    H, W = int(shape[0]), int(shape[1])
+    tile = int(tile)
+    if tile < 16:
+        raise ValueError(f"match_tile must be >= 16, got {tile}")
+    if radius <= 0:
+        raise ValueError(f"match_radius must be positive, got {radius}")
+    # Finer sub-buckets for small radii shrink the candidate window
+    # (fewer wasted candidates); tile//2 keeps per-bucket capacity
+    # MXU-reasonable for larger radii.
+    if tile % 4:
+        # sub-bucket sides are tile//4 or tile//2 and the window
+        # arithmetic assumes tile == (tile//sub)*sub exactly — a
+        # non-divisible tile would misalign the candidate window by
+        # (tile mod sub) px per tile and silently violate the
+        # radius-coverage guarantee.
+        raise ValueError(f"match tile must be a multiple of 4, got {tile}")
+    sub = tile // 4 if radius <= tile // 4 else tile // 2
+    pad_subs = int(math.ceil(radius / sub))
+    r = tile // sub  # sub-buckets per tile side
+    n_win = r + 2 * pad_subs
+
+    th, tw = -(-H // tile), -(-W // tile)
+    gh, gw = -(-H // sub), -(-W // sub)
+    T, G = th * tw, gh * gw
+
+    def cap(n, cell):
+        mean = n * cell * cell / (H * W)
+        c = int(math.ceil(slack * mean))
+        c = max(8, -(-c // 8) * 8)  # >= 8, rounded up to 8
+        if nms_tile is not None and nms_tile >= 1:
+            hard = (-(-cell // nms_tile)) ** 2  # NMS occupancy ceiling
+            c = min(c, max(hard, 1))
+        return c
+
+    cq = cap(n_query, tile)
+    csub = cap(n_ref, sub)
+
+    # Candidate window: for tile (ty, tx), the n_win x n_win block of
+    # sub-buckets starting at (ty*r - pad, tx*r - pad).
+    tys, txs = np.divmod(np.arange(T), tw)
+    wy = tys[:, None] * r - pad_subs + np.arange(n_win)[None, :]  # (T, n_win)
+    wx = txs[:, None] * r - pad_subs + np.arange(n_win)[None, :]
+    oky = (wy >= 0) & (wy < gh)
+    okx = (wx >= 0) & (wx < gw)
+    sub_id = (
+        np.clip(wy, 0, gh - 1)[:, :, None] * gw
+        + np.clip(wx, 0, gw - 1)[:, None, :]
+    )  # (T, n_win, n_win)
+    window_sub = sub_id.reshape(T, n_win * n_win).astype(np.int32)
+    window_ok = (oky[:, :, None] & okx[:, None, :]).reshape(T, n_win * n_win)
+
+    # Reverse map: which tiles' windows contain sub-bucket (sy, sx)?
+    # ty*r - pad <= sy < ty*r - pad + n_win, i.e. ty in
+    # [ceil((sy + pad - n_win + 1)/r), floor((sy + pad)/r)] — at most
+    # ceil(n_win / r) values per axis.
+    S_axis = -(-n_win // r)
+    sys_, sxs = np.divmod(np.arange(G), gw)
+
+    def serving(s):  # (G,) -> ids (G, S_axis), ok (G, S_axis)
+        lo = -(-(s + pad_subs - n_win + 1) // r)
+        ids = lo[:, None] + np.arange(S_axis)[None, :]
+        ok = ids * r - pad_subs <= s[:, None]  # window still contains s
+        return ids, ok
+
+    ty_ids, ty_ok = serving(sys_)
+    tx_ids, tx_ok = serving(sxs)
+    ty_ok &= (ty_ids >= 0) & (ty_ids < th)
+    tx_ok &= (tx_ids >= 0) & (tx_ids < tw)
+    rev_tile = (
+        np.clip(ty_ids, 0, th - 1)[:, :, None] * tw
+        + np.clip(tx_ids, 0, tw - 1)[:, None, :]
+    ).reshape(G, S_axis * S_axis).astype(np.int32)
+    rev_ok = (ty_ok[:, :, None] & tx_ok[:, None, :]).reshape(G, -1)
+    # Window position of sub-bucket s inside serving tile t's window:
+    # (sy - (ty*r - pad)) * n_win + (sx - (tx*r - pad)).
+    wpy = sys_[:, None] - (np.clip(ty_ids, 0, th - 1) * r - pad_subs)
+    wpx = sxs[:, None] - (np.clip(tx_ids, 0, tw - 1) * r - pad_subs)
+    rev_wpos = (
+        wpy[:, :, None] * n_win + wpx[:, None, :]
+    ).reshape(G, -1).astype(np.int32)
+    rev_wpos = np.clip(rev_wpos, 0, n_win * n_win - 1)
+
+    return BandedGeometry(
+        shape=(H, W), tile=tile, sub=sub, th=th, tw=tw, gh=gh, gw=gw,
+        cq=cq, csub=csub, n_win=n_win,
+        window_sub=window_sub, window_ok=window_ok,
+        rev_tile=rev_tile, rev_wpos=rev_wpos, rev_ok=rev_ok,
+    )
+
+
+def _bucketize(xy, valid, cell: int, gh: int, gw: int, cap: int):
+    """Assign keypoints to a (gh, gw) grid of `cell`-px buckets with
+    fixed capacity via one stable argsort.
+
+    Returns slot_idx (G, cap) int32 — keypoint index per bucket slot —
+    and slot_ok (G, cap) bool. Keypoints beyond a bucket's capacity are
+    dropped (their slots simply don't exist); invalid keypoints sort to
+    a sentinel bucket past the grid.
+    """
+    K = xy.shape[0]
+    G = gh * gw
+    cx = (xy[:, 0] // cell).astype(jnp.int32)
+    cy = (xy[:, 1] // cell).astype(jnp.int32)
+    # Keypoints outside the grid (cannot occur for detector output, but
+    # callers may pass arbitrary xy) are dropped rather than clamped —
+    # clamping would hand a border tile candidates arbitrarily far from
+    # the keypoint's true position, violating the radius contract.
+    in_grid = (cx >= 0) & (cx < gw) & (cy >= 0) & (cy < gh)
+    cid = jnp.where(
+        valid & in_grid,
+        jnp.clip(cy, 0, gh - 1) * gw + jnp.clip(cx, 0, gw - 1),
+        G,
+    )
+    order = jnp.argsort(cid)  # stable: preserves detection-score order
+    sorted_cid = cid[order]
+    bins = jnp.arange(G, dtype=sorted_cid.dtype)
+    starts = jnp.searchsorted(sorted_cid, bins, side="left")
+    ends = jnp.searchsorted(sorted_cid, bins, side="right")
+    slots = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    slot_ok = slots < ends[:, None]
+    slot_idx = order[jnp.minimum(slots, K - 1)].astype(jnp.int32)
+    return slot_idx, slot_ok
+
+
+class BandedRef(NamedTuple):
+    """Reference-side banded structure (template keypoints bucketed).
+
+    Built once per batch dispatch, outside the per-frame vmap — the
+    template is fixed, so every frame in the batch shares it.
+    """
+
+    cand_pm1: jnp.ndarray  # (T, C, N_BITS) bf16 candidate ±1 descriptors
+    cand_idx: jnp.ndarray  # (T, C) int32 global ref keypoint per slot
+    cand_ok: jnp.ndarray  # (T, C) bool
+    ref_sub: jnp.ndarray  # (Kr,) int32 sub-bucket of each ref keypoint
+    ref_slot: jnp.ndarray  # (Kr,) int32 slot within that sub-bucket
+
+
+def build_banded_ref(
+    geom: BandedGeometry, ref_xy, ref_desc, ref_valid
+) -> BandedRef:
+    Kr = ref_xy.shape[0]
+    G = geom.gh * geom.gw
+    # Zero descriptors are the invalid sentinel (see knn_match).
+    ref_valid = ref_valid & jnp.any(ref_desc != 0, axis=-1)
+    slot_idx, slot_ok = _bucketize(
+        ref_xy, ref_valid, geom.sub, geom.gh, geom.gw, geom.csub
+    )  # (G, csub)
+    # Inverse map: ref keypoint -> (sub-bucket, slot). Overflow-dropped
+    # keypoints keep the scatter default (sub-bucket G, slot 0) and can
+    # never be selected as a candidate, so the mutual lookup for them is
+    # never consulted.
+    flat = jnp.where(slot_ok, slot_idx, Kr).reshape(-1)
+    subs = jnp.repeat(
+        jnp.arange(G, dtype=jnp.int32), geom.csub
+    )
+    slots_in = jnp.tile(jnp.arange(geom.csub, dtype=jnp.int32), G)
+    ref_sub = jnp.full((Kr + 1,), G, jnp.int32).at[flat].set(subs)[:Kr]
+    ref_slot = jnp.zeros((Kr + 1,), jnp.int32).at[flat].set(slots_in)[:Kr]
+
+    wsub = jnp.asarray(geom.window_sub)  # (T, n_win²)
+    wok = jnp.asarray(geom.window_ok)
+    cand_idx = slot_idx[wsub].reshape(wsub.shape[0], -1)  # (T, W²·csub)
+    cand_ok = (slot_ok[wsub] & wok[:, :, None]).reshape(wsub.shape[0], -1)
+    cand_pm1 = unpack_pm1(ref_desc[cand_idx])
+    return BandedRef(
+        cand_pm1=cand_pm1, cand_idx=cand_idx, cand_ok=cand_ok,
+        ref_sub=ref_sub, ref_slot=ref_slot,
+    )
+
+
+def banded_match(
+    geom: BandedGeometry,
+    bref: BandedRef,
+    q_desc,
+    q_xy,
+    q_valid,
+    ratio: float = 0.85,
+    max_dist: int = 80,
+    mutual: bool = True,
+) -> Matches:
+    """2-NN Hamming match of one frame's keypoints against the banded
+    reference. Same validity semantics as `knn_match` (distance cap,
+    Lowe ratio, optional mutual-nearest), with the candidate universe
+    restricted to each query's motion envelope.
+    """
+    K = q_desc.shape[0]
+    T = geom.th * geom.tw
+    # Zero descriptors are the invalid sentinel — same rule as the
+    # dense matcher (see knn_match): they must never match.
+    q_valid = q_valid & jnp.any(q_desc != 0, axis=-1)
+    q_slot_idx, q_slot_ok = _bucketize(
+        q_xy, q_valid, geom.tile, geom.th, geom.tw, geom.cq
+    )  # (T, cq)
+    qd = unpack_pm1(q_desc[q_slot_idx])  # (T, cq, N_BITS)
+
+    # One MXU matmul per tile, batched: exact integer dot products in
+    # f32 (±1 products, sums <= N_BITS), same identity as the dense
+    # matcher's hamming_matrix_mxu.
+    s = lax.dot_general(
+        qd, bref.cand_pm1,
+        (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # (T, cq, C)
+    d = ((N_BITS - s) * 0.5).astype(jnp.int32)
+    mask = q_slot_ok[:, :, None] & bref.cand_ok[:, None, :]
+    D = jnp.where(mask, d, _IBIG)
+
+    best = jnp.min(D, axis=-1)  # (T, cq)
+    arg = jnp.argmin(D, axis=-1).astype(jnp.int32)
+    C = D.shape[-1]
+    taken = arg[:, :, None] == jnp.arange(C, dtype=jnp.int32)[None, None, :]
+    second = jnp.min(jnp.where(taken, _IBIG, D), axis=-1)
+    ridx = jnp.take_along_axis(bref.cand_idx, arg, axis=1)  # (T, cq) global
+
+    ok = (best < max_dist) & (
+        best.astype(jnp.float32) < ratio * second.astype(jnp.float32)
+    )
+    ok = ok & q_slot_ok & (best < jnp.int32(N_BITS + 1))
+
+    if mutual:
+        # Reverse pass, TPU-shaped: reduce FIRST, gather AFTER. For
+        # every (tile, window-slot) pair, the best query in that tile
+        # for each of the slot's csub candidates is a plain reduction
+        # over the already-computed D — no indexing. Each sub-bucket is
+        # then the min over its <= S statically-known (tile, window-
+        # slot) sources: S row gathers with CONSTANT indices. (A
+        # per-sub-bucket advanced-indexing gather over D lowers to
+        # element-level scatter/gather on TPU — measured 6.1 ms/frame,
+        # 10x this formulation.)
+        G = geom.gh * geom.gw
+        csub = geom.csub
+        n_w2 = geom.n_win * geom.n_win
+        # Packed key: distance in the high bits, query index in the
+        # low — one min recovers (best distance, lowest query on ties),
+        # the same tie order as the dense matcher's argmin. The
+        # multiplier is the smallest power of two > K (static), and the
+        # distance field is capped at DCAP (> N_BITS, so every real
+        # distance keeps its order and masked slots stay maximal) to
+        # keep DCAP * mult + K within int32 at any K.
+        mult = 1 << int(K + 1).bit_length()
+        dcap = jnp.int32(2 * N_BITS)
+        if (2 * N_BITS + 1) * mult + K >= 2**31:
+            raise ValueError(
+                f"banded mutual packing overflows int32 at K={K}"
+            )
+        q_global = jnp.broadcast_to(
+            q_slot_idx[:, :, None, None], (T, geom.cq, n_w2, csub)
+        )
+        packed = (
+            jnp.minimum(D.reshape(T, geom.cq, n_w2, csub), dcap) * mult
+            + q_global
+        )
+        sentinel = jnp.int32((2 * N_BITS) * mult + mult - 1)
+        tw_min = jnp.min(packed, axis=1).reshape(T * n_w2, csub)
+        S = geom.rev_tile.shape[1]
+        # Static source rows: flat (tile, window-slot) index per
+        # sub-bucket and serving slot — trace-time constants.
+        src = geom.rev_tile * n_w2 + geom.rev_wpos  # (G, S) numpy
+        rev = jnp.full((G, csub), sentinel)
+        for si in range(S):
+            rows = tw_min[jnp.asarray(src[:, si])]  # (G, csub)
+            rows = jnp.where(
+                jnp.asarray(geom.rev_ok[:, si])[:, None], rows, sentinel
+            )
+            rev = jnp.minimum(rev, rows)
+        rev_q = rev % mult  # the claiming query's global index
+        rsub = bref.ref_sub[ridx]  # (T, cq); G for overflow-dropped refs
+        rslot = bref.ref_slot[ridx]
+        # Overflow-dropped refs can't be candidates, so rsub < G
+        # wherever ok can be True — the clip only guards the gather.
+        claimed = rev_q[jnp.minimum(rsub, G - 1), rslot]
+        ok = ok & (claimed == q_slot_idx)
+
+    # Scatter per-slot results back to original query order. Every valid
+    # slot holds a distinct query index; invalid slots route to a
+    # scratch row past the end. Dropped/overflowed queries keep the
+    # defaults (valid=False).
+    dest = jnp.where(q_slot_ok, q_slot_idx, K).reshape(-1)
+    out_idx = jnp.zeros((K + 1,), jnp.int32).at[dest].set(ridx.reshape(-1))
+    out_dist = jnp.full((K + 1,), _IBIG).at[dest].set(best.reshape(-1))
+    out_second = jnp.full((K + 1,), _IBIG).at[dest].set(second.reshape(-1))
+    out_ok = jnp.zeros((K + 1,), bool).at[dest].set(ok.reshape(-1))
+    return Matches(
+        idx=out_idx[:K],
+        dist=out_dist[:K],
+        second=out_second[:K],
+        valid=out_ok[:K],
+    )
