@@ -127,6 +127,17 @@ class JaxBackend:
         if cfg.sanitize_input:
             frame = _sanitize_nonfinite(frame[None])[0]
         if frame.ndim == 2:
+            if cfg.n_octaves > 1:
+                # Multi-scale reference through the SAME pyramid stage
+                # as the batch program, so frame and reference keypoint
+                # sets share octave layout and coordinate convention.
+                kps, desc = self._detect_describe_2d(
+                    frame[None], self._on_accelerator()
+                )
+                return {
+                    "xy": kps.xy[0], "desc": desc[0],
+                    "valid": kps.valid[0], "frame": frame,
+                }
             kps = detect_keypoints(
                 frame,
                 max_keypoints=cfg.max_keypoints,
@@ -256,6 +267,57 @@ class JaxBackend:
             return make_sharded_batch_fn(local, self.mesh)
         return jax.jit(local)
 
+    def _detect_describe_2d(self, frames, use_pallas: bool, multi_scale=True):
+        """The 2D detect+describe stage for a (B, H, W) float32 batch:
+        single-scale by default; with `n_octaves > 1`, the ORB scale
+        pyramid — per-octave fixed-K detection and description on
+        MXU-resized images, merged into one multi-scale keypoint set in
+        base coordinates (ops/pyramid.py). Shared by the batch program
+        and prepare_reference so reference and frame keypoints always
+        come from the same pipeline."""
+        cfg = self.config
+        oriented = cfg.resolved_oriented()
+
+        def stage(fr, k_octave, border):
+            kps, smooth = detect_keypoints_batch(
+                fr,
+                max_keypoints=k_octave,
+                threshold=cfg.detect_threshold,
+                nms_size=cfg.nms_size,
+                border=border,
+                harris_k=cfg.harris_k,
+                use_pallas=use_pallas,
+                smooth_sigma=cfg.blur_sigma,
+                window_sigma=cfg.harris_window_sigma,
+                cand_tile=cfg.cand_tile,
+            )
+            desc = describe_keypoints_batch(
+                fr,
+                kps,
+                oriented=oriented,
+                blur_sigma=cfg.blur_sigma,
+                use_pallas=use_pallas,
+                smooth=smooth,
+            )
+            return kps, desc
+
+        if cfg.n_octaves <= 1 or not multi_scale:
+            return stage(frames, cfg.max_keypoints, cfg.border)
+
+        from kcmc_tpu.ops.pyramid import (
+            build_pyramid,
+            merge_octave_keypoints,
+            per_octave_k,
+        )
+
+        octs = build_pyramid(frames, cfg.n_octaves, cfg.octave_scale)
+        ks = per_octave_k(cfg.max_keypoints, cfg.n_octaves)
+        per = []
+        for oc, ko in zip(octs, ks):
+            b = min(cfg.border, min(oc.frames.shape[1:]) // 4)
+            per.append(stage(oc.frames, ko, b))
+        return merge_octave_keypoints(per, octs)
+
     def _build_local_2d(self, shape):
         cfg = self.config
         oriented = cfg.resolved_oriented()
@@ -293,27 +355,8 @@ class JaxBackend:
                     banded_geom, ref_xy, ref_desc, ref_valid
                 )
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
-            # smooth (the descriptor-stage blur) rides along with the
-            # fused Pallas detection kernel's resident slab.
-            kps, smooth = detect_keypoints_batch(
-                frames,
-                max_keypoints=cfg.max_keypoints,
-                threshold=cfg.detect_threshold,
-                nms_size=cfg.nms_size,
-                border=cfg.border,
-                harris_k=cfg.harris_k,
-                use_pallas=use_pallas_patches,
-                smooth_sigma=cfg.blur_sigma,
-                window_sigma=cfg.harris_window_sigma,
-                cand_tile=cfg.cand_tile,
-            )
-            desc = describe_keypoints_batch(
-                frames,
-                kps,
-                oriented=oriented,
-                blur_sigma=cfg.blur_sigma,
-                use_pallas=use_pallas_patches,
-                smooth=smooth,
+            kps, desc = self._detect_describe_2d(
+                frames, use_pallas_patches
             )
 
             def tail(frame, kp, d, key):
@@ -387,6 +430,36 @@ class JaxBackend:
                 return out
 
             out = jax.vmap(tail)(frames, kps, desc, keys)
+            if not is_pw and cfg.n_octaves > 1 and cfg.pyramid_refine:
+                # Coarse-to-fine: the multi-scale estimate's floor is
+                # the coarse octave's localization noise (subpixel
+                # error x octave factor in base coords). Exactly warp
+                # each frame by the coarse estimate (gather warp — the
+                # bounded kernels would zero large zooms) and
+                # re-register single-scale: the residual motion is
+                # near-identity, so localization is full-resolution.
+                # Composition: corrected0(p) = frame(M1 p), pass 2
+                # gives corrected0 = ref-aligned via M_r, so
+                # ref -> frame is M1 @ M_r.
+                from kcmc_tpu.ops.warp import warp_frame
+
+                coarse = out["transform"]
+                corrected0 = jax.vmap(warp_frame)(frames, coarse)
+                kps2, desc2 = self._detect_describe_2d(
+                    corrected0, use_pallas_patches, multi_scale=False
+                )
+                keys2 = jax.vmap(
+                    lambda k: jax.random.fold_in(k, 1)
+                )(keys)
+                out2 = jax.vmap(tail)(corrected0, kps2, desc2, keys2)
+                coarse_matches = out["n_matches"]
+                out = dict(out2)
+                out["transform"] = jnp.einsum(
+                    "bij,bjk->bik", coarse, out2["transform"]
+                )
+                # standard keys report the FINAL (fine) fit; the coarse
+                # pass's match count stays visible for diagnosis
+                out["coarse_n_matches"] = coarse_matches
             # Batch-level warp: (corrected, ok) — frames a bounded
             # gather-free kernel could not resample are zeroed and
             # flagged via the per-frame `warp_ok` diagnostic.

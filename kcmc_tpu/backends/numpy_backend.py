@@ -104,6 +104,65 @@ class NumpyBackend:
     def __init__(self, config: CorrectorConfig, **_options):
         self.config = config
 
+    def _detect_describe_2d(self, frame: np.ndarray, multi_scale=True):
+        """Single-scale detect+describe, or the ORB scale pyramid when
+        n_octaves > 1 — the same octave sizes, resize matrices, and
+        coordinate mapping as the jax backend (ops/pyramid.py exports
+        the JAX-free constants), so cross-backend parity holds for
+        multi-scale configs too."""
+        cfg = self.config
+
+        def stage(fr, k_octave, border):
+            xy, score, valid = K.detect_keypoints(
+                fr,
+                max_keypoints=k_octave,
+                threshold=cfg.detect_threshold,
+                nms_size=cfg.nms_size,
+                border=border,
+                harris_k=cfg.harris_k,
+                window_sigma=cfg.harris_window_sigma,
+                cand_tile=cfg.cand_tile,
+            )
+            desc = K.describe_keypoints(
+                fr, xy, valid,
+                oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma,
+            )
+            return xy, score, valid, desc
+
+        if cfg.n_octaves <= 1 or not multi_scale:
+            return stage(frame, cfg.max_keypoints, cfg.border)
+
+        from kcmc_tpu.ops.pyramid import (
+            octave_sizes,
+            per_octave_k,
+            resize_matrix,
+        )
+
+        H, W = frame.shape
+        sizes = octave_sizes((H, W), cfg.n_octaves, cfg.octave_scale)
+        ks = per_octave_k(cfg.max_keypoints, cfg.n_octaves)
+        xs, ss, vs, ds = [], [], [], []
+        for o, ((ho, wo), ko) in enumerate(zip(sizes, ks)):
+            if o == 0:
+                fr, sx, sy = frame, 1.0, 1.0
+            else:
+                rh = resize_matrix(H, ho)
+                rw = resize_matrix(W, wo)
+                fr = (rh @ frame @ rw.T).astype(np.float32)
+                sx, sy = W / wo, H / ho
+            b = min(cfg.border, min(ho, wo) // 4)
+            xy, score, valid, desc = stage(fr, ko, b)
+            xs.append((xy + 0.5) * np.float32([sx, sy]) - 0.5)
+            ss.append(score)
+            vs.append(valid)
+            ds.append(desc)
+        return (
+            np.concatenate(xs).astype(np.float32),
+            np.concatenate(ss),
+            np.concatenate(vs),
+            np.concatenate(ds),
+        )
+
     def prepare_reference(self, ref_frame: np.ndarray) -> dict:
         cfg = self.config
         ref_frame = np.asarray(ref_frame, np.float32)
@@ -121,23 +180,7 @@ class NumpyBackend:
                 frame, xyz, valid, blur_sigma=cfg.blur_sigma
             )
             return {"xy": xyz, "desc": desc, "valid": valid, "frame": frame}
-        xy, score, valid = K.detect_keypoints(
-            ref_frame,
-            max_keypoints=cfg.max_keypoints,
-            threshold=cfg.detect_threshold,
-            nms_size=cfg.nms_size,
-            border=cfg.border,
-            harris_k=cfg.harris_k,
-            window_sigma=cfg.harris_window_sigma,
-            cand_tile=cfg.cand_tile,
-        )
-        desc = K.describe_keypoints(
-            ref_frame,
-            xy,
-            valid,
-            oriented=cfg.resolved_oriented(),
-            blur_sigma=cfg.blur_sigma,
-        )
+        xy, score, valid, desc = self._detect_describe_2d(ref_frame)
         return {"xy": xy, "desc": desc, "valid": valid, "frame": ref_frame}
 
     def process_batch(
@@ -159,11 +202,18 @@ class NumpyBackend:
         return merged
 
     def _keys(self):
+        cfg = self.config
         base = [
             "corrected", "warp_ok", "n_keypoints", "n_matches",
             "n_inliers", "rms_residual",
         ]
-        return base + (["field"] if self.config.model == "piecewise" else ["transform"])
+        if (
+            cfg.model != "piecewise"
+            and cfg.n_octaves > 1
+            and cfg.pyramid_refine
+        ):
+            base.append("coarse_n_matches")
+        return base + (["field"] if cfg.model == "piecewise" else ["transform"])
 
     def _process_one(self, frame, gidx, ref, out):
         cfg = self.config
@@ -172,19 +222,7 @@ class NumpyBackend:
         if frame.ndim == 3:
             self._process_one_3d(frame, gidx, ref, out)
             return
-        xy, score, valid = K.detect_keypoints(
-            frame,
-            max_keypoints=cfg.max_keypoints,
-            threshold=cfg.detect_threshold,
-            nms_size=cfg.nms_size,
-            border=cfg.border,
-            harris_k=cfg.harris_k,
-            window_sigma=cfg.harris_window_sigma,
-            cand_tile=cfg.cand_tile,
-        )
-        desc = K.describe_keypoints(
-            frame, xy, valid, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
-        )
+        xy, score, valid, desc = self._detect_describe_2d(frame)
         idx, dist, second, ok = K.knn_match(
             desc,
             ref["desc"],
@@ -218,6 +256,34 @@ class NumpyBackend:
                 threshold=cfg.inlier_threshold,
                 refine_iters=cfg.refine_iters,
             )
+            if cfg.n_octaves > 1 and cfg.pyramid_refine:
+                # Coarse-to-fine mirror of the jax backend: exactly
+                # warp by the coarse multi-scale estimate, re-register
+                # single-scale (full-resolution localization), compose
+                # ref->frame as M_coarse @ M_residual.
+                corrected0 = K.warp_frame(frame, M)
+                xy2, _, valid2, desc2 = self._detect_describe_2d(
+                    corrected0, multi_scale=False
+                )
+                idx2, _, _, ok2 = K.knn_match(
+                    desc2, ref["desc"], valid2, ref["valid"],
+                    ratio=cfg.ratio, max_dist=cfg.max_hamming,
+                    mutual=cfg.mutual,
+                )
+                rng2 = np.random.default_rng([cfg.seed, gidx, 1])
+                Mr, n_in, inl, rms = K.ransac_estimate(
+                    cfg.model, ref["xy"][idx2], xy2, ok2, rng2,
+                    n_hypotheses=cfg.n_hypotheses,
+                    threshold=cfg.inlier_threshold,
+                    refine_iters=cfg.refine_iters,
+                )
+                out["coarse_n_matches"].append(out["n_matches"].pop())
+                out["n_matches"].append(np.int32(ok2.sum()))
+                # the jax backend reports the FINE pass's keypoint
+                # count under refine — keep diagnostics parity
+                out["n_keypoints"].pop()
+                out["n_keypoints"].append(np.int32(valid2.sum()))
+                M = (M @ Mr).astype(np.float32)
             out["transform"].append(M)
             out["corrected"].append(K.warp_frame(frame, M))
             out["n_inliers"].append(np.int32(n_in))

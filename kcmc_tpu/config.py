@@ -36,6 +36,32 @@ class CorrectorConfig:
     oriented: bool | None = None  # None => auto: off for translation
     blur_sigma: float = 2.0
 
+    # -- scale pyramid (true ORB multi-scale) ------------------------------
+    # Octave count for multi-scale detection/description (2D models).
+    # 1 = single-scale (default: zero cost, the measured ±25% zoom
+    # envelope). 3 with the 1.5 spacing below extends the envelope to
+    # ~2x zoom: each octave detects and describes on a downscaled image
+    # (constant-matrix MXU resize), keypoints merge into one fixed-size
+    # multi-scale set in base coordinates, and matching/consensus are
+    # unchanged. max_keypoints splits evenly across octaves.
+    n_octaves: int = 1
+    # Scale ratio between octaves. 1.5 is gap-free for the descriptor's
+    # ±25% tolerance (worst-case residual zoom sqrt(1.5) ≈ 1.22); 2.0
+    # would leave coverage holes at sqrt(2) ≈ 1.41.
+    octave_scale: float = 1.5
+    # Two-pass coarse-to-fine estimation for pyramid runs (matrix
+    # models): the multi-scale pass gives a coarse estimate whose
+    # accuracy floor is the COARSE octave's localization noise (its
+    # subpixel error scales by the octave factor in base coordinates —
+    # measured ~0.2 px at 2x zoom); frames are then exactly warped by
+    # that estimate and re-registered single-scale, where the residual
+    # motion is near-identity and localization is full-resolution. The
+    # composed transform recovers <=0.07 px through 1.5x zoom and
+    # ~0.06-0.12 px at 2x (platform/scene dependent — see DESIGN.md
+    # "Scale pyramid"), at ~2x the per-frame cost. Only consulted when
+    # n_octaves > 1.
+    pyramid_refine: bool = True
+
     # -- matching ----------------------------------------------------------
     ratio: float = 0.85
     max_hamming: int = 80
@@ -194,6 +220,21 @@ class CorrectorConfig:
                 "separable shear decomposition degrades; use warp='jnp' "
                 f"for extreme rotations (got {self.max_rotation_deg})"
             )
+        if self.n_octaves < 1:
+            raise ValueError(
+                f"n_octaves must be >= 1, got {self.n_octaves}"
+            )
+        if self.n_octaves > 1:
+            if not 1.0 < self.octave_scale <= 4.0:
+                raise ValueError(
+                    "octave_scale must be in (1, 4], got "
+                    f"{self.octave_scale}"
+                )
+            if self.model in ("rigid3d",):
+                raise ValueError(
+                    "n_octaves > 1 (scale pyramid) supports 2D models "
+                    "only"
+                )
         if self.match_radius is not None:
             if self.match_radius <= 0:
                 raise ValueError(

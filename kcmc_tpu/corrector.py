@@ -208,6 +208,7 @@ def apply_correction_file(
     output_dtype: str | np.dtype = "input",
     n_threads: int = 0,
     progress: bool = False,
+    reader_options: dict | None = None,
 ) -> None:
     """Streaming `apply_correction`: TIFF in, corrected TIFF out,
     constant host memory.
@@ -226,13 +227,15 @@ def apply_correction_file(
     (see the CLI's rigid3d handling). Output dtype semantics match
     `apply_correction`; BigTIFF engages automatically past 4 GiB.
     """
-    from kcmc_tpu.io import ChunkedStackLoader, TiffStack
+    from kcmc_tpu.io import ChunkedStackLoader, open_stack
     from kcmc_tpu.io.tiff import TiffWriter
 
     if (transforms is None) == (fields is None):
         raise ValueError("pass exactly one of transforms= or fields=")
     ref = transforms if transforms is not None else fields
-    with TiffStack(path, n_threads=n_threads) as ts:
+    with open_stack(
+        path, n_threads=n_threads, **(reader_options or {})
+    ) as ts:
         if len(ref) != len(ts):
             raise ValueError(
                 f"{path} has {len(ts)} pages but {len(ref)} transforms/fields"
@@ -1068,8 +1071,16 @@ class MotionCorrector:
         checkpoint_every: int = 512,
         stall_abort: float | None = None,
         emit_frames: bool = True,
+        reader_options: dict | None = None,
     ) -> CorrectionResult:
-        """Stream-correct a multi-page TIFF stack.
+        """Stream-correct a file-scale stack.
+
+        `path` may be a multi-page TIFF, a Zarr v2 store, an HDF5 file,
+        a memory-mappable .npy, a headerless .raw/.bin (shape/dtype via
+        `reader_options`), an in-memory array, or any reader object
+        implementing the io.formats protocol — every format streams
+        through the same prefetch / checkpoint-resume / watchdog
+        machinery (io/formats.py). Output stays TIFF.
 
         Chunks are decoded by a background prefetch thread (the native
         threaded TIFF decoder when available) while the device registers
@@ -1125,7 +1136,7 @@ class MotionCorrector:
         checkpoint). Reference selection is deterministic, so it is
         re-derived on resume rather than stored.
         """
-        from kcmc_tpu.io import ChunkedStackLoader, TiffStack
+        from kcmc_tpu.io import ChunkedStackLoader, open_stack
         from kcmc_tpu.io.tiff import TiffWriter
 
         timer = StageTimer()
@@ -1138,6 +1149,12 @@ class MotionCorrector:
                 "checkpoint requires output= (corrected frames are "
                 "persisted in the output TIFF, not the checkpoint)"
             )
+        if checkpoint is not None and not isinstance(path, (str, os.PathLike)):
+            raise ValueError(
+                "checkpoint= requires a file-path source — the resume "
+                "signature fingerprints the file (size/mtime); an "
+                "in-memory source has no cross-process identity"
+            )
         if not emit_frames and output is not None:
             raise ValueError(
                 "emit_frames=False is registration-only; it cannot be "
@@ -1149,7 +1166,9 @@ class MotionCorrector:
                 "(use None to disable)"
             )
 
-        with TiffStack(path, n_threads=n_threads) as ts:
+        with open_stack(
+            path, n_threads=n_threads, **(reader_options or {})
+        ) as ts:
             with timer.stage("prepare_reference"):
                 if isinstance(self.reference, (int, np.integer)):
                     idx = int(self.reference)

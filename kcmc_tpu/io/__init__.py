@@ -1,6 +1,27 @@
-"""Stack I/O: TIFF read/write (native threaded decoder) + chunked loading."""
+"""Stack I/O: TIFF read/write (native threaded decoder), pluggable
+streaming ingest (Zarr/HDF5/npy/raw/array via one reader protocol),
+and chunked prefetch loading."""
 
+from kcmc_tpu.io.formats import (
+    ArrayStack,
+    HDF5Stack,
+    NpyStack,
+    RawStack,
+    ZarrStack,
+    open_stack,
+)
 from kcmc_tpu.io.reader import ChunkedStackLoader
 from kcmc_tpu.io.tiff import TiffStack, read_stack, write_stack
 
-__all__ = ["ChunkedStackLoader", "TiffStack", "read_stack", "write_stack"]
+__all__ = [
+    "ArrayStack",
+    "ChunkedStackLoader",
+    "HDF5Stack",
+    "NpyStack",
+    "RawStack",
+    "TiffStack",
+    "ZarrStack",
+    "open_stack",
+    "read_stack",
+    "write_stack",
+]

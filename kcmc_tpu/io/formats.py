@@ -1,0 +1,299 @@
+"""Pluggable streaming ingest: one reader protocol, many formats.
+
+SURVEY.md §1 names the stack-I/O layer "TIFF/array ingest"; the
+microscopy ecosystem this targets ships HDF5 / Zarr / raw-binary stacks
+as often as TIFF. The whole file-scale streaming machinery (prefetch
+thread, checkpoint-resume, stall watchdog, registration-only passes)
+only needs the small duck-typed protocol `TiffStack` already satisfies:
+
+    len(reader)            -> frame count
+    reader.frame_shape     -> per-frame shape tuple
+    reader.dtype           -> numpy dtype of stored frames
+    reader.read(lo, hi)    -> (hi-lo, *frame_shape) ndarray
+    context manager        -> closes underlying handles
+
+This module provides that protocol over:
+
+* ``ZarrStack``   — Zarr v2 directory stores. Uses the ``zarr`` package
+  when installed; otherwise a built-in pure-Python reader handles the
+  common case (C-order, 3D/4D, raw/zlib/gzip chunks) with an explicit
+  error for exotic compressors. No hard dependency either way.
+* ``HDF5Stack``   — HDF5 datasets via ``h5py`` (guarded import), with
+  single-3D-dataset auto-discovery.
+* ``NpyStack``    — ``.npy`` arrays, memory-mapped (zero-copy slicing).
+* ``RawStack``    — headerless binary via ``np.memmap`` (shape + dtype
+  supplied by the caller).
+* ``ArrayStack``  — any in-memory array-like with axis-0 slicing.
+
+``open_stack`` dispatches on extension / source type and is what
+``MotionCorrector.correct_file`` uses, so ``correct_file("stack.zarr",
+checkpoint=...)`` streams with the same kill-safe resume machinery as a
+TIFF. Output writing stays TIFF (the one format with a native threaded
+encoder here); registration-only runs have no output file at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+import numpy as np
+
+
+class _BaseStack:
+    """Context-manager plumbing shared by the readers."""
+
+    frame_shape: tuple
+    dtype: np.dtype
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self):  # pragma: no cover - trivial default
+        pass
+
+    def __len__(self) -> int:
+        return self._n
+
+
+class ArrayStack(_BaseStack):
+    """Adapter for any array-like with numpy-style axis-0 slicing
+    (ndarray, memmap, dask/zarr arrays, h5py datasets...)."""
+
+    def __init__(self, source):
+        if getattr(source, "ndim", len(getattr(source, "shape", ()))) not in (3, 4):
+            raise ValueError(
+                "stack source must be 3D (T, H, W) or 4D (T, D, H, W), "
+                f"got shape {getattr(source, 'shape', None)}"
+            )
+        self.source = source
+        self._n = source.shape[0]
+        self.frame_shape = tuple(source.shape[1:])
+        self.dtype = np.dtype(source.dtype)
+
+    def read(self, lo: int, hi: int) -> np.ndarray:
+        return np.asarray(self.source[lo:hi])
+
+
+class NpyStack(ArrayStack):
+    """A ``.npy`` stack, memory-mapped: reads touch only the sliced
+    frames, so 100 GB files stream fine."""
+
+    def __init__(self, path):
+        super().__init__(np.load(path, mmap_mode="r"))
+
+
+class RawStack(ArrayStack):
+    """Headerless binary: caller supplies shape and dtype (the usual
+    acquisition-software dump: fixed-size frames, C order, optional
+    fixed header skipped via ``offset`` bytes)."""
+
+    def __init__(self, path, shape, dtype, offset: int = 0):
+        mm = np.memmap(
+            path, dtype=np.dtype(dtype), mode="r", offset=int(offset),
+            shape=tuple(int(s) for s in shape),
+        )
+        super().__init__(mm)
+
+
+class HDF5Stack(_BaseStack):
+    """An HDF5 dataset. `dataset` names it; omitted, the file must
+    contain exactly one 3D/4D dataset (auto-discovered)."""
+
+    def __init__(self, path, dataset: str | None = None):
+        try:
+            import h5py
+        except ImportError as e:  # pragma: no cover - present on image
+            raise ImportError(
+                "HDF5 ingest needs the optional h5py package"
+            ) from e
+        self._f = h5py.File(path, "r")
+        if dataset is None:
+            cands = []
+
+            def visit(name, obj):
+                if isinstance(obj, h5py.Dataset) and obj.ndim in (3, 4):
+                    cands.append(name)
+
+            self._f.visititems(visit)
+            if len(cands) != 1:
+                self._f.close()
+                raise ValueError(
+                    f"{path}: expected exactly one 3D/4D dataset, found "
+                    f"{cands or 'none'} — pass dataset='name'"
+                )
+            dataset = cands[0]
+        self._d = self._f[dataset]
+        if self._d.ndim not in (3, 4):
+            self._f.close()
+            raise ValueError(
+                f"dataset {dataset!r} is {self._d.ndim}D, need 3D/4D"
+            )
+        self._n = self._d.shape[0]
+        self.frame_shape = tuple(self._d.shape[1:])
+        self.dtype = np.dtype(self._d.dtype)
+
+    def read(self, lo: int, hi: int) -> np.ndarray:
+        return np.asarray(self._d[lo:hi])
+
+    def close(self):
+        self._f.close()
+
+
+class _MiniZarr:
+    """Pure-Python Zarr v2 array reader: C-order, raw/zlib/gzip chunks.
+
+    Covers the stores scientific pipelines commonly write without
+    pulling in the zarr/numcodecs stack; anything fancier (blosc, F
+    order, filters) gets an explicit error pointing at the optional
+    dependency.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        with open(os.path.join(path, ".zarray")) as f:
+            meta = json.load(f)
+        if meta.get("zarr_format") != 2:
+            raise ValueError(f"{path}: only zarr v2 stores supported")
+        if meta.get("order", "C") != "C":
+            raise ValueError(
+                f"{path}: F-order store needs the optional zarr package"
+            )
+        if meta.get("filters"):
+            raise ValueError(
+                f"{path}: filtered store needs the optional zarr package"
+            )
+        comp = meta.get("compressor")
+        cid = None if comp is None else comp.get("id")
+        if cid not in (None, "zlib", "gzip"):
+            raise ValueError(
+                f"{path}: compressor {cid!r} needs the optional zarr "
+                "package (built-in reader handles raw/zlib/gzip)"
+            )
+        self._zlib = cid is not None
+        self.shape = tuple(meta["shape"])
+        self.chunks = tuple(meta["chunks"])
+        self.dtype = np.dtype(meta["dtype"])
+        self.fill = meta.get("fill_value", 0) or 0
+        self.sep = meta.get("dimension_separator", ".")
+        self.ndim = len(self.shape)
+
+    def _chunk(self, idx) -> np.ndarray:
+        name = self.sep.join(str(i) for i in idx)
+        p = os.path.join(self.path, name)
+        if not os.path.exists(p):
+            return np.full(self.chunks, self.fill, self.dtype)
+        with open(p, "rb") as f:
+            buf = f.read()
+        if self._zlib:
+            # zlib stream or gzip wrapper — wbits=47 accepts both
+            buf = zlib.decompress(buf, 47)
+        return np.frombuffer(buf, self.dtype).reshape(self.chunks)
+
+    def __getitem__(self, sl) -> np.ndarray:
+        lo, hi = sl.start or 0, sl.stop if sl.stop is not None else self.shape[0]
+        hi = min(hi, self.shape[0])
+        out = np.empty((hi - lo,) + self.shape[1:], self.dtype)
+        c0 = self.chunks[0]
+        grids = [
+            -(-s // c) for s, c in zip(self.shape[1:], self.chunks[1:])
+        ]
+        for ci in range(lo // c0, -(-hi // c0)):
+            t0 = ci * c0
+            s_lo, s_hi = max(lo, t0), min(hi, t0 + c0)
+            idx_rest = np.ndindex(*grids)
+            for rest in idx_rest:
+                chunk = self._chunk((ci,) + rest)
+                # destination window of this chunk in the spatial dims
+                dst = [slice(s_lo - lo, s_hi - lo)]
+                src = [slice(s_lo - t0, s_hi - t0)]
+                ok = True
+                for d, (ri, c, s) in enumerate(
+                    zip(rest, self.chunks[1:], self.shape[1:])
+                ):
+                    a, b = ri * c, min((ri + 1) * c, s)
+                    if a >= b:
+                        ok = False
+                        break
+                    dst.append(slice(a, b))
+                    src.append(slice(0, b - a))
+                if ok:
+                    out[tuple(dst)] = chunk[tuple(src)]
+        return out
+
+
+class ZarrStack(ArrayStack):
+    """A Zarr v2 array store (directory). Prefers the optional ``zarr``
+    package (full format coverage); falls back to the built-in reader
+    for plain C-order raw/zlib/gzip stores."""
+
+    def __init__(self, path):
+        path = os.fspath(path)
+        try:
+            import zarr  # optional
+
+            arr = zarr.open_array(path, mode="r")
+        except ImportError:
+            arr = _MiniZarr(path)
+        if len(arr.shape) not in (3, 4):
+            raise ValueError(
+                f"{path}: zarr array is {len(arr.shape)}D, need 3D/4D"
+            )
+        super().__init__(arr)
+
+
+def open_stack(source, n_threads: int = 0, **reader_options):
+    """Open any supported stack source with the streaming-reader
+    protocol.
+
+    source: a path (dispatched on extension: .tif/.tiff, .zarr
+    directory, .h5/.hdf5, .npy, .raw/.bin/.dat), an object already
+    implementing the protocol (returned as-is), or an array-like
+    (wrapped in ArrayStack). reader_options are format-specific
+    (HDF5Stack's ``dataset``, RawStack's ``shape``/``dtype``/
+    ``offset``).
+    """
+    def no_options(fmt):
+        # Silently absorbing options a format doesn't take would let a
+        # stale reader_options dict (e.g. an HDF5 dataset= against a
+        # TIFF) "succeed" while reading something else entirely.
+        if reader_options:
+            raise ValueError(
+                f"{fmt} sources take no reader_options, got "
+                f"{sorted(reader_options)}"
+            )
+
+    if not isinstance(source, (str, os.PathLike)):
+        no_options("array/reader")
+        if hasattr(source, "read") and hasattr(source, "frame_shape"):
+            return source  # already a protocol reader
+        return ArrayStack(source)
+    path = os.fspath(source)
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".tif", ".tiff"):
+        from kcmc_tpu.io.tiff import TiffStack
+
+        no_options("TIFF")
+        return TiffStack(path, n_threads=n_threads)
+    if ext == ".zarr" or os.path.isdir(path) and os.path.exists(
+        os.path.join(path, ".zarray")
+    ):
+        no_options("Zarr")
+        return ZarrStack(path)
+    if ext in (".h5", ".hdf5"):
+        return HDF5Stack(path, **reader_options)
+    if ext == ".npy":
+        no_options(".npy")
+        return NpyStack(path)
+    if ext in (".raw", ".bin", ".dat"):
+        return RawStack(path, **reader_options)
+    raise ValueError(
+        f"unrecognized stack format {ext!r} for {path} — supported: "
+        ".tif/.tiff, .zarr, .h5/.hdf5, .npy, .raw/.bin/.dat, or pass "
+        "an array / reader object"
+    )

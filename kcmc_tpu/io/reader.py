@@ -22,8 +22,10 @@ from kcmc_tpu.io.tiff import TiffStack
 class ChunkedStackLoader:
     """Iterate (lo, hi, frames) chunks of a stack with background prefetch.
 
-    source: a TiffStack, a path to one, or any array-like with
-    numpy-style slicing along axis 0 (ndarray, memmap, zarr-ish).
+    source: any io.formats protocol reader (TiffStack, ZarrStack,
+    HDF5Stack, ...), a path (dispatched via open_stack), or any
+    array-like with numpy-style slicing along axis 0 (ndarray, memmap,
+    zarr-ish).
     """
 
     def __init__(
@@ -37,7 +39,9 @@ class ChunkedStackLoader:
     ):
         self._own = False
         if isinstance(source, (str, os.PathLike)):
-            source = TiffStack(source, n_threads=n_threads)
+            from kcmc_tpu.io.formats import open_stack
+
+            source = open_stack(source, n_threads=n_threads)
             self._own = True
         self.source = source
         self.n_total = len(source)
@@ -47,7 +51,7 @@ class ChunkedStackLoader:
         self.prefetch = max(1, prefetch)
 
     def _read(self, lo: int, hi: int) -> np.ndarray:
-        if isinstance(self.source, TiffStack):
+        if hasattr(self.source, "read"):  # io.formats protocol readers
             return self.source.read(lo, hi)
         return np.asarray(self.source[lo:hi])
 
@@ -91,7 +95,7 @@ class ChunkedStackLoader:
             t.join(timeout=5)
 
     def close(self):
-        if self._own and isinstance(self.source, TiffStack):
+        if self._own:
             self.source.close()
 
     def __enter__(self):

@@ -314,18 +314,15 @@ def _binned_select(flat: jnp.ndarray, bins: jnp.ndarray, valid) -> jnp.ndarray:
     keypoints (stable argsort keeps detection-score order within a
     bin, so the strongest stay).
     """
+    from kcmc_tpu.ops.dispatch import segment_by_key
+
     K, L = flat.shape
     nb = N_ORIENT_BINS
     cap = min(K, max(32, -(-2 * K // (nb * 8)) * 8))
     b_eff = jnp.where(valid, bins, nb)  # invalid slots: sentinel bin
-    order = jnp.argsort(b_eff)  # stable: score order kept within bins
-    sb = b_eff[order]
-    arange_nb = jnp.arange(nb, dtype=sb.dtype)
-    starts = jnp.searchsorted(sb, arange_nb, side="left")
-    ends = jnp.searchsorted(sb, arange_nb, side="right")
-    slots = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
-    ok = slots < ends[:, None]
-    rows_idx = order[jnp.minimum(slots, K - 1)]  # (nb, cap)
+    # stable segment-by-key: score order kept within bins, so overflow
+    # drops each bin's weakest keypoints
+    rows_idx, ok = segment_by_key(b_eff, nb, cap)
     rows = flat[rows_idx]  # (nb, cap, L)
     # Same split-precision passes as _onehot_select, batched over bins.
     hi = rows.astype(jnp.bfloat16).astype(jnp.float32)

@@ -1,0 +1,37 @@
+"""Fixed-capacity segment-by-key: the shared dispatch primitive.
+
+Both the banded matcher (keypoints -> spatial buckets) and the oriented
+descriptor stage (keypoints -> orientation bins) need the same TPU-
+shaped operation: group N items by an integer key into fixed-capacity
+segments with static shapes, dropping overflow (masked, never resized).
+One stable argsort + searchsorted does it with no scatters; keeping the
+mechanism in one place means a tie-order or clamping fix reaches every
+user.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def segment_by_key(keys, n_groups: int, cap: int):
+    """Group items by integer key with fixed per-group capacity.
+
+    keys: (N,) int — group id per item; ids outside [0, n_groups) are
+    dropped (use n_groups as the drop sentinel). Returns
+    (slot_idx (n_groups, cap) int32 — item index per slot — and
+    slot_ok (n_groups, cap) bool). The argsort is stable, so items
+    keep their original relative order within a group and overflow
+    drops the LAST items of each group (callers ordering items by
+    priority keep the most important ones).
+    """
+    N = keys.shape[0]
+    order = jnp.argsort(keys)  # stable
+    sorted_keys = keys[order]
+    bins = jnp.arange(n_groups, dtype=sorted_keys.dtype)
+    starts = jnp.searchsorted(sorted_keys, bins, side="left")
+    ends = jnp.searchsorted(sorted_keys, bins, side="right")
+    slots = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    slot_ok = slots < ends[:, None]
+    slot_idx = order[jnp.minimum(slots, N - 1)].astype(jnp.int32)
+    return slot_idx, slot_ok

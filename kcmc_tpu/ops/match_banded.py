@@ -55,7 +55,8 @@ import numpy as np
 from jax import lax
 
 from kcmc_tpu.ops.describe import N_BITS
-from kcmc_tpu.ops.match import Matches, _BIG, unpack_pm1
+from kcmc_tpu.ops.dispatch import segment_by_key
+from kcmc_tpu.ops.match import Matches, unpack_pm1
 
 _IBIG = jnp.int32(1 << 16)  # sentinel distance (> N_BITS), int32 flavor
 
@@ -133,7 +134,12 @@ def make_geometry(
         c = int(math.ceil(slack * mean))
         c = max(8, -(-c // 8) * 8)  # >= 8, rounded up to 8
         if nms_tile is not None and nms_tile >= 1:
-            hard = (-(-cell // nms_tile)) ** 2  # NMS occupancy ceiling
+            # NMS occupancy ceiling: a window of `cell` px intersects at
+            # most floor(cell/nms)+1 origin-aligned NMS cells per axis
+            # (NOT ceil(cell/nms), which undercounts whenever nms_tile
+            # doesn't divide the cell and would clamp capacity below
+            # real occupancy).
+            hard = (cell // nms_tile + 1) ** 2
             c = min(c, max(hard, 1))
         return c
 
@@ -202,7 +208,6 @@ def _bucketize(xy, valid, cell: int, gh: int, gw: int, cap: int):
     dropped (their slots simply don't exist); invalid keypoints sort to
     a sentinel bucket past the grid.
     """
-    K = xy.shape[0]
     G = gh * gw
     cx = (xy[:, 0] // cell).astype(jnp.int32)
     cy = (xy[:, 1] // cell).astype(jnp.int32)
@@ -216,15 +221,8 @@ def _bucketize(xy, valid, cell: int, gh: int, gw: int, cap: int):
         jnp.clip(cy, 0, gh - 1) * gw + jnp.clip(cx, 0, gw - 1),
         G,
     )
-    order = jnp.argsort(cid)  # stable: preserves detection-score order
-    sorted_cid = cid[order]
-    bins = jnp.arange(G, dtype=sorted_cid.dtype)
-    starts = jnp.searchsorted(sorted_cid, bins, side="left")
-    ends = jnp.searchsorted(sorted_cid, bins, side="right")
-    slots = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
-    slot_ok = slots < ends[:, None]
-    slot_idx = order[jnp.minimum(slots, K - 1)].astype(jnp.int32)
-    return slot_idx, slot_ok
+    # stable segment-by-key: preserves detection-score order in-bucket
+    return segment_by_key(cid, G, cap)
 
 
 class BandedRef(NamedTuple):

@@ -1,0 +1,213 @@
+"""Pluggable streaming ingest (io/formats.py): Zarr / HDF5 / npy / raw
+/ array sources stream through the same machinery as TIFF — prefetch,
+checkpoint-resume, registration-only passes (SURVEY.md §1 stack-I/O
+layer)."""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.io import ChunkedStackLoader, ZarrStack, open_stack
+from kcmc_tpu.utils import synthetic
+from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+SHAPE = (128, 128)
+T = 24
+
+
+@pytest.fixture(scope="module")
+def drift():
+    return synthetic.make_drift_stack(
+        n_frames=T, shape=SHAPE, model="translation", max_drift=5.0, seed=7
+    )
+
+
+def _u16(stack):
+    return np.clip(stack * 40000, 0, 65535).astype(np.uint16)
+
+
+def _write_zarr(path, arr, chunks=(8, 64, 64), compress=True, sep="."):
+    """Hand-rolled Zarr v2 store — no zarr dependency, which is the
+    point: the built-in reader must handle stores other tools wrote."""
+    os.makedirs(path)
+    meta = {
+        "zarr_format": 2,
+        "shape": list(arr.shape),
+        "chunks": list(chunks),
+        "dtype": arr.dtype.str,
+        "compressor": {"id": "zlib", "level": 1} if compress else None,
+        "fill_value": 0,
+        "order": "C",
+        "filters": None,
+        "dimension_separator": sep,
+    }
+    with open(os.path.join(path, ".zarray"), "w") as f:
+        json.dump(meta, f)
+    grid = [-(-s // c) for s, c in zip(arr.shape, chunks)]
+    for idx in np.ndindex(*grid):
+        block = np.zeros(chunks, arr.dtype)
+        sl = tuple(
+            slice(i * c, min((i + 1) * c, s))
+            for i, c, s in zip(idx, chunks, arr.shape)
+        )
+        view = arr[sl]
+        block[tuple(slice(0, v) for v in view.shape)] = view
+        buf = block.tobytes()
+        if compress:
+            buf = zlib.compress(buf, 1)
+        dst = os.path.join(path, sep.join(map(str, idx)))
+        os.makedirs(os.path.dirname(dst), exist_ok=True)  # "/"-separated
+        with open(dst, "wb") as f:
+            f.write(buf)
+
+
+@pytest.mark.parametrize("compress,sep", [(True, "."), (False, "/")])
+def test_zarr_reader_roundtrip(tmp_path, drift, compress, sep):
+    arr = _u16(drift.stack)
+    path = tmp_path / "stack.zarr"
+    _write_zarr(str(path), arr, compress=compress, sep=sep)
+    with open_stack(str(path)) as ts:
+        assert len(ts) == T
+        assert ts.frame_shape == SHAPE
+        assert ts.dtype == np.uint16
+        np.testing.assert_array_equal(ts.read(0, T), arr)
+        np.testing.assert_array_equal(ts.read(5, 11), arr[5:11])
+
+
+def test_zarr_correct_file_end_to_end(tmp_path, drift):
+    arr = _u16(drift.stack)
+    zpath = tmp_path / "in.zarr"
+    _write_zarr(str(zpath), arr)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=8)
+    res = mc.correct_file(str(zpath), chunk_size=8)
+    err = transform_rmse(
+        res.transforms, relative_transforms(drift.transforms), SHAPE
+    )
+    assert err < 0.15
+
+
+def test_zarr_checkpoint_resume_byte_identical(tmp_path, drift):
+    """Kill+resume over a zarr source produces the same output TIFF as
+    an uninterrupted run — the streaming machinery is format-blind."""
+    arr = _u16(drift.stack)
+    zpath = tmp_path / "in.zarr"
+    _write_zarr(str(zpath), arr)
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=4
+    )
+    ref_out = tmp_path / "ref.tif"
+    mk().correct_file(str(zpath), output=str(ref_out), chunk_size=8)
+
+    calls = {"n": 0}
+    orig = ChunkedStackLoader._read
+
+    def poisoned(self, lo, hi):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("simulated kill")
+        return orig(self, lo, hi)
+
+    out = tmp_path / "out.tif"
+    ckpt = tmp_path / "run.ckpt.npz"
+    ChunkedStackLoader._read = poisoned
+    try:
+        with pytest.raises(RuntimeError, match="simulated kill"):
+            mk().correct_file(
+                str(zpath), output=str(out), chunk_size=8,
+                checkpoint=str(ckpt), checkpoint_every=8,
+            )
+    finally:
+        ChunkedStackLoader._read = orig
+    res = mk().correct_file(
+        str(zpath), output=str(out), chunk_size=8, checkpoint=str(ckpt),
+    )
+    assert res.timing["restored_frames"] > 0
+    assert ref_out.read_bytes() == out.read_bytes()
+
+
+def test_hdf5_source(tmp_path, drift):
+    h5py = pytest.importorskip("h5py")
+    arr = _u16(drift.stack)
+    path = tmp_path / "in.h5"
+    with h5py.File(path, "w") as f:
+        f.create_dataset("data/stack", data=arr, chunks=(4,) + SHAPE)
+    with open_stack(str(path)) as ts:  # auto-discovered single dataset
+        assert ts.frame_shape == SHAPE
+        np.testing.assert_array_equal(ts.read(3, 9), arr[3:9])
+    res = MotionCorrector(
+        model="translation", backend="jax", batch_size=8
+    ).correct_file(str(path), chunk_size=8)
+    err = transform_rmse(
+        res.transforms, relative_transforms(drift.transforms), SHAPE
+    )
+    assert err < 0.15
+
+
+def test_npy_and_raw_sources(tmp_path, drift):
+    arr = _u16(drift.stack)
+    npy = tmp_path / "in.npy"
+    np.save(npy, arr)
+    with open_stack(str(npy)) as ts:
+        np.testing.assert_array_equal(ts.read(0, 5), arr[:5])
+
+    raw = tmp_path / "in.raw"
+    arr.tofile(raw)
+    with open_stack(
+        str(raw), shape=arr.shape, dtype=np.uint16
+    ) as ts:
+        assert ts.dtype == np.uint16
+        np.testing.assert_array_equal(ts.read(10, T), arr[10:])
+
+    res = MotionCorrector(
+        model="translation", backend="jax", batch_size=8
+    ).correct_file(
+        str(raw), chunk_size=8,
+        reader_options=dict(shape=arr.shape, dtype=np.uint16),
+    )
+    assert res.transforms.shape == (T, 3, 3)
+
+
+def test_array_source_streams(drift):
+    """An in-memory array goes through the same streaming path."""
+    res = MotionCorrector(
+        model="translation", backend="jax", batch_size=8
+    ).correct_file(_u16(drift.stack), chunk_size=8)
+    err = transform_rmse(
+        res.transforms, relative_transforms(drift.transforms), SHAPE
+    )
+    assert err < 0.15
+
+
+def test_checkpoint_needs_path_source(drift):
+    with pytest.raises(ValueError, match="file-path source"):
+        MotionCorrector(model="translation", backend="jax").correct_file(
+            _u16(drift.stack), output="x.tif", checkpoint="c.npz"
+        )
+
+
+def test_unknown_format_message(tmp_path):
+    p = tmp_path / "stack.xyz"
+    p.write_bytes(b"??")
+    with pytest.raises(ValueError, match="unrecognized stack format"):
+        open_stack(str(p))
+
+
+def test_mini_zarr_rejects_exotic_compressor(tmp_path, drift):
+    try:
+        import zarr  # noqa: F401
+
+        pytest.skip("zarr installed: the full reader handles blosc")
+    except ImportError:
+        pass
+    arr = _u16(drift.stack)
+    path = tmp_path / "b.zarr"
+    _write_zarr(str(path), arr, compress=False)
+    meta = json.loads((path / ".zarray").read_text())
+    meta["compressor"] = {"id": "blosc", "cname": "zstd"}
+    (path / ".zarray").write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="blosc"):
+        ZarrStack(str(path))

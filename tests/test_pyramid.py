@@ -1,0 +1,164 @@
+"""ORB scale pyramid (ops/pyramid.py + backend wiring): multi-octave
+detection extends the zoom envelope from ±25% to ~2x.
+
+The headline contract (VERDICT r3 item 2): similarity drift with
+1.5-2x zoom — where the single-scale envelope test documents collapse —
+is recovered with n_octaves=3, cross-backend, without touching the
+flagship single-scale configs (n_octaves=1 default).
+"""
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.ops.pyramid import (
+    octave_sizes,
+    per_octave_k,
+    resize_matrix,
+)
+from kcmc_tpu.utils import synthetic
+from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+SHAPE = (256, 256)
+
+
+def _zoom_stack(rng, scene, s, n=4, drift=3.0):
+    """Frames showing `scene` scaled by s (about the center) plus small
+    random drift — the same construction as the single-scale envelope
+    test in test_robustness.py."""
+    cy, cx = (SHAPE[0] - 1) / 2.0, (SHAPE[1] - 1) / 2.0
+    mats = np.tile(np.eye(3, dtype=np.float32), (n, 1, 1))
+    frames = [scene]
+    for t in range(1, n):
+        L = np.float32(s) * np.eye(2, dtype=np.float32)
+        mats[t, :2, :2] = L
+        mats[t, :2, 2] = rng.uniform(-drift, drift, 2).astype(
+            np.float32
+        ) + np.array([cx, cy], np.float32) - L @ np.array([cx, cy], np.float32)
+        frames.append(synthetic._warp_scene(scene, mats[t]))
+    st = np.stack(frames) + rng.normal(0, 0.01, (n,) + SHAPE).astype(np.float32)
+    return st.astype(np.float32), mats
+
+
+def test_resize_matrix_properties():
+    # rows are a partition of unity (interpolation preserves constants)
+    for n_in, n_out in ((256, 172), (256, 256), (100, 64), (64, 100)):
+        m = resize_matrix(n_in, n_out)
+        assert m.shape == (n_out, n_in)
+        np.testing.assert_allclose(m.sum(axis=1), 1.0, atol=1e-6)
+    # identity at equal size
+    np.testing.assert_allclose(resize_matrix(64, 64), np.eye(64), atol=1e-6)
+    # constant image stays constant; linear ramp stays linear (interior)
+    m = resize_matrix(256, 172)
+    ramp = np.arange(256, dtype=np.float32)
+    out = m @ ramp
+    centers = (np.arange(172) + 0.5) * (256 / 172) - 0.5
+    np.testing.assert_allclose(out[5:-5], centers[5:-5], atol=1e-3)
+
+
+def test_octave_geometry():
+    sizes = octave_sizes((512, 512), 3, 1.5)
+    assert sizes[0] == (512, 512)
+    assert all(h % 8 == 0 and w % 8 == 0 for h, w in sizes)
+    assert sizes[1][0] < sizes[0][0] > sizes[2][0]
+    ks = per_octave_k(1024, 3)
+    assert len(ks) == 3 and all(k % 8 == 0 for k in ks)
+
+
+@pytest.mark.parametrize(
+    "zoom,n_octaves,octave_scale,n_blobs",
+    [
+        (1.5, 3, 1.5, 220),
+        # 2x zoom only shows the scene's central quarter, so corner-
+        # evaluated RMSE extrapolates from quarter-confined matches —
+        # the denser scene and the sqrt(2) spacing (whose powers hit 2x
+        # exactly) keep the fit's lever-arm error under the bound.
+        (2.0, 4, 2**0.5, 500),
+        (0.67, 3, 1.5, 220),
+    ],
+)
+def test_pyramid_recovers_large_zoom(zoom, n_octaves, octave_scale, n_blobs):
+    """1.5-2x zoom at <0.1 px with the pyramid + coarse-to-fine refine —
+    the regime where the single-scale run is documented
+    (test_robustness envelope) to collapse to a false consensus."""
+    import warnings
+
+    rng = np.random.default_rng(3)
+    scene = synthetic.render_scene(rng, SHAPE, n_blobs=n_blobs)
+    st, mats = _zoom_stack(rng, scene, zoom)
+    rel = relative_transforms(mats)
+
+    mc = MotionCorrector(
+        model="similarity", backend="jax", batch_size=4,
+        n_octaves=n_octaves, octave_scale=octave_scale, max_keypoints=1024,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res = mc.correct(st)
+    err = transform_rmse(res.transforms, rel, SHAPE)
+    assert err < 0.1, err
+    # the recovered zoom itself is right (scale of the linear part)
+    got_s = np.sqrt(np.abs(np.linalg.det(np.asarray(res.transforms)[1:, :2, :2])))
+    np.testing.assert_allclose(got_s, zoom, rtol=0.01)
+
+
+def test_single_scale_fails_where_pyramid_succeeds():
+    """Contrast case: the same 1.5x-zoom stack through the default
+    single-scale config must NOT reach pyramid accuracy — otherwise the
+    pyramid is dead weight and the envelope documentation is stale."""
+    import warnings
+
+    rng = np.random.default_rng(3)
+    scene = synthetic.render_scene(rng, SHAPE, n_blobs=220)
+    st, mats = _zoom_stack(rng, scene, 1.5)
+    rel = relative_transforms(mats)
+    mc = MotionCorrector(model="similarity", backend="jax", batch_size=4)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res = mc.correct(st)
+    err = transform_rmse(res.transforms, rel, SHAPE)
+    assert err > 0.5, err
+
+
+def test_pyramid_cross_backend_parity():
+    """jax and numpy backends agree on the multi-scale config (same
+    resize constants, same octave layout, same coordinate mapping)."""
+    import warnings
+
+    rng = np.random.default_rng(5)
+    scene = synthetic.render_scene(rng, SHAPE, n_blobs=220)
+    st, mats = _zoom_stack(rng, scene, 1.4, n=3)
+    rel = relative_transforms(mats)
+    kw = dict(
+        model="similarity", batch_size=4, n_octaves=3,
+        octave_scale=1.5, max_keypoints=768,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rj = MotionCorrector(backend="jax", **kw).correct(st)
+        rn = MotionCorrector(backend="numpy", **kw).correct(st)
+    ej = transform_rmse(rj.transforms, rel, SHAPE)
+    en = transform_rmse(rn.transforms, rel, SHAPE)
+    assert ej < 0.1 and en < 0.1, (ej, en)
+
+
+def test_flagship_configs_unaffected():
+    """n_octaves=1 (default) goes through the unchanged single-scale
+    stage — identical results to a pre-pyramid run."""
+    data = synthetic.make_drift_stack(
+        n_frames=6, shape=SHAPE, model="translation", max_drift=5.0, seed=0
+    )
+    rel = relative_transforms(data.transforms)
+    res = MotionCorrector(model="translation", backend="jax", batch_size=3).correct(
+        data.stack
+    )
+    assert transform_rmse(res.transforms, rel, SHAPE) < 0.1
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="n_octaves"):
+        MotionCorrector(n_octaves=0)
+    with pytest.raises(ValueError, match="octave_scale"):
+        MotionCorrector(n_octaves=2, octave_scale=1.0)
+    with pytest.raises(ValueError, match="2D"):
+        MotionCorrector(model="rigid3d", n_octaves=2)
