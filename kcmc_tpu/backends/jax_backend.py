@@ -211,11 +211,15 @@ class JaxBackend:
 
             frames_j = shard_frames(frames_j, self.mesh)
             idx_j = shard_frames(idx_j, self.mesh)
-        out = fn(frames_j, ref["xy"], ref["desc"], ref["valid"], idx_j)
+        out = fn(
+            frames_j, ref["xy"], ref["desc"], ref["valid"], ref["frame"],
+            idx_j,
+        )
         if (
             self.config.quality_metrics
             and "corrected" in out
             and ref.get("frame") is not None
+            and not ref.get("_skip_quality")
         ):
             out = dict(out)
             if "field" in out:
@@ -340,7 +344,7 @@ class JaxBackend:
                 slack=cfg.match_slack, nms_tile=cfg.cand_tile,
             )
 
-        def local(frames, ref_xy, ref_desc, ref_valid, indices):
+        def local(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices):
             # Frames upload in their native dtype (uint16 stacks halve
             # the host->device bytes); all math runs in float32.
             frames = frames.astype(jnp.float32)
@@ -406,13 +410,11 @@ class JaxBackend:
                         smooth_sigma=cfg.field_smooth_sigma,
                         passes=cfg.field_passes,
                         refine_reach_scale=cfg.refine_reach_scale,
+                        patch_model=cfg.patch_model,
                     )
+                    # warping is batch-level for BOTH flow paths now
+                    # (the correlation polish needs the warped batch)
                     out["field"] = res.field
-                    if flow_warp is not None:
-                        out["flow"] = res.flow
-                    else:
-                        out["corrected"] = warp_frame_flow(frame, res.flow)
-                        out["warp_ok"] = jnp.bool_(True)  # gather: unbounded
                 else:
                     res = ransac_estimate(
                         model,
@@ -464,11 +466,33 @@ class JaxBackend:
             # gather-free kernel could not resample are zeroed and
             # flagged via the per-frame `warp_ok` diagnostic.
             if is_pw:
-                if flow_warp is not None:
-                    out = dict(out)
-                    out["corrected"], out["warp_ok"] = flow_warp(
-                        frames, out.pop("flow")
+                out = dict(out)
+
+                def warp_flows(field):
+                    flows = jax.vmap(
+                        lambda f: pw.upsample_field(f, shape)
+                    )(field)
+                    if flow_warp is not None:
+                        return flow_warp(frames, flows)
+                    return (
+                        jax.vmap(warp_frame_flow)(frames, flows),
+                        jnp.ones(frames.shape[0], bool),  # gather: unbounded
                     )
+
+                corrected, ok = warp_flows(out["field"])
+                for _ in range(int(cfg.field_polish)):
+                    delta = pw.correlation_polish(
+                        corrected, ref_frame, cfg.patch_grid
+                    )
+                    # a frame the bounded flow kernel zeroed has no
+                    # pixels to correlate — leave its field alone (the
+                    # host rescue re-warps it from the field as-is)
+                    delta = jnp.where(
+                        ok[:, None, None, None], delta, 0.0
+                    )
+                    out["field"] = out["field"] + delta
+                    corrected, ok = warp_flows(out["field"])
+                out["corrected"], out["warp_ok"] = corrected, ok
             else:
                 out = dict(out)
                 out["corrected"], out["warp_ok"] = batch_warp(
@@ -489,7 +513,8 @@ class JaxBackend:
         from kcmc_tpu.ops.detect3d import detect_keypoints_3d_batch
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d_batch
 
-        def local(frames, ref_xy, ref_desc, ref_valid, indices):
+        def local(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices):
+            del ref_frame  # 3D path has no photometric polish (yet)
             frames = frames.astype(jnp.float32)  # native-dtype upload
             if cfg.sanitize_input:
                 frames = _sanitize_nonfinite(frames)
@@ -610,9 +635,15 @@ class JaxBackend:
         if use_separable:
             from kcmc_tpu.ops.warp_separable import warp_batch_affine
 
+            # Pure translation has structurally zero shear (the model
+            # can't produce rotation), so the ±shear_px masked-shift
+            # loops collapse to their k=0 term — at 2048² that is 2.9
+            # -> ~0.5 ms/frame of warp (the 17-pass shear loop was the
+            # whole cost; measured, DESIGN.md "Large-frame support").
+            shear = 0 if cfg.model == "translation" else self._shear_bound_px(shape)
             return functools.partial(
                 warp_batch_affine,
-                shear_px=self._shear_bound_px(shape),
+                shear_px=shear,
                 with_ok=True,
             )
         if cfg.warp == "auto" and cfg.model == "homography" and on_tpu:

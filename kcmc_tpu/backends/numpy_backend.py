@@ -84,6 +84,65 @@ def coverage_masks_np(shape, out: dict) -> np.ndarray:
     return np.stack([fn(shape, M) for M in Ms])
 
 
+def _corr_polish_np(
+    corrected: np.ndarray, template: np.ndarray, grid
+) -> np.ndarray:
+    """NumPy mirror of ops/piecewise.correlation_polish (one frame):
+    center-weighted two-way symmetric cross-correlation at the 3x3
+    integer shifts, separable quadratic peak fit, clamped to ±1 px."""
+    H, W = corrected.shape
+    gh, gw = grid
+    sh, sw = H // gh, W // gw
+    Hc, Wc = gh * sh, gw * sw
+    window_frac = 0.25
+
+    def patches(x):
+        return (
+            x[:Hc, :Wc]
+            .reshape(gh, sh, gw, sw)
+            .swapaxes(1, 2)
+            .reshape(gh, gw, sh * sw)
+        )
+
+    yy = (np.arange(sh) - (sh - 1) / 2) / (window_frac * sh)
+    xx = (np.arange(sw) - (sw - 1) / 2) / (window_frac * sw)
+    w = np.exp(-0.5 * (yy[:, None] ** 2 + xx[None, :] ** 2)).reshape(-1)
+    w = (w / w.sum()).astype(np.float64)
+
+    def zero_mean(p):
+        return p - np.sum(w * p, axis=-1, keepdims=True)
+
+    C = zero_mean(patches(corrected))
+    T0 = zero_mean(patches(template))
+    tpad = np.pad(template, 1, mode="edge")
+    cpad = np.pad(corrected, 1, mode="edge")
+
+    def score(dy, dx):
+        t = zero_mean(patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]))
+        c = zero_mean(patches(cpad[1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W]))
+        return np.sum(w * (C * t + c * T0), axis=-1)
+
+    s_c = score(0, 0)
+    s_xm, s_xp = score(0, -1), score(0, 1)
+    s_ym, s_yp = score(-1, 0), score(1, 0)
+    # significance gate — mirror of ops/piecewise.correlation_polish
+    e_c = np.sum(w * C * C, axis=-1)
+    e_t = np.sum(w * T0 * T0, axis=-1)
+    significant = s_c > 0.2 * np.sqrt(e_c * e_t * 4.0) + 1e-12
+
+    def subpixel(sm, sp):
+        denom = sm - 2.0 * s_c + sp
+        with np.errstate(divide="ignore", invalid="ignore"):
+            off = np.where(
+                denom < -1e-12, 0.5 * (sm - sp) / denom, np.sign(sp - sm)
+            )
+        return np.clip(np.where(significant, off, 0.0), -1.0, 1.0)
+
+    return -np.stack(
+        [subpixel(s_xm, s_xp), subpixel(s_ym, s_yp)], axis=-1
+    ).astype(np.float32)
+
+
 def _sanitize_nonfinite_np(frame: np.ndarray) -> np.ndarray:
     """Replace non-finite pixels with the frame's finite mean (mirror
     of the jax backend's `sanitize_input` path, for parity)."""
@@ -191,7 +250,12 @@ class NumpyBackend:
         for frame, gidx in zip(frames, frame_indices):
             self._process_one(np.asarray(frame, np.float32), int(gidx), ref, out)
         merged = {k: np.stack(v) for k, v in out.items()}
-        if cfg.quality_metrics and "corrected" in merged and "frame" in ref:
+        if (
+            cfg.quality_metrics
+            and "corrected" in merged
+            and "frame" in ref
+            and not ref.get("_skip_quality")
+        ):
             masks = coverage_masks_np(merged["corrected"].shape[1:], merged)
             merged["template_corr"] = template_corr_np(
                 merged["corrected"], ref["frame"], masks
@@ -241,8 +305,19 @@ class NumpyBackend:
 
         if cfg.model == "piecewise":
             field, flow, n_in, rms = self._estimate_field(src, dst, ok, rng, frame.shape)
+            corrected = K.warp_frame_flow(frame, flow)
+            for _ in range(int(cfg.field_polish)):
+                # photometric polish — mirror of the jax backend's
+                # ops/piecewise.correlation_polish + re-warp
+                from kcmc_tpu.utils.synthetic import upsample_field
+
+                field = field + _corr_polish_np(
+                    corrected, ref["frame"], cfg.patch_grid
+                )
+                flow = upsample_field(field, frame.shape)
+                corrected = K.warp_frame_flow(frame, flow)
             out["field"].append(field)
-            out["corrected"].append(K.warp_frame_flow(frame, flow))
+            out["corrected"].append(corrected)
             out["n_inliers"].append(np.int32(n_in))
             out["rms_residual"].append(np.float32(rms))
         else:
@@ -346,17 +421,32 @@ class NumpyBackend:
         cx = (np.arange(gw, dtype=np.float32) + 0.5) * W / gw - 0.5
         reach = 1.5 * max(H / gh, W / gw)
         thr = cfg.inlier_threshold
+        pmodel = cfg.patch_model
+
+        def center_disp(Mp, c):
+            # displacement AT the patch center (mirror of the jax
+            # backend's per-patch evaluation, incl. the trust region
+            # for multi-DoF patch fits)
+            return Mp[:2, :2] @ c + Mp[:2, 2] - c
+
+        def clamp(delta, cap):
+            nrm = float(np.sqrt((delta**2).sum()) + 1e-12)
+            return delta * min(1.0, cap / nrm)
+
         field = np.zeros((gh, gw, 2), np.float32)
         for i in range(gh):
             for j in range(gw):
                 c = np.array([cx[j], cy[i]], np.float32)
                 member = inl_g & (((src - c) ** 2).sum(-1) < reach * reach)
                 Mp, n_p, _, _ = K.ransac_estimate(
-                    "translation", src, dst, member, rng,
+                    pmodel, src, dst, member, rng,
                     n_hypotheses=cfg.patch_hypotheses, threshold=thr,
                 )
+                disp = g_t + clamp(
+                    center_disp(Mp, c) - g_t, 2.0 * cfg.global_threshold
+                )
                 lam = n_p / (n_p + cfg.patch_prior)
-                field[i, j] = lam * Mp[:2, 2] + (1 - lam) * g_t
+                field[i, j] = lam * disp + (1 - lam) * g_t
         field = self._smooth_field(field, cfg.field_smooth_sigma)
 
         pitch = max(H / gh, W / gw)
@@ -375,11 +465,11 @@ class NumpyBackend:
                     c = np.array([cx[j], cy[i]], np.float32)
                     member = gate & (((src - c) ** 2).sum(-1) < reach_r * reach_r)
                     Mp, n_p, _, _ = K.ransac_estimate(
-                        "translation", src, dst_resid, member, rng,
+                        pmodel, src, dst_resid, member, rng,
                         n_hypotheses=cfg.patch_hypotheses, threshold=thr,
                     )
                     lam = n_p / (n_p + cfg.patch_prior)
-                    r[i, j] = lam * Mp[:2, 2]
+                    r[i, j] = lam * clamp(center_disp(Mp, c), 2.0 * thr)
             field = self._smooth_field(field + r, cfg.field_smooth_sigma)
 
         from kcmc_tpu.utils.synthetic import upsample_field

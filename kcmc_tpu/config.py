@@ -97,6 +97,17 @@ class CorrectorConfig:
     # -- piecewise-rigid (config 3) ---------------------------------------
     patch_grid: tuple[int, int] = (8, 8)
     patch_hypotheses: int = 32
+    # Per-patch consensus model. "translation" (default) fits a
+    # constant displacement over the patch reach. Multi-DoF patch
+    # models ("affine"/"rigid"/"similarity") read the local fit at the
+    # patch center — in principle removing the reach-averaging bias,
+    # but MEASURED WORSE on every tried configuration: with ~20-40
+    # members per patch the extra DoF are noise-dominated, and the
+    # residual-refinement rounds amplify rather than damp them (0.97 px
+    # vs translation's 0.35, even trust-region-clamped; DESIGN.md
+    # "Piecewise patch models"). Kept as an option for dense-match
+    # regimes where the member count supports the DoF.
+    patch_model: str = "translation"
     # Inlier-mass scale blending each patch's own translation against the
     # global one (lambda = n_inliers / (n_inliers + prior)), and the
     # grid-cell sigma of the field smoothing. Defaults set by a 2D sweep
@@ -124,6 +135,19 @@ class CorrectorConfig:
     # monotone improvement down to 0.5 in every regime.
     refine_reach_scale: float = 0.5
     global_threshold: float = 8.0  # generous inlier px for the global stage
+    # Photometric field polish passes (0 = off): after the flow warp,
+    # measure each patch's REMAINING shift against the template by
+    # symmetric subpixel cross-correlation (±1 px window, all ~4k
+    # pixels of the patch instead of ~40 matched corners) and re-warp
+    # with the corrected field. This breaks the keypoint-localization
+    # noise floor the smoothing passes cannot (NoRMCorre-style).
+    # Measured on the judged 512² workload (DESIGN.md "Piecewise
+    # correlation polish"): 0.39 px field RMSE -> 0.18 at one pass
+    # (1009 fps on the v5e) -> 0.13 at two (850 fps); a third
+    # oscillates. Each pass costs one extra flow warp + 18 correlation
+    # maps per batch; default 1 keeps the v5e above 1000 fps — set 2
+    # when accuracy matters more than ~15% throughput.
+    field_polish: int = 1
 
     # -- diagnostics -------------------------------------------------------
     # Per-frame Pearson correlation between each corrected frame and the
@@ -257,6 +281,17 @@ class CorrectorConfig:
         if self.field_passes < 1:
             raise ValueError(
                 f"field_passes must be >= 1, got {self.field_passes}"
+            )
+        if int(self.field_polish) < 0:
+            raise ValueError(
+                f"field_polish must be >= 0 passes, got {self.field_polish}"
+            )
+        if self.patch_model not in (
+            "translation", "rigid", "similarity", "affine"
+        ):
+            raise ValueError(
+                "patch_model must be one of translation/rigid/"
+                f"similarity/affine, got {self.patch_model!r}"
             )
         if not 0.0 < self.rescue_warn_fraction <= 1.0:
             raise ValueError(

@@ -35,6 +35,26 @@ def _fingerprint(ref) -> str:
     return repr(ref)
 
 
+def _input_fingerprint(path) -> list:
+    """Checkpoint input-identity for a source path. For a plain file,
+    [size, mtime_ns]. For a DIRECTORY store (Zarr): a directory's own
+    stat is a filesystem constant (size fixed, mtime untouched by
+    in-place chunk rewrites), so fingerprint the entries instead —
+    total bytes and the newest mtime across the tree — which changes
+    whenever any chunk is rewritten."""
+    st = os.stat(path)
+    if not os.path.isdir(path):
+        return [int(st.st_size), int(st.st_mtime_ns)]
+    total, newest, count = 0, 0, 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            s = os.stat(os.path.join(root, f))
+            total += s.st_size
+            newest = max(newest, s.st_mtime_ns)
+            count += 1
+    return [int(total), int(newest), int(count)]
+
+
 class _StallWatchdog:
     """Hard-exit the process when frame progress freezes (correct_file's
     `stall_abort`). A wedged accelerator link blocks the main thread
@@ -584,10 +604,11 @@ class MotionCorrector:
             sub = sub.astype(np.float32)
         for _ in range(self.template_iters):
             ref = self.backend.prepare_reference(ref_frame)
-            # Refinement only consumes corrected/warp_ok; dropping the
-            # reference frame from this view disables the per-batch
-            # quality metric (and its D2H transfer) in these passes.
-            ref = {k: v for k, v in ref.items() if k != "frame"}
+            # Refinement only consumes corrected/warp_ok; flagging the
+            # view skips the per-batch quality metric (and its D2H
+            # transfer) in these passes. (The frame itself must stay —
+            # it is an argument of the batch program now.)
+            ref = dict(ref, _skip_quality=True)
             corrected, ok = [], []
             for lo in range(0, W, B):
                 hi = min(lo + B, W)
@@ -1206,7 +1227,6 @@ class MotionCorrector:
             if checkpoint is not None:
                 from kcmc_tpu.utils.checkpoint import load_stream_checkpoint
 
-                st = os.stat(path)
                 ckpt_sig = {
                     "config": repr(cfg),
                     "n_frames": len(ts),
@@ -1214,7 +1234,7 @@ class MotionCorrector:
                     "dtype": str(ts.dtype),
                     # Input identity: a rerun over a REPLACED same-shape
                     # input must not resume into stale results.
-                    "input": [int(st.st_size), int(st.st_mtime_ns)],
+                    "input": _input_fingerprint(path),
                     # Every argument that changes the results or the
                     # output file must be part of the signature — a
                     # mismatched rerun restarts instead of silently

@@ -128,7 +128,7 @@ def sample_field_at(
     jax.jit,
     static_argnames=(
         "grid", "shape", "n_global_hyps", "patch_hyps", "smooth_sigma",
-        "passes", "refine_reach_scale",
+        "passes", "refine_reach_scale", "patch_model",
     ),
 )
 def estimate_field(
@@ -146,6 +146,7 @@ def estimate_field(
     smooth_sigma: float = 0.7,
     passes: int = 2,
     refine_reach_scale: float = 1.0,
+    patch_model: str = "translation",
 ) -> FieldResult:
     """Per-patch consensus displacement field for one frame.
 
@@ -164,9 +165,18 @@ def estimate_field(
     neighborhood means less cross-patch averaging of exactly the
     variation being recovered. See DESIGN.md "Piecewise refinement
     reach" for the measured sweep.
+
+    `patch_model` selects the per-patch consensus model. "translation"
+    fits a constant displacement over each patch's reach — for a
+    smoothly varying field that constant is the reach-AVERAGED field,
+    the representation bias the refinement passes fight. "affine" fits
+    the local first-order field (displacement + gradient) and reads it
+    off AT the patch center, removing that bias at the source (see
+    DESIGN.md "Piecewise patch models").
     """
     gh, gw = grid
     translation = MODELS["translation"]
+    pmodel = MODELS[patch_model]
     kg, kp = jax.random.split(key)
 
     # 1. Global stage: robust overall translation, generous threshold.
@@ -187,10 +197,26 @@ def estimate_field(
         d2 = jnp.sum((src - center) ** 2, axis=-1)
         member = ok & (d2 < reach * reach)
         res = ransac_estimate(
-            translation, src, dst, member, k,
+            pmodel, src, dst, member, k,
             n_hypotheses=patch_hyps, threshold=patch_threshold,
         )
-        disp = res.transform[:2, 2]
+        # Displacement AT the patch center (for translation this is
+        # just the constant; for affine it reads the local first-order
+        # fit at the one point the field stores).
+        M = res.transform
+        disp = (
+            M[:2, :2] @ center + M[:2, 2] - center
+        )
+        # Trust region: a degenerate multi-DoF patch fit (few, near-
+        # collinear members) can land far from any data-supported
+        # motion; cap the deviation from the global displacement at
+        # 2x the global inlier threshold — every member was within
+        # global_threshold of the global motion, so real local motion
+        # can't exceed that scale.
+        delta = disp - g_t
+        nrm = jnp.sqrt(jnp.sum(delta**2) + 1e-12)
+        cap_px = 2.0 * global_threshold
+        disp = g_t + delta * jnp.minimum(1.0, cap_px / nrm)
         mass = res.n_inliers.astype(jnp.float32)
         # Blend toward the global displacement when the patch has few inliers.
         lam = mass / (mass + prior)
@@ -217,12 +243,20 @@ def estimate_field(
             d2 = jnp.sum((src - center) ** 2, axis=-1)
             member = gate & (d2 < reach_r * reach_r)
             res = ransac_estimate(
-                translation, src, dst_resid, member, k,
+                pmodel, src, dst_resid, member, k,
                 n_hypotheses=patch_hyps, threshold=patch_threshold,
             )
+            M = res.transform
+            disp = M[:2, :2] @ center + M[:2, 2] - center
+            # Trust region: members passed the residual gate
+            # (< 2x patch_threshold), so a genuine correction is
+            # bounded by it; a degenerate fit beyond that is clamped.
+            nrm = jnp.sqrt(jnp.sum(disp**2) + 1e-12)
+            cap_px = 2.0 * patch_threshold
+            disp = disp * jnp.minimum(1.0, cap_px / nrm)
             mass = res.n_inliers.astype(jnp.float32)
             lam = mass / (mass + prior)
-            return lam * res.transform[:2, 2]  # blend toward zero residual
+            return lam * disp  # blend toward zero residual
 
         rkeys = jax.random.split(
             jax.random.fold_in(kp, it + 1), centers.shape[0]
@@ -236,3 +270,104 @@ def estimate_field(
     return FieldResult(
         field=field, flow=flow, n_inliers=gres.n_inliers, rms_residual=gres.rms_residual
     )
+
+
+def correlation_polish(
+    corrected: jnp.ndarray,  # (B, H, W) flow-warped frames (ref-aligned)
+    template: jnp.ndarray,  # (H, W) reference frame
+    grid: tuple[int, int],
+    window_frac: float = 0.25,
+) -> jnp.ndarray:
+    """Photometric field correction: per-patch subpixel cross-
+    correlation of each corrected frame against the template.
+
+    Keypoint consensus estimates the field from ~40 matched corners per
+    patch, each localized to ~0.2-0.3 px — a noise floor the smoothing
+    passes can't beat. This NoRMCorre-style polish measures the
+    REMAINING shift of every patch photometrically, using all ~4k
+    pixels of the patch: correlation scores at the 3x3 integer shifts
+    (the coarse field is already sub-pixel-good, so ±1 px covers it),
+    then a separable quadratic peak fit, clamped to ±1 px. All static
+    slicing and reductions — the 9 shifted score maps are elementwise
+    multiplies of reshaped views, no gathers.
+
+    Returns (B, gh, gw, 2) field corrections (ADD to the field:
+    corrected(p) = frame(p + u(p)), so content displaced by ε relative
+    to the template peaks at shift d = ε and the fix is u += -d...
+    which this function already negates).
+    """
+    B, H, W = corrected.shape
+    gh, gw = grid
+    sh, sw = H // gh, W // gw
+    Hc, Wc = gh * sh, gw * sw  # crop to whole patches
+
+    def patches(x):  # (..., Hc, Wc) -> (..., gh, gw, sh*sw)
+        p = x[..., :Hc, :Wc].reshape(x.shape[:-2] + (gh, sh, gw, sw))
+        p = jnp.swapaxes(p, -3, -2)  # (..., gh, gw, sh, sw)
+        return p.reshape(x.shape[:-2] + (gh, gw, sh * sw))
+
+    # Center-weighted window: the field stores the displacement AT the
+    # patch center, but an unweighted correlation measures the patch-
+    # AVERAGE shift — the same averaging bias the consensus stage
+    # fights. A Gaussian window (sigma = window_frac * patch side)
+    # makes the photometric estimate local to the center while still
+    # using hundreds of pixels.
+    yy = (jnp.arange(sh, dtype=jnp.float32) - (sh - 1) / 2) / (
+        window_frac * sh
+    )
+    xx = (jnp.arange(sw, dtype=jnp.float32) - (sw - 1) / 2) / (
+        window_frac * sw
+    )
+    w = jnp.exp(-0.5 * (yy[:, None] ** 2 + xx[None, :] ** 2)).reshape(-1)
+    w = w / jnp.sum(w)
+
+    def zero_mean(p):  # weighted mean removal
+        return p - jnp.sum(w * p, axis=-1, keepdims=True)
+
+    C = zero_mean(patches(corrected))
+    T0 = zero_mean(patches(template))
+    tpad = jnp.pad(template, 1, mode="edge")
+    cpad = jnp.pad(corrected, ((0, 0), (1, 1), (1, 1)), mode="edge")
+
+    def score(dy, dx):
+        # Two-way symmetric correlation: the one-sided form (window
+        # fixed on C, T shifting) is NOT symmetric under the window —
+        # measured 0.07 px of vertex bias on IDENTICAL images. Summing
+        # the mirrored pairing (C shifting, T fixed) makes score(d) ==
+        # score(-d) exact for identical inputs, killing the bias.
+        t = zero_mean(patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]))
+        c = zero_mean(
+            patches(cpad[:, 1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W])
+        )
+        return jnp.sum(w * (C * t + c * T0), axis=-1)  # (B, gh, gw)
+
+    s_c = score(0, 0)
+    s_xm, s_xp = score(0, -1), score(0, 1)
+    s_ym, s_yp = score(-1, 0), score(1, 0)
+    # Significance gate: a featureless patch (vignetted corner,
+    # saturated region) has noise-level scores, and the monotone-
+    # surface fallback would inject a full ±1 px step from the SIGN of
+    # that noise. Require a real normalized-correlation peak — the
+    # center score against the patches' own energies — before touching
+    # the consensus field (which is strictly better there: smooth and
+    # global-blended).
+    e_c = jnp.sum(w * C * C, axis=-1)
+    e_t = jnp.sum(w * T0 * T0, axis=-1)
+    significant = s_c > 0.2 * jnp.sqrt(e_c * e_t * 4.0) + 1e-12
+    # (the factor 4 accounts for the two-way score being the sum of two
+    # correlation terms, each bounded by sqrt(e_c * e_t))
+
+    def subpixel(sm, sp):
+        denom = sm - 2.0 * s_c + sp
+        # proper peak: quadratic vertex; monotone surface: full ±1 step
+        off = jnp.where(
+            denom < -1e-12,
+            0.5 * (sm - sp) / jnp.where(denom < -1e-12, denom, -1.0),
+            jnp.sign(sp - sm),
+        )
+        return jnp.clip(jnp.where(significant, off, 0.0), -1.0, 1.0)
+
+    dx = subpixel(s_xm, s_xp)
+    dy = subpixel(s_ym, s_yp)
+    # content displaced by ε peaks at shift d = ε; the field fix is -d
+    return -jnp.stack([dx, dy], axis=-1)

@@ -35,6 +35,7 @@ spacing (worst case sqrt(2) ≈ 1.41) would not give.
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax.numpy as jnp
@@ -59,11 +60,15 @@ def octave_sizes(
     return out
 
 
+@functools.lru_cache(maxsize=64)
 def resize_matrix(n_in: int, n_out: int) -> np.ndarray:
     """(n_out, n_in) antialiased-linear (triangle/area) resampling
     matrix in the pixel-center convention. Shared, JAX-free constant —
     the NumPy backend applies the identical matrix, so both backends
-    compute the same pyramid up to float summation order."""
+    compute the same pyramid up to float summation order. Cached: the
+    NumPy backend calls this per frame, and the per-row moment
+    correction below is a Python loop worth building exactly once per
+    (n_in, n_out)."""
     s = n_in / n_out
     w = max(s, 1.0)
     centers = (np.arange(n_out, dtype=np.float64) + 0.5) * s - 0.5
@@ -132,8 +137,8 @@ def merge_octave_keypoints(
     set in BASE-frame coordinates.
 
     per_octave: [(Keypoints with (B, K_o, ...) fields, desc (B, K_o,
-    W))] per octave. Returns (Keypoints (B, ΣK_o, ...), desc); the
-    octave id of each slot is the static layout `octave_ids` describes.
+    W))] per octave. Returns (Keypoints (B, ΣK_o, ...), desc); slots
+    are laid out octave-major (octave o's K_o slots are contiguous).
     """
     xs, ss, vs, ds = [], [], [], []
     for (kp, desc), oc in zip(per_octave, octaves):
@@ -149,14 +154,6 @@ def merge_octave_keypoints(
             valid=jnp.concatenate(vs, axis=1),
         ),
         jnp.concatenate(ds, axis=1),
-    )
-
-
-def octave_ids(k_per_octave: list[int]) -> np.ndarray:
-    """(ΣK_o,) int32 octave id per merged keypoint slot — a trace-time
-    constant (slot layout is static)."""
-    return np.concatenate(
-        [np.full(k, o, np.int32) for o, k in enumerate(k_per_octave)]
     )
 
 

@@ -33,27 +33,34 @@ from kcmc_tpu.parallel.mesh import FRAME_AXIS
 def make_sharded_batch_fn(local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS):
     """Wrap a local batch program into a sharded one.
 
-    local_batch_fn(frames, ref_xy, ref_desc, ref_valid, indices) -> dict
-    is the backend's full single-chip batch program (vmapped stages +
-    batch-level Pallas kernels); indices are GLOBAL frame indices, so
-    per-frame RANSAC keys stay device-count-independent.
+    local_batch_fn(frames, ref_xy, ref_desc, ref_valid, ref_frame,
+    indices) -> dict is the backend's full single-chip batch program
+    (vmapped stages + batch-level Pallas kernels); indices are GLOBAL
+    frame indices, so per-frame RANSAC keys stay device-count-
+    independent.
 
     Returns a jitted fn whose frame-axis inputs/outputs are sharded over
-    `mesh`; ref_* inputs are sharded over the *keypoint* axis and
-    all-gathered on device.
+    `mesh`; ref_* inputs are sharded over the *keypoint* axis (the
+    reference frame over its row axis) and all-gathered on device.
     """
 
-    def local_block(frames, ref_xy, ref_desc, ref_valid, indices):
+    def local_block(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices):
         # One all-gather per reference array: K/n -> K on every chip.
         ref_xy = lax.all_gather(ref_xy, axis, tiled=True)
         ref_desc = lax.all_gather(ref_desc, axis, tiled=True)
         ref_valid = lax.all_gather(ref_valid, axis, tiled=True)
-        return local_batch_fn(frames, ref_xy, ref_desc, ref_valid, indices)
+        return local_batch_fn(
+            frames, ref_xy, ref_desc, ref_valid, ref_frame, indices
+        )
 
     sharded = shard_map(
         local_block,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
+        # ref_frame is REPLICATED (one frame of pixels, consumed whole
+        # by the photometric polish; its row count — e.g. a 12-deep
+        # volume — need not divide the mesh, unlike the keypoint
+        # arrays, whose K is mesh-padded by construction).
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(axis)),
         out_specs=P(axis),
         check_vma=False,
     )
@@ -61,9 +68,14 @@ def make_sharded_batch_fn(local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS):
 
 
 def shard_reference(ref: dict, mesh: Mesh, axis: str = FRAME_AXIS) -> dict:
-    """Lay out prepared reference arrays sharded over the keypoint axis."""
+    """Lay out prepared reference arrays sharded over the keypoint axis
+    (the reference FRAME is replicated — see make_sharded_batch_fn)."""
     sh = NamedSharding(mesh, P(axis))
-    return {k: jax.device_put(v, sh) for k, v in ref.items()}
+    rep = NamedSharding(mesh, P())
+    return {
+        k: jax.device_put(v, rep if k == "frame" else sh)
+        for k, v in ref.items()
+    }
 
 
 def shard_frames(frames, mesh: Mesh, axis: str = FRAME_AXIS):

@@ -195,7 +195,7 @@ def stage_breakdown(
 
     def p_full(frames):
         return fn_full(
-            frames, ref["xy"], ref["desc"], ref["valid"],
+            frames, ref["xy"], ref["desc"], ref["valid"], ref["frame"],
             jnp.arange(frames.shape[0], dtype=jnp.uint32),
         )
 

@@ -79,9 +79,15 @@ ref_sharded = {
     )
     for k in ("xy", "desc", "valid")
 }
+# the reference FRAME is replicated over the mesh (both hosts hold it)
+rep = NamedSharding(mesh, P())
+ref_frame = jax.make_array_from_process_local_data(
+    rep, np.asarray(ref["frame"], np.float32)
+)
 
 out = fn(
-    frames, ref_sharded["xy"], ref_sharded["desc"], ref_sharded["valid"], idx
+    frames, ref_sharded["xy"], ref_sharded["desc"], ref_sharded["valid"],
+    ref_frame, idx,
 )
 
 # every host checks ITS addressable transform shards against the truth

@@ -137,12 +137,11 @@ def test_piecewise_parity_and_recovery():
     ej = field_rmse(rj.fields, gt_rel)
     en = field_rmse(rn.fields, gt_rel)
     cross = field_rmse(rj.fields, rn.fields)
-    # Absolute bounds (measured 2026-07-31: both backends 0.54 px field
-    # RMSE — representation bias of the 8x8 patch grid, see DESIGN.md —
-    # cross 0.026 px). 0.8 fails a 1.5x ground-truth regression; the
-    # cross bound is deliberately looser (~6x delivered) because patch-
-    # level RANSAC agreement is noisier than the matrix models', yet
-    # still ~4x tighter than the old 1.0 px tolerance.
-    assert ej < 0.8, f"jax piecewise field RMSE {ej:.3f}"
-    assert en < 0.8, f"numpy piecewise field RMSE {en:.3f}"
-    assert cross < 0.15, f"cross-backend field RMSE {cross:.3f}"
+    # Absolute bounds (measured 2026-07-31, round 4, with the
+    # correlation polish: both backends 0.26 px field RMSE on this
+    # 160²/5px-disp workload, cross 0.011 px). 0.4 fails a ~1.5x
+    # ground-truth regression; the cross bound keeps ~5x headroom for
+    # patch-level RANSAC noise while staying 3x tighter than before.
+    assert ej < 0.4, f"jax piecewise field RMSE {ej:.3f}"
+    assert en < 0.4, f"numpy piecewise field RMSE {en:.3f}"
+    assert cross < 0.05, f"cross-backend field RMSE {cross:.3f}"
