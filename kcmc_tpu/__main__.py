@@ -62,6 +62,12 @@ def _parse_reference_and_overrides(args):
         overrides["quality_metrics"] = True
     if getattr(args, "template_update", 0):
         overrides["template_update_every"] = args.template_update
+    if getattr(args, "octaves", 0):
+        overrides["n_octaves"] = args.octaves
+    if getattr(args, "match_radius", 0):
+        overrides["match_radius"] = args.match_radius
+    if getattr(args, "field_polish", -1) >= 0:
+        overrides["field_polish"] = args.field_polish
     return ref, overrides
 
 
@@ -182,6 +188,14 @@ def _correct_volumetric(args) -> int:
             "--stall-exit is not supported with --model rigid3d (the "
             "in-memory volumetric path has no progress watchdog)"
         )
+    # Construct (and so config-validate) BEFORE the multi-GB page read:
+    # a 2D-only flag (--octaves, --match-radius) must fail fast, not
+    # after minutes of loading.
+    ref, overrides = _parse_reference_and_overrides(args)
+    mc = MotionCorrector(
+        model="rigid3d", backend=args.backend, reference=ref, **overrides
+    )
+
     pages = read_stack(args.stack, n_threads=args.io_threads)
     T, rem = divmod(len(pages), D)
     if rem:
@@ -189,11 +203,6 @@ def _correct_volumetric(args) -> int:
             f"{len(pages)} pages is not a whole number of {D}-deep volumes"
         )
     stack = pages.reshape(T, D, *pages.shape[1:])
-    ref, overrides = _parse_reference_and_overrides(args)
-
-    mc = MotionCorrector(
-        model="rigid3d", backend=args.backend, reference=ref, **overrides
-    )
     res = mc.correct(
         stack, progress=args.progress, output_dtype=args.output_dtype
     )
@@ -378,6 +387,22 @@ def main(argv=None) -> int:
         help="exit(3) after this many seconds of zero frame progress "
         "(wedged device link); rerun with the same --checkpoint to "
         "resume. Set well above the first batch's compile time.",
+    )
+    p.add_argument(
+        "--octaves", type=int, default=0,
+        help="ORB scale-pyramid octave count (2D models): 3 extends "
+        "the zoom envelope from ±25%% to ~2x at ~2x per-frame cost; "
+        "0/1 = single-scale (default)",
+    )
+    p.add_argument(
+        "--match-radius", type=float, default=0,
+        help="spatially-banded matching radius, px (the scale path for "
+        "very high keypoint counts; 0 = dense matching, default)",
+    )
+    p.add_argument(
+        "--field-polish", type=int, default=-1,
+        help="piecewise photometric polish passes (default 1; 2 = best "
+        "accuracy at ~15%% throughput; 0 = off)",
     )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
