@@ -68,6 +68,8 @@ def _parse_reference_and_overrides(args):
         overrides["match_radius"] = args.match_radius
     if getattr(args, "field_polish", -1) >= 0:
         overrides["field_polish"] = args.field_polish
+    if getattr(args, "transform_polish", -1) >= 0:
+        overrides["transform_polish"] = args.transform_polish
     return ref, overrides
 
 
@@ -403,6 +405,12 @@ def main(argv=None) -> int:
         "--field-polish", type=int, default=-1,
         help="piecewise photometric polish passes (default 1; 2 = best "
         "accuracy at ~15%% throughput; 0 = off)",
+    )
+    p.add_argument(
+        "--transform-polish", type=int, default=-1,
+        help="photometric transform-polish passes for the matrix "
+        "models (default 1 — breaks the keypoint-noise accuracy "
+        "floor, ~3-10x lower RMSE; 0 = off)",
     )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)

@@ -495,9 +495,27 @@ class JaxBackend:
                 out["corrected"], out["warp_ok"] = corrected, ok
             else:
                 out = dict(out)
-                out["corrected"], out["warp_ok"] = batch_warp(
-                    frames, out["transform"]
-                )
+                corrected, ok = batch_warp(frames, out["transform"])
+                for _ in range(int(cfg.transform_polish)):
+                    from kcmc_tpu.ops.polish import polish_transforms
+
+                    # Photometric polish: measure the warped frames'
+                    # per-region residual shifts against the template,
+                    # fit the model family's own update, compose, and
+                    # re-warp (ops/polish.py — the piecewise
+                    # field_polish mechanism for matrix models).
+                    # Frames the bounded kernel zeroed have no pixels
+                    # to correlate — keep their transform for the host
+                    # rescue path.
+                    newM = polish_transforms(
+                        corrected, ref_frame, out["transform"],
+                        cfg.model, grid=cfg.polish_grid,
+                    )
+                    out["transform"] = jnp.where(
+                        ok[:, None, None], newM, out["transform"]
+                    )
+                    corrected, ok = batch_warp(frames, out["transform"])
+                out["corrected"], out["warp_ok"] = corrected, ok
             return out
 
         return local
@@ -545,7 +563,7 @@ class JaxBackend:
 
         return local
 
-    def rescue_warp(self, frames, out: dict) -> np.ndarray:
+    def rescue_warp(self, frames, out: dict, ref: dict | None = None) -> np.ndarray:
         """Exact unbounded resample for frames a bounded gather-free
         kernel flagged (`warp_ok` False): the consensus transform/field
         is correct far beyond the warp kernels' static motion bounds
@@ -555,6 +573,13 @@ class JaxBackend:
         frames: (n, H, W) or (n, D, H, W); out: the per-frame outputs
         (already host/NumPy, sliced to the same n frames). Returns the
         corrected frames.
+
+        With `ref` and a 2D matrix model, the photometric transform
+        polish runs here too (the in-program polish skipped these
+        frames — their bounded-warp output was zeroed, leaving nothing
+        to correlate): same passes, measured on the exact gather-warped
+        pixels. `out["transform"]` is updated in place so the exported
+        transforms match the rescued pixels.
         """
         cfg = self.config
         frames = jnp.asarray(frames, jnp.float32)
@@ -576,7 +601,19 @@ class JaxBackend:
             return np.asarray(jax.vmap(warp_volume)(frames, transforms))
         from kcmc_tpu.ops.warp import warp_frame
 
-        return np.asarray(jax.vmap(warp_frame)(frames, transforms))
+        corrected = jax.vmap(warp_frame)(frames, transforms)
+        if ref is not None and ref.get("frame") is not None:
+            from kcmc_tpu.ops.polish import polish_transforms
+
+            ref_frame = jnp.asarray(ref["frame"], jnp.float32)
+            for _ in range(int(cfg.transform_polish)):
+                transforms = polish_transforms(
+                    corrected, ref_frame, transforms, cfg.model,
+                    grid=cfg.polish_grid,
+                )
+                corrected = jax.vmap(warp_frame)(frames, transforms)
+            out["transform"] = np.asarray(transforms)
+        return np.asarray(corrected)
 
     @staticmethod
     def _on_accelerator() -> bool:

@@ -84,30 +84,25 @@ def coverage_masks_np(shape, out: dict) -> np.ndarray:
     return np.stack([fn(shape, M) for M in Ms])
 
 
-def _corr_polish_np(
+def _measure_shifts_np(
     corrected: np.ndarray, template: np.ndarray, grid
-) -> np.ndarray:
-    """NumPy mirror of ops/piecewise.correlation_polish (one frame):
+) -> tuple[np.ndarray, np.ndarray]:
+    """NumPy mirror of ops/polish.measure_shifts (one frame):
     center-weighted two-way symmetric cross-correlation at the 3x3
-    integer shifts, separable quadratic peak fit, clamped to ±1 px."""
+    integer shifts, separable quadratic peak fit, clamped to ±1 px,
+    plus the normalized-correlation significance gate. Returns
+    (d (gh, gw, 2), significant (gh, gw))."""
+    from kcmc_tpu.ops.polish import region_patches, region_window
+
     H, W = corrected.shape
     gh, gw = grid
     sh, sw = H // gh, W // gw
-    Hc, Wc = gh * sh, gw * sw
     window_frac = 0.25
 
     def patches(x):
-        return (
-            x[:Hc, :Wc]
-            .reshape(gh, sh, gw, sw)
-            .swapaxes(1, 2)
-            .reshape(gh, gw, sh * sw)
-        )
+        return region_patches(x, grid)
 
-    yy = (np.arange(sh) - (sh - 1) / 2) / (window_frac * sh)
-    xx = (np.arange(sw) - (sw - 1) / 2) / (window_frac * sw)
-    w = np.exp(-0.5 * (yy[:, None] ** 2 + xx[None, :] ** 2)).reshape(-1)
-    w = (w / w.sum()).astype(np.float64)
+    w = region_window(sh, sw, window_frac, xp=np).astype(np.float64)
 
     def zero_mean(p):
         return p - np.sum(w * p, axis=-1, keepdims=True)
@@ -125,7 +120,7 @@ def _corr_polish_np(
     s_c = score(0, 0)
     s_xm, s_xp = score(0, -1), score(0, 1)
     s_ym, s_yp = score(-1, 0), score(1, 0)
-    # significance gate — mirror of ops/piecewise.correlation_polish
+    # significance gate — mirror of ops/polish.measure_shifts
     e_c = np.sum(w * C * C, axis=-1)
     e_t = np.sum(w * T0 * T0, axis=-1)
     significant = s_c > 0.2 * np.sqrt(e_c * e_t * 4.0) + 1e-12
@@ -138,9 +133,54 @@ def _corr_polish_np(
             )
         return np.clip(np.where(significant, off, 0.0), -1.0, 1.0)
 
-    return -np.stack(
+    d = np.stack(
         [subpixel(s_xm, s_xp), subpixel(s_ym, s_yp)], axis=-1
     ).astype(np.float32)
+    return d, significant
+
+
+def _corr_polish_np(
+    corrected: np.ndarray, template: np.ndarray, grid
+) -> np.ndarray:
+    """NumPy mirror of ops/piecewise.correlation_polish (one frame):
+    the negated measured shifts, added to the displacement field."""
+    d, _ = _measure_shifts_np(corrected, template, grid)
+    return -d
+
+
+def _polish_transform_np(
+    corrected: np.ndarray, template: np.ndarray, M: np.ndarray,
+    model_name: str, grid,
+) -> np.ndarray:
+    """NumPy mirror of ops/polish.polish_transforms (one frame):
+    measure per-region residual shifts of the warped frame against the
+    template, fit the model family's weighted solver to the region
+    correspondences (c -> c - d, significance-gated weights), and
+    compose M' = M @ A."""
+    H, W = corrected.shape
+    gh, gw = grid
+    d, sig = _measure_shifts_np(corrected, template, grid)
+    # Coverage gate — mirror of ops/polish.polish_transforms: regions
+    # whose Gaussian window sees the warp's out-of-coverage zeros
+    # (>= 2% window mass) measure template content against synthetic
+    # black; drop them from the fit.
+    from kcmc_tpu.ops.polish import region_patches, region_window
+
+    cov = _coverage_mask_np((H, W), M).astype(np.float64)
+    w = region_window(H // gh, W // gw, 0.25, xp=np)
+    sig = sig & ((region_patches(cov, grid) * w).sum(-1) >= 0.98)
+    cy = (np.arange(gh, dtype=np.float64) + 0.5) * H / gh - 0.5
+    cx = (np.arange(gw, dtype=np.float64) + 0.5) * W / gw - 0.5
+    centers = np.stack(np.meshgrid(cx, cy, indexing="xy"), axis=-1).reshape(-1, 2)
+    wts = sig.reshape(-1).astype(np.float64)
+    solve, min_samples, _d = K.SOLVERS[model_name]
+    # same well-posedness margin as ops/polish.polish_transforms
+    if wts.sum() < 2.0 * min_samples:
+        return M
+    A = solve(centers, centers - d.reshape(-1, 2), wts)
+    if not np.all(np.isfinite(A)):
+        return M
+    return (M.astype(np.float64) @ A).astype(np.float32)
 
 
 def _sanitize_nonfinite_np(frame: np.ndarray) -> np.ndarray:
@@ -359,8 +399,16 @@ class NumpyBackend:
                 out["n_keypoints"].pop()
                 out["n_keypoints"].append(np.int32(valid2.sum()))
                 M = (M @ Mr).astype(np.float32)
+            corrected = K.warp_frame(frame, M)
+            for _ in range(int(cfg.transform_polish)):
+                # photometric transform polish — mirror of the jax
+                # backend's ops/polish.polish_transforms + re-warp
+                M = _polish_transform_np(
+                    corrected, ref["frame"], M, cfg.model, cfg.polish_grid
+                )
+                corrected = K.warp_frame(frame, M)
             out["transform"].append(M)
-            out["corrected"].append(K.warp_frame(frame, M))
+            out["corrected"].append(corrected)
             out["n_inliers"].append(np.int32(n_in))
             out["rms_residual"].append(np.float32(rms))
 

@@ -148,6 +148,25 @@ class CorrectorConfig:
     # maps per batch; default 1 keeps the v5e above 1000 fps — set 2
     # when accuracy matters more than ~15% throughput.
     field_polish: int = 1
+    # Photometric TRANSFORM polish passes for the 2D matrix models
+    # (0 = off): the same correlation mechanism as field_polish applied
+    # to translation/rigid/similarity/affine/homography — after the
+    # batch warp, measure per-region residual shifts of the corrected
+    # frames against the template over `polish_grid`, fit the model
+    # family's own weighted solver to the region correspondences, and
+    # compose (ops/polish.polish_transforms). Attacks the 0.04-0.06 px
+    # keypoint-localization floor of the matrix configs the same way
+    # field_polish broke the piecewise floor. Ignored for 3D stacks
+    # and the piecewise model (which has field_polish). Frames the
+    # bounded warp kernels flagged (warp_ok False) keep their
+    # unpolished transform and take the host rescue path as before.
+    transform_polish: int = 1
+    # Region grid for the transform polish's shift measurement. 4x4 on
+    # a 512² frame gives 16 regions of ~16k pixels — enough
+    # correspondences for every family (homography needs >= 8
+    # significant regions to update) at ~1/4 the correlation
+    # bandwidth of the piecewise 8x8 grid.
+    polish_grid: tuple[int, int] = (4, 4)
 
     # -- diagnostics -------------------------------------------------------
     # Per-frame Pearson correlation between each corrected frame and the
@@ -285,6 +304,20 @@ class CorrectorConfig:
         if int(self.field_polish) < 0:
             raise ValueError(
                 f"field_polish must be >= 0 passes, got {self.field_polish}"
+            )
+        if int(self.transform_polish) < 0:
+            raise ValueError(
+                "transform_polish must be >= 0 passes, got "
+                f"{self.transform_polish}"
+            )
+        if (
+            not isinstance(self.polish_grid, (tuple, list))
+            or len(self.polish_grid) != 2
+            or any(not isinstance(g, int) or g < 1 for g in self.polish_grid)
+        ):
+            raise ValueError(
+                "polish_grid must be two positive ints, got "
+                f"{self.polish_grid!r}"
             )
         if self.patch_model not in (
             "translation", "rigid", "similarity", "affine"

@@ -1063,8 +1063,20 @@ class MotionCorrector:
         corrected = np.array(host["corrected"])
         # round/clip like every other cast when the batch came back in
         # an integer output dtype (device-side cast path)
-        corrected[bad] = _cast_output(rescue(frames, sub), corrected.dtype)
+        import inspect
+
+        if "ref" in inspect.signature(rescue).parameters:
+            rescued = rescue(frames, sub, ref=ref)
+        else:  # older backend plugins without the polish-capable seam
+            rescued = rescue(frames, sub)
+        corrected[bad] = _cast_output(rescued, corrected.dtype)
         host["corrected"] = corrected
+        if "transform" in sub and "transform" in host:
+            # the rescue path may have photometrically polished the
+            # flagged frames' transforms — export must match pixels
+            transforms = np.array(host["transform"])
+            transforms[bad] = sub["transform"]
+            host["transform"] = transforms
         host["warp_ok"] = np.ones_like(ok)
         if "template_corr" in host and ref is not None and "frame" in ref:
             from kcmc_tpu.backends.numpy_backend import (

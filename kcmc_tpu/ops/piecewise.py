@@ -285,89 +285,17 @@ def correlation_polish(
     patch, each localized to ~0.2-0.3 px — a noise floor the smoothing
     passes can't beat. This NoRMCorre-style polish measures the
     REMAINING shift of every patch photometrically, using all ~4k
-    pixels of the patch: correlation scores at the 3x3 integer shifts
-    (the coarse field is already sub-pixel-good, so ±1 px covers it),
-    then a separable quadratic peak fit, clamped to ±1 px. All static
-    slicing and reductions — the 9 shifted score maps are elementwise
-    multiplies of reshaped views, no gathers.
+    pixels of the patch. The measurement core (center-weighted window,
+    two-way symmetric scoring, significance gate, quadratic peak fit)
+    lives in ops/polish.measure_shifts, shared with the matrix-model
+    transform polish since round 5.
 
     Returns (B, gh, gw, 2) field corrections (ADD to the field:
     corrected(p) = frame(p + u(p)), so content displaced by ε relative
     to the template peaks at shift d = ε and the fix is u += -d...
     which this function already negates).
     """
-    B, H, W = corrected.shape
-    gh, gw = grid
-    sh, sw = H // gh, W // gw
-    Hc, Wc = gh * sh, gw * sw  # crop to whole patches
+    from kcmc_tpu.ops.polish import measure_shifts
 
-    def patches(x):  # (..., Hc, Wc) -> (..., gh, gw, sh*sw)
-        p = x[..., :Hc, :Wc].reshape(x.shape[:-2] + (gh, sh, gw, sw))
-        p = jnp.swapaxes(p, -3, -2)  # (..., gh, gw, sh, sw)
-        return p.reshape(x.shape[:-2] + (gh, gw, sh * sw))
-
-    # Center-weighted window: the field stores the displacement AT the
-    # patch center, but an unweighted correlation measures the patch-
-    # AVERAGE shift — the same averaging bias the consensus stage
-    # fights. A Gaussian window (sigma = window_frac * patch side)
-    # makes the photometric estimate local to the center while still
-    # using hundreds of pixels.
-    yy = (jnp.arange(sh, dtype=jnp.float32) - (sh - 1) / 2) / (
-        window_frac * sh
-    )
-    xx = (jnp.arange(sw, dtype=jnp.float32) - (sw - 1) / 2) / (
-        window_frac * sw
-    )
-    w = jnp.exp(-0.5 * (yy[:, None] ** 2 + xx[None, :] ** 2)).reshape(-1)
-    w = w / jnp.sum(w)
-
-    def zero_mean(p):  # weighted mean removal
-        return p - jnp.sum(w * p, axis=-1, keepdims=True)
-
-    C = zero_mean(patches(corrected))
-    T0 = zero_mean(patches(template))
-    tpad = jnp.pad(template, 1, mode="edge")
-    cpad = jnp.pad(corrected, ((0, 0), (1, 1), (1, 1)), mode="edge")
-
-    def score(dy, dx):
-        # Two-way symmetric correlation: the one-sided form (window
-        # fixed on C, T shifting) is NOT symmetric under the window —
-        # measured 0.07 px of vertex bias on IDENTICAL images. Summing
-        # the mirrored pairing (C shifting, T fixed) makes score(d) ==
-        # score(-d) exact for identical inputs, killing the bias.
-        t = zero_mean(patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]))
-        c = zero_mean(
-            patches(cpad[:, 1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W])
-        )
-        return jnp.sum(w * (C * t + c * T0), axis=-1)  # (B, gh, gw)
-
-    s_c = score(0, 0)
-    s_xm, s_xp = score(0, -1), score(0, 1)
-    s_ym, s_yp = score(-1, 0), score(1, 0)
-    # Significance gate: a featureless patch (vignetted corner,
-    # saturated region) has noise-level scores, and the monotone-
-    # surface fallback would inject a full ±1 px step from the SIGN of
-    # that noise. Require a real normalized-correlation peak — the
-    # center score against the patches' own energies — before touching
-    # the consensus field (which is strictly better there: smooth and
-    # global-blended).
-    e_c = jnp.sum(w * C * C, axis=-1)
-    e_t = jnp.sum(w * T0 * T0, axis=-1)
-    significant = s_c > 0.2 * jnp.sqrt(e_c * e_t * 4.0) + 1e-12
-    # (the factor 4 accounts for the two-way score being the sum of two
-    # correlation terms, each bounded by sqrt(e_c * e_t))
-
-    def subpixel(sm, sp):
-        denom = sm - 2.0 * s_c + sp
-        # proper peak: quadratic vertex; monotone surface: full ±1 step
-        off = jnp.where(
-            denom < -1e-12,
-            0.5 * (sm - sp) / jnp.where(denom < -1e-12, denom, -1.0),
-            jnp.sign(sp - sm),
-        )
-        return jnp.clip(jnp.where(significant, off, 0.0), -1.0, 1.0)
-
-    dx = subpixel(s_xm, s_xp)
-    dy = subpixel(s_ym, s_yp)
-    # content displaced by ε peaks at shift d = ε; the field fix is -d
-    return -jnp.stack([dx, dy], axis=-1)
+    d, _ = measure_shifts(corrected, template, grid, window_frac)
+    return -d

@@ -1,0 +1,233 @@
+"""Photometric (correlation) residual-shift measurement and the
+matrix-transform polish built on it.
+
+Keypoint consensus leaves every matrix model at a 0.04-0.06 px floor set
+by corner-localization noise (BENCH_r04: translation 0.043, homography
+0.062). The piecewise path broke the same floor photometrically in
+round 4 (0.386 -> 0.184 px field RMSE) by measuring each patch's
+REMAINING shift against the template from ~4k pixels instead of ~40
+matched corners (ops/piecewise.correlation_polish). This module
+generalizes that mechanism:
+
+- `measure_shifts`: the shared core — center-weighted, two-way
+  symmetric cross-correlation at the 3x3 integer shifts with a
+  separable quadratic peak fit, clamped to ±1 px, plus the
+  significance gate. Returns the measured shifts AND the gate, so
+  callers can use the gate as a fitting weight.
+- `polish_transforms`: the matrix-model polish. After the batch warp,
+  the corrected frames' per-region residual shifts d_i at region
+  centers c_i define a residual map R(p) ~ p - d(p) in reference
+  coordinates (content displaced by eps peaks at shift d = -eps; see
+  the derivation below). Fitting the model family's own weighted
+  solver to (c_i -> c_i - d_i) and composing M' = M @ A updates the
+  transform with photometric accuracy while staying exactly inside
+  the model family (a rigid stays rigid, a homography a homography).
+
+Sign/composition derivation: the batch program's convention is
+corrected(p) = frame(M p) (ref -> source map). If the corrected frame
+still shows residual content displacement eps(p) — corrected(p) =
+ref(p - eps(p)) — then ref(p) = corrected(p + eps) = frame(M (p + eps)),
+so the fixed map is M' = M o T_{+eps}. `measure_shifts` peaks at
+d = -eps (same convention as the piecewise polish, whose field fix is
+u += -d), hence A fits p -> p - d(p) and M' = M @ A. For a pure
+translation residual this reduces exactly to the piecewise update
+(t' = t - d), and for rotated/zoomed models the composition correctly
+routes the ref-space shift through M's linear part.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from kcmc_tpu.models.transforms import get_model
+
+
+def region_window(
+    sh: int, sw: int, window_frac: float, xp=jnp, dtype=None
+):
+    """Flattened, normalized center-weighted Gaussian window for an
+    (sh, sw) region — THE window of the polish family: the correlation
+    scores, the coverage gate, and the numpy mirrors must all weight
+    with the same function, so it lives in exactly one place. `xp`
+    selects the array namespace (jnp for the compiled path, np for the
+    mirrors, which weight in float64)."""
+    dtype = dtype or (jnp.float32 if xp is jnp else None)
+    yy = (xp.arange(sh, dtype=dtype) - (sh - 1) / 2) / (window_frac * sh)
+    xx = (xp.arange(sw, dtype=dtype) - (sw - 1) / 2) / (window_frac * sw)
+    w = xp.exp(-0.5 * (yy[:, None] ** 2 + xx[None, :] ** 2)).reshape(-1)
+    return w / xp.sum(w)
+
+
+def region_patches(x, grid: tuple[int, int]):
+    """(..., H, W) -> (..., gh, gw, sh*sw): crop to whole regions and
+    flatten each region's pixels (works on numpy and jax arrays — pure
+    method calls). The polish family's one region layout."""
+    gh, gw = grid
+    H, W = x.shape[-2], x.shape[-1]
+    sh, sw = H // gh, W // gw
+    p = x[..., : gh * sh, : gw * sw].reshape(x.shape[:-2] + (gh, sh, gw, sw))
+    return p.swapaxes(-3, -2).reshape(x.shape[:-2] + (gh, gw, sh * sw))
+
+
+def region_centers(grid: tuple[int, int], shape: tuple[int, int]) -> jnp.ndarray:
+    """(gh, gw, 2) cell-center (x, y) coordinates of the region grid
+    (identical convention to ops/piecewise.patch_centers; duplicated
+    here to keep the import graph acyclic — piecewise imports this
+    module for the shared measurement core)."""
+    gh, gw = grid
+    H, W = shape
+    cy = (jnp.arange(gh, dtype=jnp.float32) + 0.5) * H / gh - 0.5
+    cx = (jnp.arange(gw, dtype=jnp.float32) + 0.5) * W / gw - 0.5
+    return jnp.stack(jnp.meshgrid(cx, cy, indexing="xy"), axis=-1)
+
+
+def measure_shifts(
+    corrected: jnp.ndarray,  # (B, H, W) warped frames (ref-aligned)
+    template: jnp.ndarray,  # (H, W) reference frame
+    grid: tuple[int, int],
+    window_frac: float = 0.25,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-region photometric residual shifts of each corrected frame
+    against the template.
+
+    Correlation scores at the 3x3 integer shifts (the upstream estimate
+    is already sub-pixel-good, so ±1 px covers the residual), then a
+    separable quadratic peak fit, clamped to ±1 px. All static slicing
+    and reductions — the 9 shifted score maps are elementwise multiplies
+    of reshaped views, no gathers.
+
+    Returns (d, significant): d (B, gh, gw, 2) peak shifts — content
+    displaced by eps relative to the template peaks at d = -eps — with
+    insignificant regions zeroed; significant (B, gh, gw) bool, the
+    normalized-correlation gate (featureless regions — vignetted
+    corners, saturated areas — have noise-level scores whose SIGN would
+    otherwise inject a full ±1 px step via the monotone-surface
+    fallback).
+    """
+    B, H, W = corrected.shape
+    gh, gw = grid
+    sh, sw = H // gh, W // gw
+
+    def patches(x):
+        return region_patches(x, grid)
+
+    # Center-weighted window: the caller reads the shift AT the region
+    # center, but an unweighted correlation measures the region-AVERAGE
+    # shift — an averaging bias. A Gaussian window (sigma = window_frac
+    # * region side) makes the estimate local to the center while still
+    # using hundreds of pixels.
+    w = region_window(sh, sw, window_frac)
+
+    def zero_mean(p):  # weighted mean removal
+        return p - jnp.sum(w * p, axis=-1, keepdims=True)
+
+    C = zero_mean(patches(corrected))
+    T0 = zero_mean(patches(template))
+    tpad = jnp.pad(template, 1, mode="edge")
+    cpad = jnp.pad(corrected, ((0, 0), (1, 1), (1, 1)), mode="edge")
+
+    def score(dy, dx):
+        # Two-way symmetric correlation: the one-sided form (window
+        # fixed on C, T shifting) is NOT symmetric under the window —
+        # measured 0.07 px of vertex bias on IDENTICAL images. Summing
+        # the mirrored pairing (C shifting, T fixed) makes score(d) ==
+        # score(-d) exact for identical inputs, killing the bias.
+        t = zero_mean(patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]))
+        c = zero_mean(
+            patches(cpad[:, 1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W])
+        )
+        return jnp.sum(w * (C * t + c * T0), axis=-1)  # (B, gh, gw)
+
+    s_c = score(0, 0)
+    s_xm, s_xp = score(0, -1), score(0, 1)
+    s_ym, s_yp = score(-1, 0), score(1, 0)
+    # Significance gate: require a real normalized-correlation peak —
+    # the center score against the regions' own energies.
+    e_c = jnp.sum(w * C * C, axis=-1)
+    e_t = jnp.sum(w * T0 * T0, axis=-1)
+    significant = s_c > 0.2 * jnp.sqrt(e_c * e_t * 4.0) + 1e-12
+    # (the factor 4 accounts for the two-way score being the sum of two
+    # correlation terms, each bounded by sqrt(e_c * e_t))
+
+    def subpixel(sm, sp):
+        denom = sm - 2.0 * s_c + sp
+        # proper peak: quadratic vertex; monotone surface: full ±1 step
+        off = jnp.where(
+            denom < -1e-12,
+            0.5 * (sm - sp) / jnp.where(denom < -1e-12, denom, -1.0),
+            jnp.sign(sp - sm),
+        )
+        return jnp.clip(jnp.where(significant, off, 0.0), -1.0, 1.0)
+
+    d = jnp.stack([subpixel(s_xm, s_xp), subpixel(s_ym, s_yp)], axis=-1)
+    return d, significant
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model_name", "grid", "window_frac")
+)
+def polish_transforms(
+    corrected: jnp.ndarray,  # (B, H, W) warped frames
+    template: jnp.ndarray,  # (H, W) reference frame
+    transforms: jnp.ndarray,  # (B, 3, 3) ref -> source maps
+    model_name: str,
+    grid: tuple[int, int] = (4, 4),
+    window_frac: float = 0.25,
+) -> jnp.ndarray:
+    """One photometric polish pass for a batch of matrix transforms.
+
+    Measures per-region residual shifts on the already-warped frames,
+    fits the model family's own weighted refine solver to the region
+    correspondences (c -> c - d, weighted by the significance gate),
+    and composes M' = M @ A. Frames with too few significant regions
+    for a well-posed update (< 2x the model's minimal sample size)
+    keep their transform unchanged — as do regions the gate zeroed,
+    which contribute zero-shift support nowhere (weight 0) rather than
+    fake identity evidence.
+    """
+    model = get_model(model_name)
+    B, H, W = corrected.shape
+    d, sig = measure_shifts(corrected, template, grid, window_frac)
+    # Coverage gate: the warp writes zeros outside its source coverage,
+    # and a region whose window sees that zero boundary correlates
+    # template content against synthetic black — at large zooms (where
+    # a third of the frame is out-of-coverage) the resulting spurious
+    # shifts pass the significance gate and tilt the fit. Gate regions
+    # by their WINDOW-WEIGHTED coverage: >= 0.98 keeps ordinary drift
+    # edges (a 6 px stripe contaminates ~0.3% of an edge window — and
+    # measures fine) while dropping zoom borders (10-100% contaminated).
+    from kcmc_tpu.ops.warp import coverage_mask
+
+    cov = jax.vmap(lambda M: coverage_mask((H, W), M))(transforms)
+    covw = _windowed_mean(cov.astype(jnp.float32), grid, window_frac)
+    sig = sig & (covw >= 0.98)
+    centers = region_centers(grid, (H, W)).reshape(-1, 2)  # (P, 2)
+    # A well-posed family update needs margin beyond the minimal sample:
+    # with the default 4x4 grid that is 2 regions for translation, 8 for
+    # homography.
+    min_regions = 2.0 * float(model.min_samples)
+
+    def upd(M, di, si):
+        wts = si.reshape(-1).astype(jnp.float32)
+        A = model.resolved_refine_solve(centers, centers - di.reshape(-1, 2), wts)
+        ok = jnp.sum(wts) >= min_regions
+        A = jnp.where(ok, A, jnp.eye(3, dtype=A.dtype))
+        return jnp.matmul(M, A).astype(M.dtype)
+
+    return jax.vmap(upd)(transforms, d, sig)
+
+
+def _windowed_mean(
+    x: jnp.ndarray, grid: tuple[int, int], window_frac: float
+) -> jnp.ndarray:
+    """Per-region Gaussian-window-weighted mean of a (B, H, W) map —
+    the same window `measure_shifts` scores with (region_window), so a
+    gate on this quantity reflects exactly the pixels that influence
+    the shift."""
+    H, W = x.shape[-2], x.shape[-1]
+    gh, gw = grid
+    w = region_window(H // gh, W // gw, window_frac)
+    return jnp.sum(w * region_patches(x, grid), axis=-1)

