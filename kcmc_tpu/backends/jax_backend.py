@@ -456,8 +456,14 @@ class JaxBackend:
                 out2 = jax.vmap(tail)(corrected0, kps2, desc2, keys2)
                 coarse_matches = out["n_matches"]
                 out = dict(out2)
+                # full-f32 compose: TPU's default einsum precision is
+                # bf16-grade, and the coarse matrix carries
+                # O(frame-size) translation entries — an unpinned
+                # compose alone injects ~0.1-0.5 px of corner error at
+                # 512² (the same trap ops/polish.py documents)
                 out["transform"] = jnp.einsum(
-                    "bij,bjk->bik", coarse, out2["transform"]
+                    "bij,bjk->bik", coarse, out2["transform"],
+                    precision=jax.lax.Precision.HIGHEST,
                 )
                 # standard keys report the FINAL (fine) fit; the coarse
                 # pass's match count stays visible for diagnosis
@@ -635,6 +641,22 @@ class JaxBackend:
             math.ceil(math.tan(math.radians(cfg.max_rotation_deg)) * side / 2.0)
         )
 
+    def _matrix_resid_px(self, shape) -> int:
+        """Residual-displacement bound for the small-field matrix warp:
+        the rotation allowance (shear bound, from max_rotation_deg /
+        max_shear_px) plus the projective allowance plus a ~1.5% scale
+        margin — the three non-translation terms the kernel's canvas
+        cannot absorb. Floor of 12 keeps the default drift regime
+        rescue-free."""
+        cfg = self.config
+        scale_margin = max(4, int(cfg.max_scale_dev * max(shape) / 2) + 1)
+        return max(
+            12,
+            self._shear_bound_px(shape)
+            + cfg.max_projective_px
+            + scale_margin,
+        )
+
     def _resolve_batch_warp(self, shape):
         """Pick the batched warp implementation per the `warp` policy.
 
@@ -664,9 +686,47 @@ class JaxBackend:
             return functools.partial(
                 warp_batch_translation, interpret=interp, with_ok=True
             )
+        use_matrix = cfg.warp == "matrix" or (
+            cfg.warp == "auto"
+            and cfg.model in ("rigid", "affine", "homography")
+            and on_tpu
+        )
+        if use_matrix:
+            from kcmc_tpu.ops.warp_field import warp_batch_matrix
+
+            # Single-interpolation small-field kernel: exact to ~1e-4
+            # px vs the gather warp (the 4-pass separable chain's
+            # ~0.012 px artifact was fine until the round-5 photometric
+            # polish started feeding warped pixels back into the
+            # transform — it converged to the artifact's optimum, 0.055
+            # px from truth for homography). Similarity stays on the
+            # separable chain below: its zoom envelope (±25%) is far
+            # beyond any practical residual bound, while the scale
+            # matmul passes handle zoom unbounded.
+            return functools.partial(
+                warp_batch_matrix,
+                max_px=self._matrix_resid_px(shape),
+                with_ok=True,
+            )
+        if cfg.warp == "separable" and cfg.model == "homography":
+            # Explicit zoom-unbounded homography route: the separable
+            # affine chain for the first-order part plus the small-
+            # field kernel for the projective residual. The auto path
+            # prefers warp_batch_matrix (one interpolation, exact to
+            # ~1e-4 px); this chain stays selectable for projective
+            # content whose zoom exceeds the matrix kernel's residual
+            # bound.
+            from kcmc_tpu.ops.warp_field import warp_batch_homography
+
+            return functools.partial(
+                warp_batch_homography,
+                shear_px=self._shear_bound_px(shape),
+                max_px=cfg.max_projective_px,
+                with_ok=True,
+            )
         use_separable = cfg.warp == "separable" or (
             cfg.warp == "auto"
-            and cfg.model in ("translation", "rigid", "similarity", "affine")
+            and cfg.model in ("translation", "similarity")
             and on_tpu
         )
         if use_separable:
@@ -681,15 +741,6 @@ class JaxBackend:
             return functools.partial(
                 warp_batch_affine,
                 shear_px=shear,
-                with_ok=True,
-            )
-        if cfg.warp == "auto" and cfg.model == "homography" and on_tpu:
-            from kcmc_tpu.ops.warp_field import warp_batch_homography
-
-            return functools.partial(
-                warp_batch_homography,
-                shear_px=self._shear_bound_px(shape),
-                max_px=cfg.max_projective_px,
                 with_ok=True,
             )
         return warp_batch_with_ok

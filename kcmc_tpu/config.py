@@ -197,13 +197,18 @@ class CorrectorConfig:
     # Warp kernel selection: "jnp" = XLA gather warp (all models, exact,
     # slow on TPU); "pallas" = gather-free Pallas kernel (translation
     # only); "separable" = gather-free shear/scale multi-pass (affine
-    # family); "auto" = on an accelerator, the gather-free kernel for the
-    # model (pallas for translation, separable for rigid/affine, the
-    # affine+residual-field split for homography, the translation+
-    # residual-field split for piecewise) and jnp elsewhere. The
-    # gather-free kernels are bounded: frames whose motion exceeds the
-    # max_*_px bounds below are zeroed and flagged in the per-frame
-    # `warp_ok` diagnostic instead of being silently mis-resampled.
+    # family); "matrix" = gather-free single-interpolation small-field
+    # kernel (rigid/affine/homography — exact to ~1e-4 px vs the gather
+    # warp, where the 4-pass separable chain deviates ~0.012 px; see
+    # ops/warp_field.warp_batch_matrix); "auto" = on an accelerator,
+    # the gather-free kernel for the model (pallas for translation,
+    # matrix for rigid/affine/homography, separable for similarity —
+    # its scale passes are unbounded in zoom where the matrix kernel's
+    # residual bound is not — and the translation+residual-field split
+    # for piecewise) and jnp elsewhere. The gather-free kernels are
+    # bounded: frames whose motion exceeds the max_*_px bounds below
+    # are zeroed and flagged in the per-frame `warp_ok` diagnostic
+    # instead of being silently mis-resampled.
     warp: str = "auto"
     # Exact-warp rescue: frames whose motion exceeded a gather-free
     # kernel's static bound (warp_ok False) are re-resampled on the host
@@ -240,6 +245,13 @@ class CorrectorConfig:
     # Static bound on the projective residual after the homography's
     # first-order affine part is factored out.
     max_projective_px: int = 4
+    # Scale-deviation allowance of the matrix warp kernel: fractional
+    # zoom the residual bound must cover (margin px = max_scale_dev *
+    # frame_side / 2, so 0.02 = ±2% zoom at any size). The matrix
+    # kernel's cost is linear in the total bound; content that zooms
+    # beyond a few percent belongs on warp='separable', whose scale
+    # passes are unbounded in zoom.
+    max_scale_dev: float = 0.02
 
     def __post_init__(self):
         if self.blur_sigma <= 0.0:
@@ -331,10 +343,23 @@ class CorrectorConfig:
                 "rescue_warn_fraction must be in (0, 1], got "
                 f"{self.rescue_warn_fraction}"
             )
-        if self.warp not in ("auto", "jnp", "pallas", "separable"):
+        if self.warp not in ("auto", "jnp", "pallas", "separable", "matrix"):
             raise ValueError(
-                "warp must be 'auto', 'jnp', 'pallas', or 'separable', "
-                f"got {self.warp!r}"
+                "warp must be 'auto', 'jnp', 'pallas', 'separable', or "
+                f"'matrix', got {self.warp!r}"
+            )
+        if self.warp == "matrix" and self.model not in (
+            "translation", "rigid", "affine", "homography"
+        ):
+            # similarity is deliberately rejected: its zoom envelope
+            # (±25%) is far beyond any practical residual bound, and
+            # the separable chain's scale passes handle zoom unbounded
+            # — a blessed matrix+similarity combo would rescue-storm
+            # on zooming content.
+            raise ValueError(
+                "warp='matrix' resamples bounded-residual 2D matrix "
+                f"transforms; model {self.model!r} needs "
+                "warp='separable' (zoom-unbounded) or 'jnp' (or 'auto')"
             )
         if self.warp == "pallas" and self.model != "translation":
             raise ValueError(
@@ -342,10 +367,11 @@ class CorrectorConfig:
                 f"model {self.model!r} needs warp='jnp' (or 'auto')"
             )
         if self.warp == "separable" and self.model not in (
-            "translation", "rigid", "similarity", "affine"
+            "translation", "rigid", "similarity", "affine", "homography"
         ):
             raise ValueError(
-                "warp='separable' resamples affine-family transforms; "
+                "warp='separable' resamples affine-family transforms "
+                "(plus homography via the affine+residual split); "
                 f"model {self.model!r} needs warp='jnp' (or 'auto')"
             )
 

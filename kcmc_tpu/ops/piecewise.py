@@ -202,10 +202,15 @@ def estimate_field(
         )
         # Displacement AT the patch center (for translation this is
         # just the constant; for affine it reads the local first-order
-        # fit at the one point the field stores).
+        # fit at the one point the field stores). Precision pin: TPU's
+        # default matmul precision is bf16-grade even for a 2x2 matvec,
+        # and `center` carries O(frame-size) coordinates — unpinned,
+        # this line alone can cost ~0.5 px (see ops/polish.py).
         M = res.transform
         disp = (
-            M[:2, :2] @ center + M[:2, 2] - center
+            jnp.matmul(
+                M[:2, :2], center, precision=jax.lax.Precision.HIGHEST
+            ) + M[:2, 2] - center
         )
         # Trust region: a degenerate multi-DoF patch fit (few, near-
         # collinear members) can land far from any data-supported
@@ -247,7 +252,10 @@ def estimate_field(
                 n_hypotheses=patch_hyps, threshold=patch_threshold,
             )
             M = res.transform
-            disp = M[:2, :2] @ center + M[:2, 2] - center
+            # precision pin: same bf16 trap as the first-pass site above
+            disp = jnp.matmul(
+                M[:2, :2], center, precision=jax.lax.Precision.HIGHEST
+            ) + M[:2, 2] - center
             # Trust region: members passed the residual gate
             # (< 2x patch_threshold), so a genuine correction is
             # bounded by it; a degenerate fit beyond that is clamped.

@@ -111,8 +111,13 @@ def test_out_of_bound_telemetry_warns_and_escalates():
     rescued = np.asarray(res.diagnostics["warp_rescued"])
     assert rescued[:2].any()  # early batches hit the bounded kernel
     assert not rescued[-2:].any()  # post-escalation batches don't rescue
-    np.testing.assert_allclose(res.corrected, ref.corrected, atol=1e-5)
-    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
+    # Rescued frames' photometric polish runs in its own jit (host
+    # rescue path) while the reference run polishes in-program; the
+    # correlation sums' float association differs, so transforms agree
+    # to ~1e-4 px rather than bitwise (pre-round-5 the two paths were
+    # identical because nothing fed warped pixels back).
+    np.testing.assert_allclose(res.corrected, ref.corrected, atol=1e-3)
+    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-4)
 
     # escalation off: warn-only, every flagged frame rescues
     with pytest.warns(RuntimeWarning, match="persistently"):
