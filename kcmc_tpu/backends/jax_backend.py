@@ -686,6 +686,24 @@ class JaxBackend:
             return functools.partial(
                 warp_batch_translation, interpret=interp, with_ok=True
             )
+        from kcmc_tpu.ops.pallas_warp import supports_strips
+
+        if (
+            cfg.warp == "auto"
+            and cfg.model == "translation"
+            and on_tpu
+            and supports_strips(shape)
+        ):
+            # Large-frame route (1024²/2048²): the whole-frame window
+            # exceeds VMEM, but row strips with a 2*PAD halo fit at any
+            # height — replaces the separable scale-matmul fallback's
+            # ~1.4 ms/frame at 2048² with ~0.3 (DESIGN.md "Large-frame
+            # support", round-5 build of the round-4 sizing).
+            from kcmc_tpu.ops.pallas_warp import warp_batch_translation_strips
+
+            return functools.partial(
+                warp_batch_translation_strips, with_ok=True
+            )
         use_matrix = cfg.warp == "matrix" or (
             cfg.warp == "auto"
             and cfg.model in ("rigid", "affine", "homography")

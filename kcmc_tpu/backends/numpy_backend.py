@@ -85,13 +85,15 @@ def coverage_masks_np(shape, out: dict) -> np.ndarray:
 
 
 def _measure_shifts_np(
-    corrected: np.ndarray, template: np.ndarray, grid
+    corrected: np.ndarray, template: np.ndarray, grid, exact: bool = False
 ) -> tuple[np.ndarray, np.ndarray]:
     """NumPy mirror of ops/polish.measure_shifts (one frame):
     center-weighted two-way symmetric cross-correlation at the 3x3
     integer shifts, separable quadratic peak fit, clamped to ±1 px,
     plus the normalized-correlation significance gate. Returns
-    (d (gh, gw, 2), significant (gh, gw))."""
+    (d (gh, gw, 2), significant (gh, gw)). `exact` mirrors the jax
+    path's split: the per-region estimator (piecewise field polish)
+    vs the index-shifted ring-window formulation (matrix polish)."""
     from kcmc_tpu.ops.polish import region_patches, region_window
 
     H, W = corrected.shape
@@ -102,27 +104,66 @@ def _measure_shifts_np(
     def patches(x):
         return region_patches(x, grid)
 
-    w = region_window(sh, sw, window_frac, xp=np).astype(np.float64)
+    if exact:
+        w = region_window(sh, sw, window_frac, xp=np, ring=False).astype(
+            np.float64
+        )
 
-    def zero_mean(p):
-        return p - np.sum(w * p, axis=-1, keepdims=True)
+        def zero_mean(p):
+            return p - np.sum(w * p, axis=-1, keepdims=True)
 
-    C = zero_mean(patches(corrected))
-    T0 = zero_mean(patches(template))
-    tpad = np.pad(template, 1, mode="edge")
-    cpad = np.pad(corrected, 1, mode="edge")
+        C = zero_mean(patches(corrected))
+        T0 = zero_mean(patches(template))
+        tpad = np.pad(template, 1, mode="edge")
+        cpad = np.pad(corrected, 1, mode="edge")
 
-    def score(dy, dx):
-        t = zero_mean(patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]))
-        c = zero_mean(patches(cpad[1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W]))
-        return np.sum(w * (C * t + c * T0), axis=-1)
+        def score(dy, dx):
+            t = zero_mean(
+                patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W])
+            )
+            c = zero_mean(
+                patches(cpad[1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W])
+            )
+            return np.sum(w * (C * t + c * T0), axis=-1)
 
-    s_c = score(0, 0)
-    s_xm, s_xp = score(0, -1), score(0, 1)
-    s_ym, s_yp = score(-1, 0), score(1, 0)
+        s_c = score(0, 0)
+        s_xm, s_xp = score(0, -1), score(0, 1)
+        s_ym, s_yp = score(-1, 0), score(1, 0)
+        e_c = np.sum(w * C * C, axis=-1)
+        e_t = np.sum(w * T0 * T0, axis=-1)
+    else:
+        w = region_window(sh, sw, window_frac, xp=np).astype(np.float64)
+
+        def zero_mean(p):
+            return p - np.sum(w * p, axis=-1, keepdims=True)
+
+        # index-shifted two-term structure — mirror of the round-5
+        # measure_shifts rewrite (template-side arrays shift; the
+        # batch side is read once per term)
+        CP = patches(corrected).astype(np.float64)
+        V = w * zero_mean(CP)
+        T0 = zero_mean(patches(template).astype(np.float64))
+        shifts = [(0, 0), (0, -1), (0, 1), (-1, 0), (1, 0)]
+        tpad = np.pad(template.astype(np.float64), 1, mode="edge")
+        t0w = (w * T0).reshape(gh, gw, sh, sw)
+        t0w = t0w.swapaxes(1, 2).reshape(gh * sh, gw * sw)
+        t0wpad = np.pad(t0w, ((1, 1 + H - gh * sh), (1, 1 + W - gw * sw)))
+        scores = [
+            np.sum(
+                V * patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]),
+                axis=-1,
+            )
+            + np.sum(
+                CP
+                * patches(t0wpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]),
+                axis=-1,
+            )
+            for dy, dx in shifts
+        ]
+        s_c, s_xm, s_xp, s_ym, s_yp = scores
+        e_c = np.sum(V * CP, axis=-1)
+        e_t = np.sum(w * T0 * T0, axis=-1)
     # significance gate — mirror of ops/polish.measure_shifts
-    e_c = np.sum(w * C * C, axis=-1)
-    e_t = np.sum(w * T0 * T0, axis=-1)
     significant = s_c > 0.2 * np.sqrt(e_c * e_t * 4.0) + 1e-12
 
     def subpixel(sm, sp):
@@ -144,7 +185,7 @@ def _corr_polish_np(
 ) -> np.ndarray:
     """NumPy mirror of ops/piecewise.correlation_polish (one frame):
     the negated measured shifts, added to the displacement field."""
-    d, _ = _measure_shifts_np(corrected, template, grid)
+    d, _ = _measure_shifts_np(corrected, template, grid, exact=True)
     return -d
 
 

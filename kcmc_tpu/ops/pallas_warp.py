@@ -110,35 +110,15 @@ def _warp_kernel(iscal_ref, fscal_ref, src_ref, out_ref):
     out_ref[:, :] = jnp.where(inb, blend, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "with_ok"))
-def warp_batch_translation(
-    frames: jnp.ndarray,
-    transforms: jnp.ndarray,
-    interpret: bool = False,
-    with_ok: bool = False,
-) -> jnp.ndarray:
-    """Correct (B, H, W) frames under pure translations.
-
-    transforms: (B, 3, 3) matrices [[1,0,tx],[0,1,ty],[0,0,1]]. Matches
-    `vmap(warp_frame)` up to float rounding, with zero gathers on TPU.
-    `with_ok` also returns the (B,) bool flag marking frames whose shift
-    was within the +-PAD exactness window (False = frame zeroed).
-    """
-    B, H, W = frames.shape
+def _shift_scalars(transforms: jnp.ndarray, extra=None):
+    """Shared host prologue of both translation kernels: split the
+    per-frame shift into window origin + bilinear fraction, apply the
+    ±PAD exactness rule, and pack the SMEM scalar operands. `extra`
+    (optional (B,) float) rides in fscal slot 5 — the strip kernel's
+    true-height channel. Returns (iscal (B,2) i32, fscal (B,8) f32,
+    exact (B,) f32)."""
     tx = transforms[:, 0, 2]
     ty = transforms[:, 1, 2]
-    # Edge-pad so interior blends clamp exactly like the gather version.
-    # The padded dims are additionally rounded up to TPU tile alignment
-    # (8 sublanes x 128 lanes — Mosaic's dynamic rotate rejects unaligned
-    # shapes); the extra edge rows/cols sit beyond every reachable window
-    # (max read row = oy + H <= H + 2*PAD - 1 < the aligned height).
-    Hp = -(-(H + 2 * PAD) // 8) * 8
-    Wp = -(-(W + 2 * PAD) // 128) * 128
-    padded = jnp.pad(
-        frames,
-        ((0, 0), (PAD, Hp - H - PAD), (PAD, Wp - W - PAD)),
-        mode="edge",
-    )
     y0 = jnp.floor(ty)
     x0 = jnp.floor(tx)
     fy = ty - y0
@@ -155,8 +135,41 @@ def warp_batch_translation(
     iscal = jnp.stack([oy, ox], axis=-1)  # (B, 2) int32
     zeros = jnp.zeros_like(fy)
     fscal = jnp.stack(
-        [fy, fx, ty, tx, exact, zeros, zeros, zeros], axis=-1
+        [fy, fx, ty, tx, exact, extra if extra is not None else zeros,
+         zeros, zeros],
+        axis=-1,
     )  # (B, 8) float32
+    return iscal, fscal, exact
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "with_ok"))
+def warp_batch_translation(
+    frames: jnp.ndarray,
+    transforms: jnp.ndarray,
+    interpret: bool = False,
+    with_ok: bool = False,
+) -> jnp.ndarray:
+    """Correct (B, H, W) frames under pure translations.
+
+    transforms: (B, 3, 3) matrices [[1,0,tx],[0,1,ty],[0,0,1]]. Matches
+    `vmap(warp_frame)` up to float rounding, with zero gathers on TPU.
+    `with_ok` also returns the (B,) bool flag marking frames whose shift
+    was within the +-PAD exactness window (False = frame zeroed).
+    """
+    B, H, W = frames.shape
+    # Edge-pad so interior blends clamp exactly like the gather version.
+    # The padded dims are additionally rounded up to TPU tile alignment
+    # (8 sublanes x 128 lanes — Mosaic's dynamic rotate rejects unaligned
+    # shapes); the extra edge rows/cols sit beyond every reachable window
+    # (max read row = oy + H <= H + 2*PAD - 1 < the aligned height).
+    Hp = -(-(H + 2 * PAD) // 8) * 8
+    Wp = -(-(W + 2 * PAD) // 128) * 128
+    padded = jnp.pad(
+        frames,
+        ((0, 0), (PAD, Hp - H - PAD), (PAD, Wp - W - PAD)),
+        mode="edge",
+    )
+    iscal, fscal, exact = _shift_scalars(transforms)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -173,6 +186,137 @@ def warp_batch_translation(
         out_shape=jax.ShapeDtypeStruct((B, H, W), jnp.float32),
         interpret=interpret,
     )(iscal, fscal, padded.astype(jnp.float32))
+    return (out, exact > 0.5) if with_ok else out
+
+
+_STRIP_ROWS = 128  # output rows per strip program (256 measured a
+# 17.4 MB Mosaic scoped-vmem allocation at 2048² vs the 16 MB limit —
+# the roll copies and blend temporaries roughly double the in-block
+# budget; 128 compiles at 2048² with ~3 MB headroom)
+
+
+def supports_strips(shape: tuple[int, int]) -> bool:
+    """Whether the ROW-STRIP translation kernel fits VMEM for this
+    frame shape — the large-frame route (DESIGN.md "Large-frame
+    support, round 4" item 1, built in round 5). The whole-frame
+    kernel gates at ~512²; strips hold (STRIP + 2*PAD) rows instead of
+    the frame, so the budget depends on width only: ~11.5 MB at 2048²,
+    ~21 MB at 4096² (beyond the scoped budget — fall back)."""
+    H, W = shape
+    Wp = -(-(W + 2 * PAD) // 128) * 128
+    rows = _STRIP_ROWS + 2 * PAD
+    # in-block appears ~2x (source + rotate), output once
+    return (2 * rows * Wp + _STRIP_ROWS * W) * 4 <= _VMEM_BUDGET
+
+
+def _warp_kernel_strip(iscal_ref, fscal_ref, src_ref, out_ref):
+    """One program per (frame, row strip). Identical math to
+    _warp_kernel over a (STRIP + 2*PAD)-row window; the validity mask
+    offsets the row iota by the strip's base row (static per program)."""
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    y0 = iscal_ref[b, 0]
+    x0 = iscal_ref[b, 1]
+    fy = fscal_ref[b, 0]
+    fx = fscal_ref[b, 1]
+    ty = fscal_ref[b, 2]
+    tx = fscal_ref[b, 3]
+    exact = fscal_ref[b, 4]
+    true_h = fscal_ref[b, 5]  # unpadded frame height (for the mask)
+
+    R, W = out_ref.shape
+    Hp, Wp = src_ref.shape
+    full = src_ref[:, :]
+    full = pltpu.roll(full, Hp - y0, 0)
+    full = pltpu.roll(full, Wp - x0, 1)
+    win = full[: R + 1, : W + 1]
+    w00 = (1.0 - fy) * (1.0 - fx)
+    w01 = (1.0 - fy) * fx
+    w10 = fy * (1.0 - fx)
+    w11 = fy * fx
+    blend = (
+        w00 * win[:-1, :-1]
+        + w01 * win[:-1, 1:]
+        + w10 * win[1:, :-1]
+        + w11 * win[1:, 1:]
+    )
+    base = s * R
+    rows = (
+        jax.lax.broadcasted_iota(jnp.int32, (R, W), 0).astype(jnp.float32)
+        + base + ty
+    )
+    out_rows = (
+        jax.lax.broadcasted_iota(jnp.int32, (R, W), 0).astype(jnp.float32)
+        + base
+    )
+    cols = jax.lax.broadcasted_iota(jnp.int32, (R, W), 1).astype(jnp.float32) + tx
+    inb = (
+        (rows >= 0.0) & (rows <= true_h - 1.0)
+        & (cols >= 0.0) & (cols <= W - 1.0)
+        & (out_rows <= true_h - 1.0)  # rows padded up to a strip multiple
+        & (exact > 0.5)
+    )
+    out_ref[:, :] = jnp.where(inb, blend, 0.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "with_ok"))
+def warp_batch_translation_strips(
+    frames: jnp.ndarray,
+    transforms: jnp.ndarray,
+    interpret: bool = False,
+    with_ok: bool = False,
+) -> jnp.ndarray:
+    """Row-strip variant of `warp_batch_translation` for frames whose
+    whole-frame window exceeds VMEM (`supports` False, `supports_strips`
+    True — 1024²/2048²). Strips of _STRIP_ROWS output rows, each with a
+    2*PAD-row halo, are stacked on the host into an extra array axis
+    the grid walks (the column-paneled detect pattern, ops/
+    pallas_detect.response_fields_paneled) — strip windows overlap, so
+    they cannot be expressed as Pallas block indexing directly.
+    Same exactness window (±PAD) and out-of-bounds semantics as the
+    whole-frame kernel.
+    """
+    B, H, W = frames.shape
+    R = _STRIP_ROWS
+    S = -(-H // R)
+    Wp = -(-(W + 2 * PAD) // 128) * 128
+    # rows: PAD halo + strip-multiple padding; edge-pad like the
+    # whole-frame kernel so interior blends clamp like the gather warp.
+    padded = jnp.pad(
+        frames,
+        ((0, 0), (PAD, PAD + S * R - H), (PAD, Wp - W - PAD)),
+        mode="edge",
+    )
+    # host-side strip stacking: (B, S, R + 2*PAD, Wp)
+    strips = jnp.stack(
+        [
+            jax.lax.slice_in_dim(padded, s * R, s * R + R + 2 * PAD, axis=1)
+            for s in range(S)
+        ],
+        axis=1,
+    )
+    hh = jnp.full((B,), float(H), jnp.float32)
+    iscal, fscal, exact = _shift_scalars(transforms, extra=hh)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (None, None, R + 2 * PAD, Wp),
+                lambda b, s, iscal: (b, s, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((None, R, W), lambda b, s, iscal: (b, s, 0)),
+    )
+    out = pl.pallas_call(
+        _warp_kernel_strip,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S * R, W), jnp.float32),
+        interpret=interpret,
+    )(iscal, fscal, strips.astype(jnp.float32))
+    out = out[:, :H, :]
     return (out, exact > 0.5) if with_ok else out
 
 

@@ -46,19 +46,44 @@ from kcmc_tpu.models.transforms import get_model
 
 
 def region_window(
-    sh: int, sw: int, window_frac: float, xp=jnp, dtype=None
+    sh: int, sw: int, window_frac: float, xp=jnp, dtype=None,
+    ring: bool = True,
 ):
     """Flattened, normalized center-weighted Gaussian window for an
     (sh, sw) region — THE window of the polish family: the correlation
     scores, the coverage gate, and the numpy mirrors must all weight
     with the same function, so it lives in exactly one place. `xp`
     selects the array namespace (jnp for the compiled path, np for the
-    mirrors, which weight in float64)."""
-    dtype = dtype or (jnp.float32 if xp is jnp else None)
-    yy = (xp.arange(sh, dtype=dtype) - (sh - 1) / 2) / (window_frac * sh)
-    xx = (xp.arange(sw, dtype=dtype) - (sw - 1) / 2) / (window_frac * sw)
-    w = xp.exp(-0.5 * (yy[:, None] ** 2 + xx[None, :] ** 2)).reshape(-1)
-    return w / xp.sum(w)
+    mirrors, which weight in float64).
+
+    With `ring` (default), the outer 1-px ring is zeroed (~0.2-0.7% of
+    the mass): it makes measure_shifts' index-shifted two-term
+    formulation EXACTLY equivalent to the per-region form for the
+    ±1 px shifts it scores — without the ring, the region-border pixels
+    re-pair across the shift and bias the quadratic vertex by
+    ~0.01-0.02 px (measured at 160²). The piecewise field polish uses
+    ring=False with the exact per-region formulation instead (its r4
+    accuracy record is pinned to that estimator).
+
+    Built in float64 numpy (sh/sw are static) and cast, so the compiled
+    path and the mirrors share bit-identical constants."""
+    import numpy as _np
+
+    yy = (_np.arange(sh, dtype=_np.float64) - (sh - 1) / 2) / (
+        window_frac * sh
+    )
+    xx = (_np.arange(sw, dtype=_np.float64) - (sw - 1) / 2) / (
+        window_frac * sw
+    )
+    w2 = _np.exp(-0.5 * (yy[:, None] ** 2 + xx[None, :] ** 2))
+    if ring and sh > 2 and sw > 2:
+        mask = _np.zeros((sh, sw))
+        mask[1:-1, 1:-1] = 1.0
+        w2 = w2 * mask
+    w = (w2 / w2.sum()).reshape(-1)
+    if xp is jnp:
+        return jnp.asarray(w, dtype or jnp.float32)
+    return w.astype(dtype) if dtype else w
 
 
 def region_patches(x, grid: tuple[int, int]):
@@ -89,6 +114,7 @@ def measure_shifts(
     template: jnp.ndarray,  # (H, W) reference frame
     grid: tuple[int, int],
     window_frac: float = 0.25,
+    exact: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Per-region photometric residual shifts of each corrected frame
     against the template.
@@ -124,30 +150,92 @@ def measure_shifts(
     def zero_mean(p):  # weighted mean removal
         return p - jnp.sum(w * p, axis=-1, keepdims=True)
 
-    C = zero_mean(patches(corrected))
-    T0 = zero_mean(patches(template))
-    tpad = jnp.pad(template, 1, mode="edge")
-    cpad = jnp.pad(corrected, ((0, 0), (1, 1), (1, 1)), mode="edge")
+    # Two-way symmetric correlation: the one-sided form (window fixed
+    # on C, T shifting) is NOT symmetric under the window — measured
+    # 0.07 px of vertex bias on IDENTICAL images. Summing the mirrored
+    # pairing (C shifting, T fixed) makes score(d) == score(-d) exact
+    # for identical inputs, killing the bias.
+    #
+    # Bandwidth structure (the polish is pure HBM traffic): the naive
+    # form reads the corrected batch ~18x (5 scores x shifted views x
+    # two terms). Both terms are rewritten so only BATCH-INDEPENDENT
+    # template-side stacks shift, and the 5 scores become two MXU
+    # contractions that read the batch arrays ONCE each:
+    #   term1(d) = sum_p w.C(p) . T(p+d)          (C's zero-mean makes
+    #              t's mean term vanish, so raw shifted T suffices)
+    #   term2(d) = sum_p w(p).c(p-d).T0(p)
+    #            = sum_q corrected(q) . (w.T0)(q+d)  — index-shifted
+    #              onto the template side. EXACT because the window's
+    #              outer 1-px ring is zero (region_window): the only
+    #              pixels the shift re-pairs across region borders
+    #              carry zero weight on both sides.
+    # Identical-input symmetry stays exact: term1(d) + term2(d) =
+    # sum w.C.(C(p+d) + C(p-d)).
+    if exact:
+        # Per-region formulation with the full (ring-less) window — the
+        # piecewise field polish's estimator, pinned to its round-4
+        # accuracy record (0.184/0.134 px; the fast path below measures
+        # +0.02-0.03 px on the field workload's pass-2 convergence).
+        # ~18 batch-array passes; the 8x8 field grid pays it on far
+        # fewer pixels per region than the matrix polish.
+        w = region_window(sh, sw, window_frac, ring=False)
 
-    def score(dy, dx):
-        # Two-way symmetric correlation: the one-sided form (window
-        # fixed on C, T shifting) is NOT symmetric under the window —
-        # measured 0.07 px of vertex bias on IDENTICAL images. Summing
-        # the mirrored pairing (C shifting, T fixed) makes score(d) ==
-        # score(-d) exact for identical inputs, killing the bias.
-        t = zero_mean(patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W]))
-        c = zero_mean(
-            patches(cpad[:, 1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W])
+        def zero_mean_x(p):
+            return p - jnp.sum(w * p, axis=-1, keepdims=True)
+
+        C = zero_mean_x(patches(corrected))
+        T0 = zero_mean_x(patches(template))
+        tpad = jnp.pad(template, 1, mode="edge")
+        cpad = jnp.pad(corrected, ((0, 0), (1, 1), (1, 1)), mode="edge")
+
+        def score(dy, dx):
+            t = zero_mean_x(
+                patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W])
+            )
+            c = zero_mean_x(
+                patches(cpad[:, 1 - dy : 1 - dy + H, 1 - dx : 1 - dx + W])
+            )
+            return jnp.sum(w * (C * t + c * T0), axis=-1)
+
+        s_c = score(0, 0)
+        s_xm, s_xp = score(0, -1), score(0, 1)
+        s_ym, s_yp = score(-1, 0), score(1, 0)
+        e_c = jnp.sum(w * C * C, axis=-1)
+        e_t = jnp.sum(w * T0 * T0, axis=-1)
+    else:
+        CP = patches(corrected)  # (B, gh, gw, S)
+        V = w * zero_mean(CP)
+        T0 = zero_mean(patches(template))
+
+        shifts = [(0, 0), (0, -1), (0, 1), (-1, 0), (1, 0)]
+        tpad = jnp.pad(template, 1, mode="edge")
+        tstack = jnp.stack(
+            [
+                patches(tpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W])
+                for dy, dx in shifts
+            ]
+        )  # (5, gh, gw, S)
+        # full-image (w . T0) layout for the index-shifted second term
+        t0w = (w * T0).reshape(gh, gw, sh, sw)
+        t0w = jnp.swapaxes(t0w, 1, 2).reshape(gh * sh, gw * sw)
+        t0wpad = jnp.pad(t0w, ((1, 1 + H - gh * sh), (1, 1 + W - gw * sw)))
+        ustack = jnp.stack(
+            [
+                patches(t0wpad[1 + dy : 1 + dy + H, 1 + dx : 1 + dx + W])
+                for dy, dx in shifts
+            ]
+        )  # (5, gh, gw, S)
+        hi = jax.lax.Precision.HIGHEST
+        scores = jnp.einsum("bghs,nghs->nbgh", V, tstack, precision=hi)
+        scores = scores + jnp.einsum(
+            "bghs,nghs->nbgh", CP, ustack, precision=hi
         )
-        return jnp.sum(w * (C * t + c * T0), axis=-1)  # (B, gh, gw)
-
-    s_c = score(0, 0)
-    s_xm, s_xp = score(0, -1), score(0, 1)
-    s_ym, s_yp = score(-1, 0), score(1, 0)
+        s_c, s_xm, s_xp, s_ym, s_yp = scores
+        # e_c = sum w.C^2 == sum V.CP exactly (the mean term cancels).
+        e_c = jnp.sum(V * CP, axis=-1)
+        e_t = jnp.sum(w * T0 * T0, axis=-1)
     # Significance gate: require a real normalized-correlation peak —
     # the center score against the regions' own energies.
-    e_c = jnp.sum(w * C * C, axis=-1)
-    e_t = jnp.sum(w * T0 * T0, axis=-1)
     significant = s_c > 0.2 * jnp.sqrt(e_c * e_t * 4.0) + 1e-12
     # (the factor 4 accounts for the two-way score being the sum of two
     # correlation terms, each bounded by sqrt(e_c * e_t))

@@ -40,3 +40,40 @@ def test_batch(img):
     for i in range(3):
         ref = np.asarray(warp_frame(frames[i], mats[i]))
         np.testing.assert_allclose(out[i], ref, atol=1e-5)
+
+
+def test_strip_kernel_matches_whole_frame():
+    """The round-5 row-strip variant (large-frame route) must agree
+    with the whole-frame kernel wherever both run — same exactness
+    window, same out-of-bounds semantics — including shifts near ±PAD
+    and a height that does not divide the strip size."""
+    import jax
+
+    from kcmc_tpu.ops.pallas_warp import (
+        PAD,
+        _STRIP_ROWS,
+        supports_strips,
+        warp_batch_translation_strips,
+    )
+
+    assert supports_strips((1024, 1024)) and supports_strips((2048, 2048))
+    rng = np.random.default_rng(9)
+    H = _STRIP_ROWS + 40  # ragged final strip
+    img = jnp.asarray(synthetic.render_scene(rng, (H, 160), n_blobs=60))
+    shifts = [
+        (0.0, 0.0), (3.4, -2.6), (-30.25, 17.5),
+        (PAD - 1.5, -(PAD - 1.5)),  # near the exactness window edge
+        (PAD + 40.0, 0.0),  # beyond it: frame must zero, ok False
+    ]
+    frames = jnp.stack([img] * len(shifts))
+    Ms = jnp.stack([_mat(tx, ty) for tx, ty in shifts])
+    ref, ok_ref = warp_batch_translation(frames, Ms, interpret=True, with_ok=True)
+    out, ok = warp_batch_translation_strips(
+        frames, Ms, interpret=True, with_ok=True
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    assert not np.asarray(ok)[-1]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    # and against the gather warp directly
+    gat = np.asarray(jax.vmap(warp_frame)(frames[:4], Ms[:4]))
+    np.testing.assert_allclose(np.asarray(out)[:4], gat, atol=1e-4)

@@ -80,10 +80,20 @@ def test_measure_shifts_matches_piecewise_polish():
         np.roll(template, (0, 1), axis=(0, 1)),
         template + rng.normal(0, 0.01, template.shape).astype(np.float32),
     ])
-    d, sig = measure_shifts(jnp.asarray(corrected), jnp.asarray(template), (4, 4))
+    # exact=True selects the per-region estimator the piecewise polish
+    # is pinned to (the default is the matrix polish's bandwidth-
+    # restructured formulation — a deliberately different estimator)
+    d, sig = measure_shifts(
+        jnp.asarray(corrected), jnp.asarray(template), (4, 4), exact=True
+    )
     delta = correlation_polish(jnp.asarray(corrected), jnp.asarray(template), (4, 4))
     np.testing.assert_array_equal(np.asarray(delta), -np.asarray(d))
     assert np.asarray(sig).any()
+    # and the two estimators agree to sub-pixel scale on a plain shift
+    d2, _ = measure_shifts(
+        jnp.asarray(corrected), jnp.asarray(template), (4, 4)
+    )
+    assert np.abs(np.asarray(d2) - np.asarray(d)).max() < 0.1
 
 
 def test_polish_coverage_gate_blocks_zoom_borders():

@@ -185,8 +185,9 @@ def test_checkpointed_run_never_escalates(tmp_path):
 def test_translation_auto_falls_back_beyond_pallas_vmem(monkeypatch):
     """The whole-frame Pallas translation kernel VMEM-OOMs at compile
     time beyond ~512^2 (measured 20.5 MB scoped vmem at 1024^2 vs the
-    16 MB limit); warp='auto' must route large frames to the separable
-    pass chain instead of dying in the compiler."""
+    16 MB limit); warp='auto' must route large frames to the ROW-STRIP
+    Pallas kernel (round 5) — and frames beyond even the strip budget
+    to the separable pass chain — instead of dying in the compiler."""
     from kcmc_tpu.backends.jax_backend import JaxBackend
     from kcmc_tpu.config import CorrectorConfig
     from kcmc_tpu.ops import pallas_warp
@@ -194,10 +195,15 @@ def test_translation_auto_falls_back_beyond_pallas_vmem(monkeypatch):
     assert pallas_warp.supports((512, 512))
     assert not pallas_warp.supports((1024, 1024))
     assert not pallas_warp.supports((2048, 2048))
+    assert pallas_warp.supports_strips((1024, 1024))
+    assert pallas_warp.supports_strips((2048, 2048))
+    assert not pallas_warp.supports_strips((2048, 8192))
 
     backend = JaxBackend(CorrectorConfig(model="translation", warp="auto"))
     monkeypatch.setattr(JaxBackend, "_on_accelerator", staticmethod(lambda: True))
     small = backend._resolve_batch_warp((512, 512))
     large = backend._resolve_batch_warp((1024, 1024))
+    huge = backend._resolve_batch_warp((2048, 8192))
     assert "warp_batch_translation" in repr(small.func)
-    assert "warp_batch_affine" in repr(large.func)
+    assert "warp_batch_translation_strips" in repr(large.func)
+    assert "warp_batch_affine" in repr(huge.func)
