@@ -305,6 +305,12 @@ def main() -> None:
             }),
             ("piecewise", "piecewise", {}),
             ("homography", "homography", {}),
+            # Scale-pyramid path (round-4 capability, benched since
+            # round 5 per VERDICT r4 item 7): similarity drift with the
+            # generator's ±3% zoom walk through n_octaves=3 — records
+            # the pyramid + coarse-to-fine + polish path's fps and RMSE
+            # so a regression there is driver-visible round over round.
+            ("pyramid", "similarity", {"n_octaves": 3}),
         ]
         if args.all:
             rows = [

@@ -118,6 +118,33 @@ class JaxBackend:
         self.config = config
         self.mesh = mesh  # jax.sharding.Mesh: shard frame batches over it
         self._batch_fns: dict[Any, Any] = {}
+        if mesh is not None:
+            # ADVICE r4: the reference keypoint arrays enter shard_map
+            # sharded over K, so K must divide the mesh — and with
+            # n_octaves > 1 the MERGED K is n_octaves * ceil(max_kp /
+            # (n_octaves * 8)) * 8 (e.g. 4104 for 4096 over 3 octaves),
+            # only guaranteed a multiple of 8. Validate here with the
+            # real number instead of failing at shard_map trace time.
+            n = int(np.prod(mesh.devices.shape))
+            if config.n_octaves > 1:
+                from kcmc_tpu.ops.pyramid import per_octave_k
+
+                K = sum(per_octave_k(config.max_keypoints, config.n_octaves))
+                hint = (
+                    f" (n_octaves={config.n_octaves} merges "
+                    f"{K // config.n_octaves} keypoints per octave)"
+                )
+            else:
+                K = config.max_keypoints
+                hint = ""
+            if K % n:
+                raise ValueError(
+                    f"reference keypoint count K={K}{hint} must divide "
+                    f"the mesh's {n} devices for the sharded reference "
+                    "all-gather; pick max_keypoints so the "
+                    "(octave-merged) total is a multiple of the device "
+                    "count"
+                )
 
     # -- reference preparation --------------------------------------------
 

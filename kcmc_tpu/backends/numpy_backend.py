@@ -242,6 +242,19 @@ class NumpyBackend:
     accepts_native_dtype = True
 
     def __init__(self, config: CorrectorConfig, **_options):
+        if config.match_radius is not None:
+            # ADVICE r4: silently running the dense matcher here would
+            # give a banded config different matcher SEMANTICS per
+            # backend (candidate universe, ratio-test second-best,
+            # capacity drops) with no warning — refuse instead.
+            raise ValueError(
+                "backend='numpy' has no banded-matching mirror; "
+                "match_radius configs change matcher semantics (bounded "
+                "candidate universe, bucket capacities) that the dense "
+                "NumPy matcher cannot reproduce. Use backend='jax' for "
+                "banded matching, or match_radius=None with the numpy "
+                "oracle."
+            )
         self.config = config
 
     def _detect_describe_2d(self, frame: np.ndarray, multi_scale=True):

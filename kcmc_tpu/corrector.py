@@ -248,7 +248,7 @@ def apply_correction_file(
     `apply_correction`; BigTIFF engages automatically past 4 GiB.
     """
     from kcmc_tpu.io import ChunkedStackLoader, open_stack
-    from kcmc_tpu.io.tiff import TiffWriter
+    from kcmc_tpu.io.formats import make_writer
 
     if (transforms is None) == (fields is None):
         raise ValueError("pass exactly one of transforms= or fields=")
@@ -263,8 +263,9 @@ def apply_correction_file(
         if len(ts.frame_shape) != 2:
             raise ValueError("apply_correction_file covers 2D stacks only")
         out_dt = _resolve_apply_dtype(output_dtype, ts)
-        writer = TiffWriter(
-            output, compression=compression,
+        writer = make_writer(
+            output, len(ts), ts.frame_shape, out_dt,
+            compression=compression,
             bigtiff=_wants_bigtiff(len(ts), ts.frame_shape, out_dt),
         )
         loader = ChunkedStackLoader(ts, chunk_size=chunk_size)
@@ -1267,7 +1268,9 @@ class MotionCorrector:
                 if state is not None and state[0].get("sig") == ckpt_sig:
                     meta, segments = state
                     try:
-                        writer = TiffWriter.resume(
+                        from kcmc_tpu.io.formats import resume_writer
+
+                        writer = resume_writer(
                             output, meta["writer"], compression=compression
                         )
                         start = int(meta["done"])
@@ -1285,10 +1288,14 @@ class MotionCorrector:
                         writer, start, outs, n_parts = None, 0, [], 0
                 # signature mismatch: stale checkpoint, restart
             if writer is None and output:
-                # BigTIFF sizing (e.g. the 512x512x10k-frame judged
+                # Extension-dispatched: .zarr -> ZarrWriter, else TIFF
+                # with BigTIFF sizing (e.g. the 512x512x10k-frame judged
                 # stack at uint16 is 5 GB); both decoders read it back.
-                writer = TiffWriter(
-                    output, compression=compression,
+                from kcmc_tpu.io.formats import make_writer
+
+                writer = make_writer(
+                    output, len(ts), ts.frame_shape, out_dt,
+                    compression=compression,
                     bigtiff=_wants_bigtiff(len(ts), ts.frame_shape, out_dt),
                 )
             restored = start

@@ -248,14 +248,15 @@ def describe_keypoints_batch(
     r = ROT_RADIUS if oriented else PATCH_RADIUS
     P = 2 * r + 2
     if use_pallas:
-        # Frames past the resident-frame kernel's VMEM budget (≈2048^2)
-        # take the XLA gather path: measured 17x faster there than the
-        # Element-indexed slab variant (DESIGN.md "Large-frame patch
-        # extraction"), and the whole-frame kernel would die at compile
-        # time with a scoped-vmem OOM.
-        from kcmc_tpu.ops.pallas_patch import supports as _patch_fits
+        # Frames past the resident-frame kernel's VMEM budget (≈2048²)
+        # run the ROW-BANDED resident layout (round 5 — keypoints
+        # dispatched to VMEM-sized row bands; pallas_patch.band_count);
+        # only frames beyond even the banded budget take the XLA gather
+        # path (the Element-indexed slab variant measured 17x slower
+        # there, DESIGN.md "Large-frame patch extraction").
+        from kcmc_tpu.ops.pallas_patch import band_count
 
-        use_pallas = _patch_fits(frames.shape[1:], P)
+        use_pallas = band_count(frames.shape[1:], P) >= 1
     if not use_pallas:
         def one(f, k, s=None):
             return describe_keypoints(

@@ -131,6 +131,25 @@ def _frame_fits(Hp: int, Wp: int, P: int) -> bool:
     return 2 * Hpp * Wpp * 4 <= _VMEM_FRAME_BUDGET
 
 
+def band_count(shape: tuple[int, int], P: int) -> int:
+    """Bands for the row-banded extraction layout (round 5, DESIGN.md
+    "Large-frame support" item 2): 1 = whole frame resident (use the
+    plain kernel), 2/4/8 = smallest split whose (Hb + S)-row band block
+    fits VMEM, 0 = nothing fits (callers fall back to the XLA gather
+    path). shape is the UNPADDED frame shape, as for `supports`."""
+    H, W = shape
+    r1 = (P - 2) // 2 + 1
+    Hp, Wp = H + 2 * r1, W + 2 * r1
+    if _frame_fits(Hp, Wp, P):
+        return 1
+    S, Wpp = _slab_dims(P, Wp)
+    for NB in (2, 4, 8):
+        Hb = -(-(-(-Hp // NB)) // 8) * 8
+        if 2 * (Hb + S) * Wpp * 4 <= _VMEM_FRAME_BUDGET:
+            return NB
+    return 0
+
+
 def _patch_kernel(oy_ref, ox_ref, src_ref, out_ref, *, P: int, KB: int):
     b = pl.program_id(0)
     kb = pl.program_id(1)
@@ -288,12 +307,21 @@ def extract_blended_planes(
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
     if not _frame_fits(Hp, Wp, P):
-        # Large frames (≈2048^2+): the resident-frame layout VMEM-OOMs
-        # at compile time; per-keypoint Element-indexed slabs instead.
-        # NOTE: the slab layout is exact but measured much slower than
-        # the XLA gather describe path at 2048^2 (DESIGN.md) — the
-        # production describe route gates on `supports()` and prefers
-        # the gather there; this fallback keeps the kernel API total.
+        H_unpadded = Hp - 2 * ((P - 2) // 2 + 1)
+        W_unpadded = Wp - 2 * ((P - 2) // 2 + 1)
+        NB = band_count((H_unpadded, W_unpadded), P)
+        if NB >= 2:
+            # Large frames (≈2048²+): row-banded resident layout —
+            # keypoints dispatched to row bands, each band's block fits
+            # VMEM (round 5; see _extract_blended_planes_banded).
+            return _extract_blended_planes_banded(
+                padded, oy, ox, fx, fy, P, NB,
+                with_moments=with_moments, interpret=interpret,
+            )
+        # Beyond even the banded budget: per-keypoint Element-indexed
+        # slabs. NOTE: exact but measured much slower than the XLA
+        # gather describe path (DESIGN.md) — kept so the kernel API is
+        # total.
         return _extract_blended_planes_slab(
             padded, oy, ox, fx, fy, P,
             with_moments=with_moments, interpret=interpret,
@@ -352,6 +380,189 @@ def extract_blended_planes(
     if with_moments:
         return pb[:, :K], m10[:, :K], m01[:, :K]
     return pb[:, :K]
+
+
+def _extract_blended_planes_banded(
+    padded: jnp.ndarray,
+    oy: jnp.ndarray,
+    ox: jnp.ndarray,
+    fx: jnp.ndarray,
+    fy: jnp.ndarray,
+    P: int,
+    NB: int,
+    with_moments: bool = False,
+    interpret: bool = False,
+):
+    """Row-banded variant of the resident-frame layout for frames whose
+    padded block exceeds VMEM (DESIGN.md "Large-frame support" item 2,
+    built round 5): the frame splits into NB row bands of Hb rows plus
+    an S-row halo, keypoints are laid out in band-sorted KB-ALIGNED
+    runs, and the unchanged `_blended_kernel` runs over the slot
+    blocks with the band block chosen DYNAMICALLY per program — the
+    block's band id rides in a scalar-prefetch array the frame
+    BlockSpec's index_map reads. Results gather back to original
+    keypoint order (a (B, K) row gather of small keypoint-first rows,
+    not pixels).
+
+    Unlike a fixed-capacity segment dispatch, the aligned-runs layout
+    has NO capacity drops: every keypoint gets a slot regardless of
+    density skew (a tissue scene with every keypoint in one band just
+    makes that band's run long), at a static slot count of
+    K + NB*KB — the alignment padding is the only overhead.
+    """
+    B, Hp, Wp = padded.shape
+    K = oy.shape[1]
+    KB = _KB
+    S, Wpp = _slab_dims(P, Wp)
+    Hb = -(-(-(-Hp // NB)) // 8) * 8
+    Kp = -(-K // KB) * KB + NB * KB  # aligned-runs worst case
+
+    bc = _smem_batch_limit(3, Kp, KB)
+    if B > bc:
+        return _chunk_batch(
+            lambda *a: _extract_blended_planes_banded(
+                *a, P, NB, with_moments=with_moments, interpret=interpret
+            ),
+            bc, B, (padded, oy, ox, fx, fy), with_moments,
+        )
+
+    keys = jnp.clip(oy // Hb, 0, NB - 1).astype(jnp.int32)  # (B, K)
+    order = jnp.argsort(keys, axis=1, stable=True)  # (B, K)
+    sorted_keys = jnp.take_along_axis(keys, order, axis=1)
+    bins = jnp.arange(NB, dtype=jnp.int32)
+    starts = jax.vmap(
+        lambda sk: jnp.searchsorted(sk, bins, side="left")
+    )(sorted_keys)  # (B, NB)
+    ends = jax.vmap(
+        lambda sk: jnp.searchsorted(sk, bins, side="right")
+    )(sorted_keys)
+    aligned = -(-(ends - starts) // KB) * KB  # per-band run length
+    astart = jnp.cumsum(aligned, axis=1) - aligned  # (B, NB) run starts
+    # slot of each sorted item: its band run's start + rank within band
+    rank = jnp.arange(K, dtype=jnp.int32)[None, :] - jnp.take_along_axis(
+        starts, sorted_keys, axis=1
+    )
+    slot_of_sorted = jnp.take_along_axis(astart, sorted_keys, axis=1) + rank
+
+    def scatter_slots(ordr, slots):
+        idx = jnp.zeros((Kp,), jnp.int32).at[slots].set(ordr, mode="drop")
+        ok = jnp.zeros((Kp,), bool).at[slots].set(True, mode="drop")
+        return idx, ok
+
+    flat_idx, slot_ok = jax.vmap(scatter_slots)(order, slot_of_sorted)
+    # band of every slot (alignment-padding slots included): the run
+    # layout makes it a step function of the run starts
+    slot_ids = jnp.arange(Kp, dtype=jnp.int32)
+    band_of_slot = (
+        jnp.sum(
+            slot_ids[None, :, None] >= astart[:, None, :], axis=-1
+        ).astype(jnp.int32) - 1
+    )  # (B, Kp)
+    band_of_slot = jnp.clip(band_of_slot, 0, NB - 1)
+    # blocks are KB-aligned to the runs, so a block never straddles
+    # bands: its band is its first slot's band
+    block_band = band_of_slot[:, ::KB]  # (B, Kp // KB)
+
+    take = functools.partial(jnp.take_along_axis, axis=1)
+    oy_s = take(oy, flat_idx) - band_of_slot * Hb
+    ox_s = take(ox, flat_idx)
+    fx_s = take(fx[..., 0], flat_idx)[..., None]
+    fy_s = take(fy[..., 0], flat_idx)[..., None]
+    # padding slots read the default item; harmless (masked below)
+    oy_s = jnp.clip(oy_s, 0, Hb + S - P)
+
+    # band stacking: (B, NB, Hb + S, Wpp); rows padded so every band
+    # slices cleanly, lanes padded for the kernel's 256-lane window
+    padded = jnp.pad(
+        padded,
+        ((0, 0), (0, NB * Hb + S - Hp), (0, Wpp - Wp)),
+        mode="edge",
+    )
+    bands = jnp.stack(
+        [
+            jax.lax.slice_in_dim(padded, b * Hb, b * Hb + Hb + S, axis=1)
+            for b in range(NB)
+        ],
+        axis=1,
+    )
+
+    Pb = P - 1
+    mm = _moment_maps(P)
+    mm_in = jnp.asarray(
+        np.concatenate(
+            [mm[:, :, 0].reshape(4, P, P), mm[:, :, 1].reshape(4, P, P)]
+        )
+    )
+    def kernel(band_ref, oy_ref, ox_ref, *rest):
+        # band_ref only steers the frame BlockSpec's index_map below;
+        # the extraction math is the unchanged resident-frame kernel
+        del band_ref
+        return _blended_kernel(
+            oy_ref, ox_ref, *rest, P=P, KB=KB, with_moments=with_moments
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, Kp // KB),
+        in_specs=[
+            pl.BlockSpec((None, KB, 1), lambda b, kb, bb, oy, ox: (b, kb, 0)),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, bb, oy, ox: (b, kb, 0)),
+            pl.BlockSpec((8, P, P), lambda b, kb, bb, oy, ox: (0, 0, 0)),
+            pl.BlockSpec(
+                (None, None, Hb + S, Wpp),
+                # dynamic block selection: this program's band id from
+                # the scalar-prefetch array (runs are KB-aligned, so a
+                # block never spans two bands)
+                lambda b, kb, bb, oy, ox: (b, bb[b, kb], 0, 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (None, KB, Pb, Pb), lambda b, kb, bb, oy, ox: (b, kb, 0, 0)
+            ),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, bb, oy, ox: (b, kb, 0)),
+            pl.BlockSpec((None, KB, 1), lambda b, kb, bb, oy, ox: (b, kb, 0)),
+        ],
+    )
+    pb, m10, m01 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        block_band.astype(jnp.int32),
+        oy_s.astype(jnp.int32), ox_s.astype(jnp.int32),
+        fx_s, fy_s, mm_in, bands.astype(jnp.float32),
+    )
+
+    # un-dispatch: original keypoint k's slot position (or -1 if the
+    # band capacity dropped it). Empty slots carry a CLAMPED item index
+    # (segment_by_key's sentinel) — route their scatter to the dropped
+    # out-of-bounds index so they can't clobber a real keypoint's slot.
+    slot_pos = jnp.broadcast_to(
+        jnp.arange(Kp, dtype=jnp.int32)[None, :], (B, Kp)
+    )
+
+    def invert(fi, ok, pos):
+        inv = jnp.full((K,), -1, jnp.int32)
+        return inv.at[jnp.where(ok, fi, K)].set(pos, mode="drop")
+
+    inv = jax.vmap(invert)(flat_idx, slot_ok.reshape(B, Kp), slot_pos)
+    kept = inv >= 0
+    safe = jnp.maximum(inv, 0)
+    pb_k = take(pb.reshape(B, Kp, -1), safe[..., None]).reshape(
+        B, K, Pb, Pb
+    )
+    pb_k = jnp.where(kept[..., None, None], pb_k, 0.0)
+    if with_moments:
+        m10_k = jnp.where(kept[..., None], take(m10, safe[..., None]), 0.0)
+        m01_k = jnp.where(kept[..., None], take(m01, safe[..., None]), 0.0)
+        return pb_k, m10_k, m01_k
+    return pb_k
 
 
 def _blended_slab_kernel(*refs, P: int, KB: int, with_moments: bool):

@@ -140,16 +140,6 @@ def measure_shifts(
     def patches(x):
         return region_patches(x, grid)
 
-    # Center-weighted window: the caller reads the shift AT the region
-    # center, but an unweighted correlation measures the region-AVERAGE
-    # shift — an averaging bias. A Gaussian window (sigma = window_frac
-    # * region side) makes the estimate local to the center while still
-    # using hundreds of pixels.
-    w = region_window(sh, sw, window_frac)
-
-    def zero_mean(p):  # weighted mean removal
-        return p - jnp.sum(w * p, axis=-1, keepdims=True)
-
     # Two-way symmetric correlation: the one-sided form (window fixed
     # on C, T shifting) is NOT symmetric under the window — measured
     # 0.07 px of vertex bias on IDENTICAL images. Summing the mirrored
@@ -203,6 +193,15 @@ def measure_shifts(
         e_c = jnp.sum(w * C * C, axis=-1)
         e_t = jnp.sum(w * T0 * T0, axis=-1)
     else:
+        # Center-weighted window: the caller reads the shift AT the
+        # region center, but an unweighted correlation measures the
+        # region-AVERAGE shift — an averaging bias. Gaussian, sigma =
+        # window_frac * region side; outer ring zeroed (see above).
+        w = region_window(sh, sw, window_frac)
+
+        def zero_mean(p):  # weighted mean removal
+            return p - jnp.sum(w * p, axis=-1, keepdims=True)
+
         CP = patches(corrected)  # (B, gh, gw, S)
         V = w * zero_mean(CP)
         T0 = zero_mean(patches(template))

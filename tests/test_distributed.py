@@ -106,3 +106,50 @@ def test_sharded_rigid3d_matches_single_device():
     ).correct(data.stack)
     np.testing.assert_allclose(r8.transforms, r1.transforms, atol=1e-4)
     np.testing.assert_allclose(r8.corrected, r1.corrected, atol=1e-4)
+
+
+def test_mesh_keypoint_divisibility_validated():
+    """ADVICE r4: a pyramid config whose octave-merged K does not
+    divide the mesh must fail at construction with a clear message,
+    not at shard_map trace time (merged K = n_octaves * ceil(max_kp /
+    (n_octaves * 8)) * 8 — e.g. 4104 for 4096 over 3 octaves — is only
+    guaranteed a multiple of 8)."""
+    import pytest
+
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.parallel import make_mesh
+
+    # merged K is n_octaves * (a multiple of 8), so any power-of-two
+    # mesh up to 8 divides it — the trap needs a mesh size with another
+    # prime factor (the ADVICE example was 4104 on 16 devices; with 8
+    # virtual devices, 7 plays that role: 1032 % 7 = 3)
+    with pytest.raises(ValueError, match="must divide"):
+        MotionCorrector(
+            model="similarity", backend="jax", mesh=make_mesh(7),
+            n_octaves=3, max_keypoints=1024,
+        )
+    # single-scale trap too: K = max_keypoints directly
+    with pytest.raises(ValueError, match="must divide"):
+        MotionCorrector(
+            model="translation", backend="jax", mesh=make_mesh(8),
+            max_keypoints=100,
+        )
+    # a compatible choice constructs fine
+    MotionCorrector(
+        model="similarity", backend="jax", mesh=make_mesh(8),
+        n_octaves=3, max_keypoints=1024,  # merged 1032 = 8 * 129
+    )
+
+
+def test_numpy_backend_rejects_banded_config():
+    """ADVICE r4: the numpy oracle has no banded-matching mirror; a
+    match_radius config must refuse rather than silently run the dense
+    matcher with different semantics."""
+    import pytest
+
+    from kcmc_tpu import MotionCorrector
+
+    with pytest.raises(ValueError, match="banded"):
+        MotionCorrector(
+            model="translation", backend="numpy", match_radius=32.0
+        )

@@ -211,3 +211,104 @@ def test_mini_zarr_rejects_exotic_compressor(tmp_path, drift):
     (path / ".zarray").write_text(json.dumps(meta))
     with pytest.raises(ValueError, match="blosc"):
         ZarrStack(str(path))
+
+
+def _store_bytes(path):
+    """Every chunk + metadata byte of a zarr directory store, keyed by
+    entry name (byte-identity comparison helper)."""
+    return {
+        name: (path / name).read_bytes() for name in os.listdir(path)
+    }
+
+
+@pytest.mark.parametrize("compression", ["none", "deflate"])
+def test_zarr_egress_roundtrip(tmp_path, drift, compression):
+    """Round-5 write side (VERDICT r4 item 8): zarr-in -> zarr-out with
+    no TIFF transcoding; the output store reads back through the same
+    ingest protocol with the corrected pixels."""
+    arr = _u16(drift.stack)
+    zin = tmp_path / "in.zarr"
+    _write_zarr(str(zin), arr)
+    zout = tmp_path / "out.zarr"
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=8)
+    res = mc.correct_file(
+        str(zin), output=str(zout), chunk_size=8, output_dtype="input",
+        compression=compression,
+    )
+    with open_stack(str(zout)) as ts:
+        assert len(ts) == T
+        assert ts.dtype == np.uint16
+        got = ts.read(0, T)
+    # output-file runs keep corrected out of memory; an in-memory run
+    # of the same deterministic pipeline is the pixel oracle
+    mem = MotionCorrector(
+        model="translation", backend="jax", batch_size=8
+    ).correct_file(str(zin), chunk_size=8, output_dtype="input")
+    np.testing.assert_array_equal(got, mem.corrected)
+    err = transform_rmse(
+        res.transforms, relative_transforms(drift.transforms), SHAPE
+    )
+    assert err < 0.15
+
+
+def test_zarr_egress_checkpoint_resume_byte_identical(tmp_path, drift):
+    """Kill+resume with a ZARR output: every chunk file and the
+    .zarray metadata must match an uninterrupted run byte for byte
+    (the zarr writer has no offset chain, so this must hold exactly)."""
+    arr = _u16(drift.stack)
+    zin = tmp_path / "in.zarr"
+    _write_zarr(str(zin), arr)
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=4
+    )
+    ref_out = tmp_path / "ref.zarr"
+    mk().correct_file(
+        str(zin), output=str(ref_out), chunk_size=8, output_dtype="input",
+        compression="deflate",
+    )
+
+    calls = {"n": 0}
+    orig = ChunkedStackLoader._read
+
+    def poisoned(self, lo, hi):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("simulated kill")
+        return orig(self, lo, hi)
+
+    out = tmp_path / "out.zarr"
+    ckpt = tmp_path / "run.ckpt.npz"
+    ChunkedStackLoader._read = poisoned
+    try:
+        with pytest.raises(RuntimeError, match="simulated kill"):
+            mk().correct_file(
+                str(zin), output=str(out), chunk_size=8,
+                checkpoint=str(ckpt), checkpoint_every=8,
+                output_dtype="input", compression="deflate",
+            )
+    finally:
+        ChunkedStackLoader._read = orig
+    res = mk().correct_file(
+        str(zin), output=str(out), chunk_size=8, checkpoint=str(ckpt),
+        output_dtype="input", compression="deflate",
+    )
+    assert res.timing["restored_frames"] > 0
+    assert _store_bytes(ref_out) == _store_bytes(out)
+
+
+def test_zarr_egress_apply_file(tmp_path, drift):
+    """apply_correction_file writes .zarr outputs through the same
+    factory seam."""
+    from kcmc_tpu import apply_correction_file
+
+    arr = _u16(drift.stack)
+    zin = tmp_path / "in.zarr"
+    _write_zarr(str(zin), arr)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=8)
+    res = mc.correct_file(str(zin), chunk_size=8)
+    zout = tmp_path / "applied.zarr"
+    apply_correction_file(
+        str(zin), str(zout), transforms=res.transforms, chunk_size=8
+    )
+    with open_stack(str(zout)) as ts:
+        assert len(ts) == T and ts.dtype == np.uint16

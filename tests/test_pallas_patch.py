@@ -151,3 +151,45 @@ def test_slab_variant_matches_whole_frame_kernel():
     assert pp.supports((512, 512), 32)
     assert pp.supports((1024, 1024), 32)
     assert not pp.supports((2048, 2048), 32)
+
+
+def test_banded_extraction_matches_oracle_any_density():
+    """The round-5 row-banded large-frame route must be exact for ANY
+    keypoint density pattern — the aligned-runs dispatch has no
+    per-band capacity, so a fully clustered scene (every keypoint in
+    one band: the microscopy tissue-in-top-quarter case) extracts
+    identically to a uniform one."""
+    from kcmc_tpu.ops.pallas_patch import extract_blended
+    from kcmc_tpu.utils import synthetic
+
+    rng = np.random.default_rng(4)
+    H = W = 768
+    P = 32
+    from kcmc_tpu.ops.pallas_patch import _extract_blended_planes_banded
+
+    frames = np.stack(
+        [synthetic.render_scene(rng, (H, W), n_blobs=300) for _ in range(2)]
+    )
+    r1 = (P - 2) // 2 + 1
+    padded = jnp.asarray(
+        np.pad(frames, ((0, 0), (r1, r1), (r1, r1)), mode="edge")
+    )
+    K = 64
+    for ymax in (H // 4, H - r1 - 2):  # clustered, then uniform
+        xy = np.stack([
+            np.stack(
+                [rng.uniform(r1 + 2, W - r1 - 2, K),
+                 rng.uniform(r1 + 2, ymax, K)], -1,
+            )
+            for _ in range(2)
+        ]).astype(np.float32)
+        oy = jnp.asarray(np.floor(xy[..., 1]).astype(np.int32) + 1)
+        ox = jnp.asarray(np.floor(xy[..., 0]).astype(np.int32) + 1)
+        fx = jnp.asarray((xy[..., 0] % 1.0)[..., None].astype(np.float32))
+        fy = jnp.asarray((xy[..., 1] % 1.0)[..., None].astype(np.float32))
+        got = np.asarray(_extract_blended_planes_banded(
+            padded, oy, ox, fx, fy, P, NB=4, interpret=True
+        ))
+        want = np.asarray(extract_blended(padded, jnp.asarray(xy), P,
+                                          interpret=True))
+        np.testing.assert_array_equal(got, want)
