@@ -12,6 +12,7 @@ parity is at the transform-RMSE level (the judged metric), not bitwise.
 
 from __future__ import annotations
 
+import ml_dtypes
 import numpy as np
 
 from kcmc_tpu.ops.patterns import (
@@ -161,6 +162,15 @@ def describe_keypoints(
     img: np.ndarray, xy: np.ndarray, valid: np.ndarray, oriented: bool, blur_sigma: float = 2.0
 ) -> np.ndarray:
     smooth = gaussian_blur(img, blur_sigma)
+    # pixels at descriptor precision — mirror of the jax paths' round-5
+    # bf16 quantization point incl. the per-frame mean removal
+    # (ops/describe.py: large DC backgrounds would otherwise exceed
+    # bf16's relative step and wipe the content)
+    fin = np.isfinite(smooth)
+    mu = np.float32(smooth[fin].mean()) if fin.any() else np.float32(0.0)
+    smooth = (smooth - mu).astype(
+        np.float32
+    ).astype(ml_dtypes.bfloat16).astype(np.float32)
     K = xy.shape[0]
     if oriented:
         r = _MOMENT_RADIUS
@@ -182,6 +192,11 @@ def describe_keypoints(
         offs = np.broadcast_to(PATTERN[None], (K,) + PATTERN.shape)
     pos = xy[:, None, None, :] + offs  # (K,B,2,2)
     vals = bilinear_sample(smooth, pos[..., 0], pos[..., 1])
+    # Descriptor values are bf16-quantized framework-wide (round 5 —
+    # the jax paths' bandwidth precision; see ops/describe.py): the
+    # oracle quantizes at the same point so comparison ties fall the
+    # same way.
+    vals = vals.astype(np.float32).astype(ml_dtypes.bfloat16)
     bits = (vals[..., 0] < vals[..., 1]).astype(np.uint32)  # (K, B)
     b = bits.reshape(K, N_WORDS, 32)
     desc = (b << np.arange(32, dtype=np.uint32)[None, None, :]).sum(-1).astype(np.uint32)

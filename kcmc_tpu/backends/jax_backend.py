@@ -452,6 +452,7 @@ class JaxBackend:
                         n_hypotheses=cfg.n_hypotheses,
                         threshold=cfg.inlier_threshold,
                         refine_iters=cfg.refine_iters,
+                        score_cap=cfg.score_cap,
                     )
                     out["transform"] = res.transform
                 out["n_inliers"] = res.n_inliers
@@ -849,6 +850,7 @@ class JaxBackend:
                 n_hypotheses=cfg.n_hypotheses,
                 threshold=cfg.inlier_threshold,
                 refine_iters=cfg.refine_iters,
+                score_cap=cfg.score_cap,
             )
             out = {
                 "transform": res.transform,

@@ -141,13 +141,16 @@ class CorrectorConfig:
     # pixels of the patch instead of ~40 matched corners) and re-warp
     # with the corrected field. This breaks the keypoint-localization
     # noise floor the smoothing passes cannot (NoRMCorre-style).
-    # Measured on the judged 512² workload (DESIGN.md "Piecewise
-    # correlation polish"): 0.39 px field RMSE -> 0.18 at one pass
-    # (1009 fps on the v5e) -> 0.13 at two (850 fps); a third
-    # oscillates. Each pass costs one extra flow warp + 18 correlation
-    # maps per batch; default 1 keeps the v5e above 1000 fps — set 2
-    # when accuracy matters more than ~15% throughput.
-    field_polish: int = 1
+    # Measured on the judged 512² workload (round 5, v5e; DESIGN.md
+    # "Piecewise polish, round 5"): 0.38 px field RMSE unpolished,
+    # 0.183 at one pass (1120 fps), 0.134 at two (929), 0.123 at
+    # three (790) — monotone since round 5 (round 4's pass-3
+    # oscillation was the unpinned bf16 compose, not the estimator).
+    # Each pass costs one extra flow warp + the correlation maps;
+    # default 2 trades ~16% of the piecewise stage's (5x-target)
+    # throughput for 27% lower field error. Set 1 to prioritize
+    # throughput, 3 for the accuracy ceiling.
+    field_polish: int = 2
     # Photometric TRANSFORM polish passes for the 2D matrix models
     # (0 = off): the same correlation mechanism as field_polish applied
     # to translation/rigid/similarity/affine/homography — after the
@@ -167,6 +170,18 @@ class CorrectorConfig:
     # significant regions to update) at ~1/4 the correlation
     # bandwidth of the piecewise 8x8 grid.
     polish_grid: tuple[int, int] = (4, 4)
+
+    # RANSAC hypothesis-scoring subset cap (0 = score on every match):
+    # at high match counts the (frames x hypotheses x matches) residual
+    # traffic dominates the consensus stage (~20 ms/batch at K=4096,
+    # H=128, B=32 on the v5e); ranking hypotheses needs only a
+    # statistical inlier estimate, so sampling+scoring run on an
+    # every-stride-th subset of ~score_cap matches. The winner's
+    # refinement, final polish, and reported n_inliers always use the
+    # full set. Inactive for typical K <= 1024 configs; at the
+    # config-2 scale it is a pure speedup (measured: accuracy and
+    # match counts unchanged — see DESIGN.md "Config 2, round 5").
+    score_cap: int = 1024
 
     # -- diagnostics -------------------------------------------------------
     # Per-frame Pearson correlation between each corrected frame and the
@@ -316,6 +331,10 @@ class CorrectorConfig:
         if int(self.field_polish) < 0:
             raise ValueError(
                 f"field_polish must be >= 0 passes, got {self.field_polish}"
+            )
+        if int(self.score_cap) < 0:
+            raise ValueError(
+                f"score_cap must be >= 0 matches, got {self.score_cap}"
             )
         if int(self.transform_polish) < 0:
             raise ValueError(

@@ -1171,7 +1171,6 @@ class MotionCorrector:
         re-derived on resume rather than stored.
         """
         from kcmc_tpu.io import ChunkedStackLoader, open_stack
-        from kcmc_tpu.io.tiff import TiffWriter
 
         timer = StageTimer()
         cfg = self.config

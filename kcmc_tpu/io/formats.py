@@ -365,8 +365,14 @@ class ZarrWriter:
         path = os.fspath(path)
         if state.get("format") != "zarr":
             raise OSError(f"{path}: checkpoint writer state is not zarr")
-        with open(os.path.join(path, ".zarray")) as f:
-            meta = json.load(f)
+        try:
+            with open(os.path.join(path, ".zarray")) as f:
+                meta = json.load(f)
+        except (ValueError, KeyError) as e:
+            # torn/corrupt metadata must surface as OSError — the
+            # corrector's resume handler restarts from scratch on
+            # OSError, exactly like a torn TIFF
+            raise OSError(f"{path}: unreadable .zarray at resume: {e}")
         self = object.__new__(cls)
         self.path = path
         self.compression = compression
@@ -379,7 +385,10 @@ class ZarrWriter:
                 f"{path}: store compressor {comp} does not match the "
                 f"resume compression {compression!r}"
             )
-        n = int(state["n_pages"])
+        try:
+            n = int(state["n_pages"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise OSError(f"{path}: malformed zarr writer state: {e}")
         # all checkpointed chunks must exist (the output is the
         # persistence layer, exactly like the TIFF resume contract)
         if n > 0 and not os.path.exists(self._chunk_path(n - 1)):

@@ -194,6 +194,11 @@ def _describe_from_patches(raw, pb, kps, oriented: bool):
     else:
         vals = dot(pb.reshape(-1, K).T, jnp.asarray(_SEL_UPRIGHT))  # (K, 512)
 
+    # Descriptor values are bf16-quantized framework-wide (round 5 —
+    # see describe_keypoints_batch): for a one-hot selection,
+    # quantizing the selected values equals selecting quantized values
+    # exactly, so this path stays the bit oracle of the batched route.
+    vals = vals.astype(jnp.bfloat16)
     return _finalize_descriptors(vals, kps.valid)
 
 
@@ -215,6 +220,16 @@ def describe_keypoints(
     """
     if smooth is None:
         smooth = gaussian_blur(img, blur_sigma)
+    # pixels at descriptor precision (round 5, see
+    # describe_keypoints_batch — incl. the per-frame mean removal that
+    # keeps large DC backgrounds out of the bf16 quantization step):
+    # values identical to the Pallas path's bf16 slab reads,
+    # arithmetic in f32 on them identical too
+    finite = jnp.isfinite(smooth)
+    mu = jnp.sum(jnp.where(finite, smooth, 0.0)) / jnp.maximum(
+        jnp.sum(finite), 1
+    )
+    smooth = (smooth - mu).astype(jnp.bfloat16).astype(jnp.float32)
     r = ROT_RADIUS if oriented else PATCH_RADIUS
     raw, pb = _extract_patches(smooth, kps.xy, r)
     return _describe_from_patches(raw, pb, kps, oriented)
@@ -256,7 +271,8 @@ def describe_keypoints_batch(
         # there, DESIGN.md "Large-frame patch extraction").
         from kcmc_tpu.ops.pallas_patch import band_count
 
-        use_pallas = band_count(frames.shape[1:], P) >= 1
+        # extraction runs on bf16 slabs (itemsize 2) since round 5
+        use_pallas = band_count(frames.shape[1:], P, itemsize=2) >= 1
     if not use_pallas:
         def one(f, k, s=None):
             return describe_keypoints(
@@ -270,19 +286,58 @@ def describe_keypoints_batch(
     from kcmc_tpu.ops.pallas_patch import extract_blended
     if smooth is None:
         smooth = jax.vmap(lambda f: gaussian_blur(f, blur_sigma))(frames)
-    padded = jnp.pad(smooth, ((0, 0), (r + 1, r + 1), (r + 1, r + 1)), mode="edge")
+    # Pixels quantize to bf16 BEFORE extraction (round 5): the slab
+    # reads are the extraction kernel's dominant VMEM traffic and bf16
+    # halves them; every path (this one, the jnp fallback below, the
+    # single-frame jnp oracle, the numpy mirror) quantizes at the same
+    # point, so comparison ties keep falling the same way. The
+    # per-frame mean comes OFF first: microscopy backgrounds sit at
+    # large DC offsets where bf16's relative step (2^-8) exceeds the
+    # content amplitude — a +500 background quantizes in steps of 2 px
+    # intensity and wipes the blobs (measured: registration collapse).
+    # Descriptor bits are order comparisons and the ORB moment maps'
+    # coordinate weights sum to zero over the disc, so subtracting a
+    # per-frame constant changes neither — it only restores dynamic
+    # range to the quantization.
+    # FINITE-pixel mean: a single inf/NaN sensor pixel must degrade
+    # descriptors locally (the pre-round-5 behavior), not poison the
+    # whole frame through the mean
+    finite = jnp.isfinite(smooth)
+    n_fin = jnp.maximum(jnp.sum(finite, axis=(1, 2), keepdims=True), 1)
+    mu = (
+        jnp.sum(jnp.where(finite, smooth, 0.0), axis=(1, 2), keepdims=True)
+        / n_fin
+    )
+    padded = jnp.pad(
+        (smooth - mu).astype(jnp.bfloat16),
+        ((0, 0), (r + 1, r + 1), (r + 1, r + 1)), mode="edge",
+    )
     B, K = kps.xy.shape[:2]
 
+    # Descriptor VALUES are quantized to bf16 between extraction and
+    # selection (round 5): the bin dispatch's row gather + scatter and
+    # the selection matmuls are the describe stage's dominant HBM
+    # traffic at config-2 scale (measured 56 ms/batch at K=4096, B=32),
+    # and descriptor bits only consume the values through ORDER
+    # comparisons — pairs of blurred intensities within bf16's 2^-8
+    # relative step are sensor-noise ties whichever way they fall. The
+    # jnp and numpy oracle paths quantize at the same point (selection
+    # of a one-hot commutes with quantization exactly), so cross-path
+    # bit parity is preserved up to the blend-rounding ties it already
+    # had.
     if oriented:
         pb, m10, m01 = extract_blended(
-            padded, kps.xy, P, with_moments=True, interpret=interpret
+            padded, kps.xy, P, with_moments=True, interpret=interpret,
+            out_dtype=jnp.bfloat16,  # quantized in-kernel: half the write
         )
         angles = jnp.arctan2(m01[..., 0], m10[..., 0])  # (B, K)
         bins = _quantize_bins(angles)
-        flat = pb.reshape(B, K, -1)  # (B, K, L) keypoint-first
+        flat = pb.reshape(B, K, -1)  # (B, K, L) bf16
         vals = jax.vmap(_binned_select)(flat, bins, kps.valid)
     else:
-        pb = extract_blended(padded, kps.xy, P, interpret=interpret)
+        pb = extract_blended(
+            padded, kps.xy, P, interpret=interpret, out_dtype=jnp.bfloat16
+        )
         flat = pb.reshape(B, K, -1)
         vals = _onehot_select(flat, jnp.asarray(_SEL_UPRIGHT))
 
@@ -325,13 +380,27 @@ def _binned_select(flat: jnp.ndarray, bins: jnp.ndarray, valid) -> jnp.ndarray:
     # drops each bin's weakest keypoints
     rows_idx, ok = segment_by_key(b_eff, nb, cap)
     rows = flat[rows_idx]  # (nb, cap, L)
-    # Same split-precision passes as _onehot_select, batched over bins.
-    hi = rows.astype(jnp.bfloat16).astype(jnp.float32)
-    lo = rows - hi
-    sel = jnp.asarray(_SEL_ROT)  # (nb, L, 512)
-    out = jnp.matmul(hi, sel) + jnp.matmul(lo, sel)  # (nb, cap, 512)
+    if flat.dtype == jnp.bfloat16:
+        # round-5 bandwidth path: the rows are already quantized to the
+        # descriptor value precision (see describe_keypoints_batch), so
+        # selecting bf16 values with a bf16 one-hot matmul is EXACT
+        # (0/1 weights, one nonzero per column, f32 accumulation) — one
+        # pass, and the gather above plus the scatter below move half
+        # the bytes of the f32 route.
+        sel = jnp.asarray(_SEL_ROT).astype(jnp.bfloat16)
+        out = jnp.matmul(
+            rows, sel, preferred_element_type=jnp.float32
+        ).astype(jnp.bfloat16)
+        vals = jnp.zeros((K + 1, out.shape[-1]), jnp.bfloat16)
+    else:
+        # Same split-precision passes as _onehot_select, batched over
+        # bins.
+        hi = rows.astype(jnp.bfloat16).astype(jnp.float32)
+        lo = rows - hi
+        sel = jnp.asarray(_SEL_ROT)  # (nb, L, 512)
+        out = jnp.matmul(hi, sel) + jnp.matmul(lo, sel)  # (nb, cap, 512)
+        vals = jnp.zeros((K + 1, out.shape[-1]), jnp.float32)
     dest = jnp.where(ok, rows_idx, K).reshape(-1)
-    vals = jnp.zeros((K + 1, out.shape[-1]), jnp.float32)
     vals = vals.at[dest].set(out.reshape(nb * cap, -1))
     return vals[:K]
 
@@ -350,6 +419,12 @@ def _onehot_select(flat: jnp.ndarray, sel: jnp.ndarray) -> jnp.ndarray:
     intensities differing by < 2^-16 relative are noise anyway (and the
     CPU-parity oracle path is the jnp route, which is exact f32).
     """
+    if flat.dtype == jnp.bfloat16:
+        # values already at descriptor precision: one exact bf16 pass
+        return jnp.matmul(
+            flat, sel.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
     hi = (flat.astype(jnp.bfloat16)).astype(jnp.float32)
     lo = flat - hi
     out = jnp.matmul(hi, sel) + jnp.matmul(lo, sel)

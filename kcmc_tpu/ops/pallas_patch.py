@@ -70,17 +70,21 @@ def _smem_batch_limit(n_scalar_arrays: int, K: int, KB: int) -> int:
 _VMEM_FRAME_BUDGET = 14 * 1024 * 1024
 
 
-def _slab_rows(P: int) -> int:
-    """Aligned slab rows covering P + the 8-alignment residual — the
-    single source of truth shared by the kernels, the wrappers' padding,
-    the VMEM gate, and the HBM chunk estimate."""
-    return ((P + 7) // 8) * 8 + 8
+def _slab_rows(P: int, itemsize: int = 4) -> int:
+    """Aligned slab rows covering P + the sublane-alignment residual —
+    the single source of truth shared by the kernels, the wrappers'
+    padding, the VMEM gate, and the HBM chunk estimate. Sublane
+    alignment is 8 for f32 tiles and 16 for bf16 ((16, 128) tiling),
+    so bf16 slabs carry more rows but half the bytes (~40% less
+    traffic at P=32)."""
+    a = 16 if itemsize == 2 else 8
+    return ((P + a - 1) // a) * a + a
 
 
-def _slab_dims(P: int, Wp: int) -> tuple[int, int]:
+def _slab_dims(P: int, Wp: int, itemsize: int = 4) -> tuple[int, int]:
     """(S, Wpp): `_slab_rows` plus the lane-padded width every 2D
     wrapper pads to."""
-    return _slab_rows(P), -(-(Wp + _WIN) // 128) * 128
+    return _slab_rows(P, itemsize), -(-(Wp + _WIN) // 128) * 128
 
 
 def _chunk_batch(fn, bc: int, B: int, arrays, with_moments: bool):
@@ -111,7 +115,7 @@ def _pad_keypoint_axis(KB: int, oy, ox, fx, fy):
     )
 
 
-def supports(shape: tuple[int, int], P: int) -> bool:
+def supports(shape: tuple[int, int], P: int, itemsize: int = 4) -> bool:
     """Whether the whole-frame (resident-frame) 2D extraction layout
     fits VMEM for a (H, W) frame and patch size P (callers pad by
     (P - 2) // 2 + 1). When False, `extract_blended_planes` switches to
@@ -122,16 +126,16 @@ def supports(shape: tuple[int, int], P: int) -> bool:
     H, W = shape
     r1 = (P - 2) // 2 + 1
     Hp, Wp = H + 2 * r1, W + 2 * r1
-    return _frame_fits(Hp, Wp, P)
+    return _frame_fits(Hp, Wp, P, itemsize)
 
 
-def _frame_fits(Hp: int, Wp: int, P: int) -> bool:
-    S, Wpp = _slab_dims(P, Wp)
+def _frame_fits(Hp: int, Wp: int, P: int, itemsize: int = 4) -> bool:
+    S, Wpp = _slab_dims(P, Wp, itemsize)
     Hpp = Hp + S - P
-    return 2 * Hpp * Wpp * 4 <= _VMEM_FRAME_BUDGET
+    return 2 * Hpp * Wpp * itemsize <= _VMEM_FRAME_BUDGET
 
 
-def band_count(shape: tuple[int, int], P: int) -> int:
+def band_count(shape: tuple[int, int], P: int, itemsize: int = 4) -> int:
     """Bands for the row-banded extraction layout (round 5, DESIGN.md
     "Large-frame support" item 2): 1 = whole frame resident (use the
     plain kernel), 2/4/8 = smallest split whose (Hb + S)-row band block
@@ -140,12 +144,13 @@ def band_count(shape: tuple[int, int], P: int) -> int:
     H, W = shape
     r1 = (P - 2) // 2 + 1
     Hp, Wp = H + 2 * r1, W + 2 * r1
-    if _frame_fits(Hp, Wp, P):
+    if _frame_fits(Hp, Wp, P, itemsize):
         return 1
-    S, Wpp = _slab_dims(P, Wp)
+    S, Wpp = _slab_dims(P, Wp, itemsize)
+    a = 16 if itemsize == 2 else 8
     for NB in (2, 4, 8):
-        Hb = -(-(-(-Hp // NB)) // 8) * 8
-        if 2 * (Hb + S) * Wpp * 4 <= _VMEM_FRAME_BUDGET:
+        Hb = -(-(-(-Hp // NB)) // a) * a
+        if 2 * (Hb + S) * Wpp * itemsize <= _VMEM_FRAME_BUDGET:
             return NB
     return 0
 
@@ -206,7 +211,9 @@ def _blended_kernel(
     """
     b = pl.program_id(0)
     kb = pl.program_id(1)
-    S = _slab_rows(P)
+    itemsize = jnp.dtype(src_ref.dtype).itemsize
+    align = 16 if itemsize == 2 else 8
+    S = _slab_rows(P, itemsize)
     # Scalar stores to VMEM are unsupported: accumulate the per-keypoint
     # moment scalars into (KB, 1) vectors (iota row-select) and store once.
     row = jax.lax.broadcasted_iota(jnp.int32, (KB, 1), 0)
@@ -216,9 +223,13 @@ def _blended_kernel(
         k = kb * KB + i
         y0 = oy_ref[b, k]
         x0 = ox_ref[b, k]
-        y0a = (y0 // 8) * 8
+        y0a = (y0 // align) * align
         x0a = (x0 // 128) * 128
-        slab = src_ref[pl.ds(y0a, S), pl.ds(x0a, _WIN)]  # (S, _WIN)
+        # Mosaic's rotate is 32-bit-only: slice the (bf16 or f32) slab
+        # out of the resident block, upcast the SLAB (tiny), roll in
+        # f32. The frame block's HBM->VMEM fetch keeps the input
+        # dtype's bytes; only the per-keypoint slab work runs f32.
+        slab = src_ref[pl.ds(y0a, S), pl.ds(x0a, _WIN)].astype(jnp.float32)
         slab = pltpu.roll(slab, S - (y0 - y0a), 0)
         slab = pltpu.roll(slab, _WIN - (x0 - x0a), 1)
         patch = slab[:P, :P]
@@ -233,7 +244,7 @@ def _blended_kernel(
             + w01 * patch[: P - 1, 1:]
             + w10 * patch[1:, : P - 1]
             + w11 * patch[1:, 1:]
-        )
+        ).astype(pb_ref.dtype)
         if with_moments:
             # mm_ref rows: [x00, x01, x10, x11, y00, y01, y10, y11]
             # (yx order: row 2*ry + rx), see _moment_maps.
@@ -249,8 +260,9 @@ def _blended_kernel(
                 jnp.where(rx, mm_ref[7], mm_ref[6]),
                 jnp.where(rx, mm_ref[5], mm_ref[4]),
             )
-            acc_x = jnp.where(row == i, jnp.sum(patch * wx), acc_x)
-            acc_y = jnp.where(row == i, jnp.sum(patch * wy), acc_y)
+            pf = patch.astype(jnp.float32)
+            acc_x = jnp.where(row == i, jnp.sum(pf * wx), acc_x)
+            acc_y = jnp.where(row == i, jnp.sum(pf * wy), acc_y)
     # Outputs must not stay unwritten (the wrapper discards them when
     # moments are off; they hold zeros then).
     m10_ref[:, :] = acc_x
@@ -258,7 +270,7 @@ def _blended_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("P", "with_moments", "interpret")
+    jax.jit, static_argnames=("P", "with_moments", "interpret", "out_dtype")
 )
 def extract_blended(
     padded: jnp.ndarray,
@@ -266,6 +278,7 @@ def extract_blended(
     P: int,
     with_moments: bool = False,
     interpret: bool = False,
+    out_dtype=jnp.float32,
 ):
     """Keypoint-first blended patches straight from the padded frames.
 
@@ -283,12 +296,12 @@ def extract_blended(
     fy = (xy[..., 1] - jnp.floor(xy[..., 1]))[..., None].astype(jnp.float32)
     return extract_blended_planes(
         padded, oy, ox, fx, fy, P, with_moments=with_moments,
-        interpret=interpret,
+        interpret=interpret, out_dtype=out_dtype,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("P", "with_moments", "interpret")
+    jax.jit, static_argnames=("P", "with_moments", "interpret", "out_dtype")
 )
 def extract_blended_planes(
     padded: jnp.ndarray,
@@ -299,6 +312,7 @@ def extract_blended_planes(
     P: int,
     with_moments: bool = False,
     interpret: bool = False,
+    out_dtype=jnp.float32,
 ):
     """Core entry on explicit integer origins (B, K) and blend
     fractions (B, K, 1): the 3D descriptor path flattens (z, y) into
@@ -306,10 +320,11 @@ def extract_blended_planes(
     """
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
-    if not _frame_fits(Hp, Wp, P):
+    isz = padded.dtype.itemsize
+    if not _frame_fits(Hp, Wp, P, isz):
         H_unpadded = Hp - 2 * ((P - 2) // 2 + 1)
         W_unpadded = Wp - 2 * ((P - 2) // 2 + 1)
-        NB = band_count((H_unpadded, W_unpadded), P)
+        NB = band_count((H_unpadded, W_unpadded), P, isz)
         if NB >= 2:
             # Large frames (≈2048²+): row-banded resident layout —
             # keypoints dispatched to row bands, each band's block fits
@@ -317,27 +332,32 @@ def extract_blended_planes(
             return _extract_blended_planes_banded(
                 padded, oy, ox, fx, fy, P, NB,
                 with_moments=with_moments, interpret=interpret,
+                out_dtype=out_dtype,
             )
         # Beyond even the banded budget: per-keypoint Element-indexed
         # slabs. NOTE: exact but measured much slower than the XLA
         # gather describe path (DESIGN.md) — kept so the kernel API is
-        # total.
+        # total. The slab layout's 8-aligned Element-indexed blocks are
+        # f32-only; upcast (values are bf16-representable, so the
+        # extraction is unchanged).
         return _extract_blended_planes_slab(
-            padded, oy, ox, fx, fy, P,
+            padded.astype(jnp.float32), oy, ox, fx, fy, P,
             with_moments=with_moments, interpret=interpret,
+            out_dtype=out_dtype,
         )
     KB = _KB
     bc = _smem_batch_limit(2, K, KB)
     if B > bc:  # chunk the batch to keep scalar prefetch within SMEM
         return _chunk_batch(
             lambda *a: extract_blended_planes(
-                *a, P, with_moments=with_moments, interpret=interpret
+                *a, P, with_moments=with_moments, interpret=interpret,
+                out_dtype=out_dtype,
             ),
             bc, B, (padded, oy, ox, fx, fy), with_moments,
         )
     oy, ox, fx, fy = _pad_keypoint_axis(KB, oy, ox, fx, fy)
     Kp = oy.shape[1]
-    S, Wpp = _slab_dims(P, Wp)
+    S, Wpp = _slab_dims(P, Wp, padded.dtype.itemsize)
     padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
     Hpp = Hp + S - P
 
@@ -368,14 +388,14 @@ def extract_blended_planes(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), out_dtype),
             jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
             jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
         ],
         interpret=interpret,
     )(
         oy.astype(jnp.int32), ox.astype(jnp.int32),
-        fx, fy, mm_in, padded.astype(jnp.float32),
+        fx, fy, mm_in, padded,
     )
     if with_moments:
         return pb[:, :K], m10[:, :K], m01[:, :K]
@@ -392,6 +412,7 @@ def _extract_blended_planes_banded(
     NB: int,
     with_moments: bool = False,
     interpret: bool = False,
+    out_dtype=jnp.float32,
 ):
     """Row-banded variant of the resident-frame layout for frames whose
     padded block exceeds VMEM (DESIGN.md "Large-frame support" item 2,
@@ -413,15 +434,18 @@ def _extract_blended_planes_banded(
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
     KB = _KB
-    S, Wpp = _slab_dims(P, Wp)
-    Hb = -(-(-(-Hp // NB)) // 8) * 8
+    S, Wpp = _slab_dims(P, Wp, padded.dtype.itemsize)
+    # band starts must respect the slab sublane alignment (16 for bf16)
+    _ba = 16 if padded.dtype.itemsize == 2 else 8
+    Hb = -(-(-(-Hp // NB)) // _ba) * _ba
     Kp = -(-K // KB) * KB + NB * KB  # aligned-runs worst case
 
     bc = _smem_batch_limit(3, Kp, KB)
     if B > bc:
         return _chunk_batch(
             lambda *a: _extract_blended_planes_banded(
-                *a, P, NB, with_moments=with_moments, interpret=interpret
+                *a, P, NB, with_moments=with_moments, interpret=interpret,
+                out_dtype=out_dtype,
             ),
             bc, B, (padded, oy, ox, fx, fy), with_moments,
         )
@@ -468,8 +492,11 @@ def _extract_blended_planes_banded(
     ox_s = take(ox, flat_idx)
     fx_s = take(fx[..., 0], flat_idx)[..., None]
     fy_s = take(fy[..., 0], flat_idx)[..., None]
-    # padding slots read the default item; harmless (masked below)
-    oy_s = jnp.clip(oy_s, 0, Hb + S - P)
+    # padding slots read the default item; harmless (masked below).
+    # Clip to Hb (not Hb + S - P): the kernel's aligned S-row slab read
+    # starts at floor-align(oy_s), and the band block has Hb + S rows —
+    # a start past Hb would read beyond the block on chip.
+    oy_s = jnp.clip(oy_s, 0, Hb)
 
     # band stacking: (B, NB, Hb + S, Wpp); rows padded so every band
     # slices cleanly, lanes padded for the kernel's 256-lane window
@@ -528,7 +555,7 @@ def _extract_blended_planes_banded(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), out_dtype),
             jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
             jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
         ],
@@ -536,7 +563,7 @@ def _extract_blended_planes_banded(
     )(
         block_band.astype(jnp.int32),
         oy_s.astype(jnp.int32), ox_s.astype(jnp.int32),
-        fx_s, fy_s, mm_in, bands.astype(jnp.float32),
+        fx_s, fy_s, mm_in, bands,
     )
 
     # un-dispatch: original keypoint k's slot position (or -1 if the
@@ -602,7 +629,7 @@ def _blended_slab_kernel(*refs, P: int, KB: int, with_moments: bool):
             + w01 * patch[: P - 1, 1:]
             + w10 * patch[1:, : P - 1]
             + w11 * patch[1:, 1:]
-        )
+        ).astype(pb_ref.dtype)
         if with_moments:
             rx = fx >= 0.5
             ry = fy >= 0.5
@@ -616,14 +643,16 @@ def _blended_slab_kernel(*refs, P: int, KB: int, with_moments: bool):
                 jnp.where(rx, mm_ref[7], mm_ref[6]),
                 jnp.where(rx, mm_ref[5], mm_ref[4]),
             )
-            acc_x = jnp.where(row == i, jnp.sum(patch * wx), acc_x)
-            acc_y = jnp.where(row == i, jnp.sum(patch * wy), acc_y)
+            pf = patch.astype(jnp.float32)
+            acc_x = jnp.where(row == i, jnp.sum(pf * wx), acc_x)
+            acc_y = jnp.where(row == i, jnp.sum(pf * wy), acc_y)
     m10_ref[:, :] = acc_x
     m01_ref[:, :] = acc_y
 
 
 def _extract_blended_planes_slab(
-    padded, oy, ox, fx, fy, P: int, with_moments: bool, interpret: bool
+    padded, oy, ox, fx, fy, P: int, with_moments: bool, interpret: bool,
+    out_dtype=jnp.float32,
 ):
     """Slab-blocked implementation behind extract_blended_planes for
     frames past the whole-frame VMEM budget. Identical outputs."""
@@ -643,13 +672,14 @@ def _extract_blended_planes_slab(
     if B > bc:
         return _chunk_batch(
             lambda *a: _extract_blended_planes_slab(
-                *a, P, with_moments=with_moments, interpret=interpret
+                *a, P, with_moments=with_moments, interpret=interpret,
+                out_dtype=out_dtype,
             ),
             bc, B, (padded, oy, ox, fx, fy), with_moments,
         )
     oy, ox, fx, fy = _pad_keypoint_axis(KB, oy, ox, fx, fy)
     Kp = oy.shape[1]
-    S, Wpp = _slab_dims(P, Wp)
+    S, Wpp = _slab_dims(P, Wp, padded.dtype.itemsize)
     padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
     Hpp = Hp + S - P
 
@@ -699,7 +729,7 @@ def _extract_blended_planes_slab(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), jnp.float32),
+            jax.ShapeDtypeStruct((B, Kp, Pb, Pb), out_dtype),
             jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
             jax.ShapeDtypeStruct((B, Kp, 1), jnp.float32),
         ],

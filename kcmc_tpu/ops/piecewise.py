@@ -305,9 +305,12 @@ def correlation_polish(
     """
     from kcmc_tpu.ops.polish import measure_shifts
 
-    # exact=True: the per-region estimator this polish's round-4
-    # accuracy record (0.184/0.134 px) is pinned to — the matrix
-    # polish's bandwidth-restructured fast path measures +0.02-0.03 px
-    # on the field workload's pass-2 convergence (ops/polish.py).
+    # exact=True: the per-region estimator the piecewise accuracy
+    # record is pinned to, through its round-5 bandwidth restructure
+    # (values equal to f32 residue — see measure_shifts). The matrix
+    # polish's ring/index-shift fast path measures +0.02 px on this
+    # workload's pass-2 convergence, and a 2D-quadratic (9-point)
+    # vertex measured as a wash across regimes — both recorded in
+    # DESIGN.md "Piecewise polish, round 5".
     d, _ = measure_shifts(corrected, template, grid, window_frac, exact=True)
     return -d

@@ -164,10 +164,15 @@ def measure_shifts(
     if exact:
         # Per-region formulation with the full (ring-less) window — the
         # piecewise field polish's estimator, pinned to its round-4
-        # accuracy record (0.184/0.134 px; the fast path below measures
-        # +0.02-0.03 px on the field workload's pass-2 convergence).
-        # ~18 batch-array passes; the 8x8 field grid pays it on far
-        # fewer pixels per region than the matrix polish.
+        # accuracy record (the ring/index-shift fast path below
+        # measures +0.02 px on the field workload's pass-2
+        # convergence). A bandwidth restructure of this branch
+        # (shifted-side zero-means dropped, template term einsum'd) was
+        # built, measured SPEED-NEUTRAL on chip (XLA already fuses
+        # this form), and reverted: it broke the bitwise
+        # score(d) == score(-d) identical-input symmetry this
+        # estimator is designed around (f32 summation orders differ
+        # between the two terms), costing a spurious ~1e-6 px vertex.
         w = region_window(sh, sw, window_frac, ring=False)
 
         def zero_mean_x(p):

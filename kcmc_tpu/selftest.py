@@ -528,7 +528,8 @@ def _check_patch_banded(size2=2048):
     from kcmc_tpu.ops.detect import detect_keypoints_batch
     from kcmc_tpu.ops.pallas_patch import band_count
 
-    nb = band_count((size2, size2), 32)
+    # the production describe path extracts from bf16 slabs
+    nb = band_count((size2, size2), 32, itemsize=2)
     if nb < 2:
         return _record("describe2d_banded_vs_jnp", True,
                        f"skipped: band_count={nb} at {size2}")

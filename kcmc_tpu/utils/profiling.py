@@ -187,6 +187,7 @@ def stage_breakdown(
                 n_hypotheses=cfg.n_hypotheses,
                 threshold=cfg.inlier_threshold,
                 refine_iters=cfg.refine_iters,
+                score_cap=cfg.score_cap,
             )
         )(ref["xy"][m.idx], k.xy, m.valid, keys)
         return res.transform

@@ -80,11 +80,20 @@ def test_pipeline_equivalence_rigid(img):
     data = synthetic.make_drift_stack(
         n_frames=4, shape=(160, 160), model="rigid", max_drift=5.0, seed=9
     )
+    # transform_polish=0: with the round-5 polish on, warped pixels
+    # feed back into the estimate, so the separable chain's ~0.01 px
+    # interpolation artifact becomes a ~0.01 px transform offset at the
+    # polish optimum — a property of the ESTIMATOR feedback, not of
+    # the warp kernel this test pins (and why warp='auto' routes rigid
+    # to the artifact-free matrix kernel on TPU). Without polish the
+    # estimation is warp-independent and the old exact bound holds.
     r_jnp = MotionCorrector(
-        model="rigid", backend="jax", batch_size=4, warp="jnp"
+        model="rigid", backend="jax", batch_size=4, warp="jnp",
+        transform_polish=0,
     ).correct(data.stack)
     r_sep = MotionCorrector(
-        model="rigid", backend="jax", batch_size=4, warp="separable"
+        model="rigid", backend="jax", batch_size=4, warp="separable",
+        transform_polish=0,
     ).correct(data.stack)
     np.testing.assert_allclose(r_sep.transforms, r_jnp.transforms, atol=1e-6)
     d = np.abs(r_sep.corrected - r_jnp.corrected)[:, 16:-16, 16:-16]
@@ -92,6 +101,8 @@ def test_pipeline_equivalence_rigid(img):
 
 
 def test_separable_rejected_for_unsupported_models():
-    for model in ("homography", "piecewise"):
-        with pytest.raises(ValueError, match="separable"):
-            MotionCorrector(model=model, backend="jax", warp="separable")
+    # homography is ALLOWED since round 5 (the affine+residual split
+    # chain stays reachable as the zoom-unbounded projective route)
+    with pytest.raises(ValueError, match="separable"):
+        MotionCorrector(model="piecewise", backend="jax", warp="separable")
+    MotionCorrector(model="homography", backend="jax", warp="separable")
