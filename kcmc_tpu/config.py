@@ -143,14 +143,24 @@ class CorrectorConfig:
     # noise floor the smoothing passes cannot (NoRMCorre-style).
     # Measured on the judged 512² workload (round 5, v5e; DESIGN.md
     # "Piecewise polish, round 5"): 0.38 px field RMSE unpolished,
-    # 0.183 at one pass (1120 fps), 0.134 at two (929), 0.123 at
-    # three (790) — monotone since round 5 (round 4's pass-3
-    # oscillation was the unpinned bf16 compose, not the estimator).
-    # Each pass costs one extra flow warp + the correlation maps;
-    # default 2 trades ~16% of the piecewise stage's (5x-target)
-    # throughput for 27% lower field error. Set 1 to prioritize
-    # throughput, 3 for the accuracy ceiling.
-    field_polish: int = 2
+    # then — with the fused Pallas field warp (ops/pallas_warp_field)
+    # carrying each re-warp — 0.183 at one pass (1391 fps), 0.135 at
+    # two (1247), 0.124 at three (1135), 0.113 at four (1041), then
+    # flat (0.114 at five, 0.108 at six — the convergence plateau).
+    # Monotone since round 5 (round 4's pass-3 oscillation was the
+    # unpinned bf16 compose; the earlier ~0.118 "interp-blur floor"
+    # was the naive two-pass flow warp's split artifact, removed by
+    # the consumer-phase-corrected kernel). Each pass costs one extra
+    # field warp + the correlation maps; default 4 holds the plateau
+    # accuracy at ≥1000 fps (5x the contract target) on the fused
+    # TPU route. The pass count is deliberately platform-INdependent
+    # (cross-backend parity compares identical semantics), so the
+    # fallback routes — numpy backend, off-accelerator JAX, shapes
+    # the fused kernel's VMEM gate rejects (e.g. 2048²) — also run 4
+    # passes; there the naive split's ~0.118 px artifact floor caps
+    # the gain from passes beyond ~3, so set 2-3 on those routes (or
+    # 1-2 anywhere) to prioritize throughput.
+    field_polish: int = 4
     # Photometric TRANSFORM polish passes for the 2D matrix models
     # (0 = off): the same correlation mechanism as field_polish applied
     # to translation/rigid/similarity/affine/homography — after the

@@ -1,0 +1,296 @@
+"""Pallas TPU kernel: gather-free bilinear warp driven by a patch-grid
+displacement field — the piecewise model's re-warp chain, fused.
+
+The XLA path (ops/piecewise.upsample_field + ops/warp_field.
+warp_batch_flow) materializes a dense (B, H, W, 2) flow in HBM and then
+runs 2*(2R+2) shifted-view passes over frame-sized intermediates —
+measured ~0.1 ms/frame at 512², the binding term of every field-polish
+pass (DESIGN.md "Piecewise polish, round 5"). This kernel fuses the
+whole chain into one VMEM-resident program per (frame, row strip):
+
+1. The per-frame integer mean displacement positions a dynamic source
+   window via `pltpu.roll` from SMEM scalars (the translation kernel's
+   mechanism, ops/pallas_warp.py, with the same ±PAD exactness window).
+2. The residual field (cell values minus that integer mean) upsamples
+   IN-KERNEL: one small MXU matmul builds the column interpolation
+   (field @ hat_x, K = 128 after padding), and each row interpolation
+   is `gh` broadcast FMAs — the dense flow never touches HBM.
+3. A two-pass 1D resample applies the bounded residual, with the
+   x-pass phases evaluated at the CONSUMER row via two fixed-point
+   iterations — the ops/warp_field.warp_batch_matrix correction — so
+   the split matches one-shot 2D bilinear to O(|grad u|²) instead of
+   the naive split's O(|u|·|grad u|), which at piecewise magnitudes is
+   a 0.01-0.1 px warp artifact that feeds straight back into the
+   photometric field-polish loop.
+
+Out-of-bounds semantics match the warp family: edge-clamped taps (host
+edge padding), per-pixel zeroing where the true sample position leaves
+the frame, and whole-frame zero + cleared ok flag when the mean
+translation exceeds ±PAD or any cell's residual exceeds max_px - 0.5
+(the 0.5 margin covers the consumer-evaluated x-phase overshoot, as in
+warp_batch_matrix).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kcmc_tpu.ops.pallas_warp import PAD, _VMEM_BUDGET
+
+
+def _geometry(H: int, W: int, max_px: int, strip: int):
+    RP = max_px + 1
+    halo = PAD + RP
+    S = -(-H // strip)
+    Hw = -(-(strip + 2 * halo) // 8) * 8
+    Wp = -(-(W + 2 * halo) // 128) * 128
+    return RP, halo, S, Hw, Wp
+
+
+def _fits(H: int, W: int, max_px: int, strip: int) -> bool:
+    RP, _, _, Hw, Wp = _geometry(H, W, max_px, strip)
+    CR = strip + 2 * RP
+    # window appears ~2x (source + rolled copy); ~6 live (CR, W) phase /
+    # accumulator temporaries; one output block
+    return (2 * Hw * Wp + 6 * CR * W + strip * W) * 4 <= _VMEM_BUDGET
+
+
+def pick_strip(shape: tuple[int, int], max_px: int = 6) -> int | None:
+    """Strip height whose program fits the VMEM budget. 256 first: at
+    512² the whole-frame window (784×896) measured 325 us/frame vs 232
+    for 256-row strips — the roll processes the entire window per
+    program, so a taller window is pure extra roll work, while the
+    strip overlap only costs ~2x HBM reads of a bandwidth-cheap input.
+    Frames shorter than 256 rows use one whole-frame program; 128 is
+    the narrow-VMEM fallback."""
+    H, W = shape
+    for strip in (256, H, 128):
+        if strip <= H and _fits(H, W, max_px, strip):
+            return strip
+    return None
+
+
+def supports(shape: tuple[int, int], max_px: int = 6) -> bool:
+    return pick_strip(shape, max_px) is not None
+
+
+def _make_kernel(H, W, gh, gw, GHp, max_px, strip):
+    RP = max_px + 1
+    CR = strip + 2 * RP
+
+    def row_interp(urow, inner):
+        """Bilinear row interpolation of a column-interpolated field:
+        urow (rows, W) cell-space row coords in [0, gh-1]; inner
+        (GHp, W) per-cell-row values. gh broadcast FMAs."""
+        acc = jnp.zeros(urow.shape, jnp.float32)
+        for c in range(gh):
+            wc = jnp.maximum(1.0 - jnp.abs(urow - float(c)), 0.0)
+            acc = acc + wc * inner[c : c + 1, :]
+        return acc
+
+    def kernel(iscal_ref, fscal_ref, src_ref, field_ref, out_ref):
+        b = pl.program_id(0)
+        s = pl.program_id(1)
+        y0 = iscal_ref[b, 0]
+        x0 = iscal_ref[b, 1]
+        ty = fscal_ref[b, 0]
+        tx = fscal_ref[b, 1]
+        exact = fscal_ref[b, 2]
+        true_h = fscal_ref[b, 3]
+
+        Hw, Wp = src_ref.shape
+        full = src_ref[:, :]
+        full = pltpu.roll(full, Hw - y0, 0)
+        # (slicing to CR rows before the column roll was measured SLOWER
+        # — 272 vs 156 us/frame at 512²: the intermediate slice breaks
+        # Mosaic's roll pipelining into an extra VMEM copy)
+        full = pltpu.roll(full, Wp - x0, 1)
+
+        # --- in-kernel upsample: column interpolation as one matmul ---
+        GWp = field_ref.shape[1]
+        dcell = jax.lax.broadcasted_iota(jnp.int32, (GWp, W), 0).astype(
+            jnp.float32
+        )
+        xcol = jax.lax.broadcasted_iota(jnp.int32, (GWp, W), 1).astype(
+            jnp.float32
+        )
+        ucol = jnp.clip((xcol + 0.5) * (gw / W) - 0.5, 0.0, gw - 1.0)
+        hatx = jnp.maximum(1.0 - jnp.abs(ucol - dcell), 0.0)  # (GWp, W)
+        fx_field = field_ref[:GHp, :]
+        fy_field = field_ref[GHp : 2 * GHp, :]
+        hi = jax.lax.Precision.HIGHEST
+        inner_x = jax.lax.dot(fx_field, hatx, precision=hi)  # (GHp, W)
+        inner_y = jax.lax.dot(fy_field, hatx, precision=hi)
+
+        def urow_of(y):
+            return jnp.clip((y + 0.5) * (gh / H) - 0.5, 0.0, gh - 1.0)
+
+        base = (s * strip).astype(jnp.float32)
+
+        # x-pass phases at the CONSUMER row: canvas row j holds frame
+        # row content consumed by output rows y_c with
+        # y_c = (base + j - RP) - ry(x, y_c) — two fixed-point steps.
+        jrows = jax.lax.broadcasted_iota(jnp.int32, (CR, W), 0).astype(
+            jnp.float32
+        )
+        y_b = jrows + base - float(RP)
+        y_c = y_b
+        for _ in range(2):
+            ry_c = row_interp(urow_of(y_c), inner_y)
+            y_c = y_b - ry_c
+        rx_c = row_interp(urow_of(y_c), inner_x)  # (CR, W)
+
+        mx = jnp.floor(rx_c)
+        fxp = rx_c - mx
+        mxi = mx.astype(jnp.int32)
+        r1 = jnp.zeros((CR, W), jnp.float32)
+        for k in range(-max_px, max_px + 2):
+            wk = jnp.where(mxi == k, 1.0 - fxp, 0.0) + jnp.where(
+                mxi == k - 1, fxp, 0.0
+            )
+            r1 = r1 + wk * full[:CR, RP + k : RP + k + W]
+
+        # y-pass phases exact at the output pixel
+        irows = jax.lax.broadcasted_iota(jnp.int32, (strip, W), 0).astype(
+            jnp.float32
+        )
+        yout = irows + base
+        uro = urow_of(yout)
+        ry_o = row_interp(uro, inner_y)
+        rx_o = row_interp(uro, inner_x)
+        my = jnp.floor(ry_o)
+        fyp = ry_o - my
+        myi = my.astype(jnp.int32)
+        acc = jnp.zeros((strip, W), jnp.float32)
+        for k in range(-max_px, max_px + 2):
+            wk = jnp.where(myi == k, 1.0 - fyp, 0.0) + jnp.where(
+                myi == k - 1, fyp, 0.0
+            )
+            acc = acc + wk * r1[RP + k : RP + k + strip, :]
+
+        # Coverage from the TRUE per-pixel sample positions.
+        cols = jax.lax.broadcasted_iota(jnp.int32, (strip, W), 1).astype(
+            jnp.float32
+        )
+        sy = yout + ty + ry_o
+        sx = cols + tx + rx_o
+        inb = (
+            (sy >= 0.0) & (sy <= true_h - 1.0)
+            & (sx >= 0.0) & (sx <= float(W) - 1.0)
+            & (yout <= true_h - 1.0)  # rows padded up to a strip multiple
+            & (exact > 0.5)
+        )
+        out_ref[:, :] = jnp.where(inb, acc, 0.0)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_px", "strip", "interpret", "with_ok")
+)
+def warp_batch_field(
+    frames: jnp.ndarray,
+    fields: jnp.ndarray,
+    max_px: int = 6,
+    strip: int | None = None,
+    interpret: bool = False,
+    with_ok: bool = False,
+) -> jnp.ndarray:
+    """Correct (B, H, W) frames through (B, gh, gw, 2) cell-centered
+    displacement fields (the ops/piecewise convention: corrected(p) =
+    frame(p + u(p)), u = bilinear upsample of the field, (ux, uy) last).
+
+    Matches `warp_frame_flow(frames, upsample_field(field))` to
+    O(|grad u|²) with zero gathers and no dense flow materialization.
+    `with_ok` also returns the (B,) bool flag; frames whose mean
+    translation leaves the ±PAD window or whose cell residual exceeds
+    max_px - 0.5 are zeroed and flagged, like every bounded kernel in
+    the family.
+    """
+    B, H, W = frames.shape
+    _, gh, gw, _ = fields.shape
+    if strip is None:
+        strip = pick_strip((H, W), max_px)
+    if strip is None:
+        raise ValueError(
+            f"warp_batch_field: no strip height fits VMEM for shape "
+            f"{(H, W)}; gate on supports() and use the XLA flow path"
+        )
+    RP, halo, S, Hw, Wp = _geometry(H, W, max_px, strip)
+
+    frames = jnp.asarray(frames, jnp.float32)
+    fields = jnp.asarray(fields, jnp.float32)
+    t = jnp.round(jnp.mean(fields, axis=(1, 2)))  # (B, 2) integer (tx, ty)
+    resid = fields - t[:, None, None, :]
+    maxr = jnp.max(jnp.abs(resid), axis=(1, 2, 3))
+    tx, ty = t[:, 0], t[:, 1]
+    exact = (
+        (ty >= -PAD) & (ty <= PAD) & (tx >= -PAD) & (tx <= PAD)
+        & (maxr <= max_px - 0.5)
+    ).astype(jnp.float32)
+    y0 = jnp.clip(ty.astype(jnp.int32) + PAD, 0, 2 * PAD)
+    x0 = jnp.clip(tx.astype(jnp.int32) + PAD, 0, 2 * PAD)
+    iscal = jnp.stack([y0, x0], axis=-1)  # (B, 2) int32
+    zeros = jnp.zeros_like(ty)
+    fscal = jnp.stack(
+        [ty, tx, exact, jnp.full((B,), float(H), jnp.float32),
+         zeros, zeros, zeros, zeros],
+        axis=-1,
+    )  # (B, 8) float32
+
+    # Residual field, channels folded onto the sublane axis:
+    # rows [0, GHp) = ux cells, rows [GHp, 2 GHp) = uy cells. The
+    # padded cells' hat weights vanish (|ucol - d| >= 1), so zero
+    # padding is exact.
+    GHp = -(-gh // 8) * 8
+    GWp = -(-gw // 128) * 128
+    fgrid = jnp.moveaxis(resid, -1, 1)  # (B, 2, gh, gw)
+    fgrid = jnp.pad(fgrid, ((0, 0), (0, 0), (0, GHp - gh), (0, GWp - gw)))
+    fgrid = fgrid.reshape(B, 2 * GHp, GWp)
+
+    # Edge-pad so taps clamp like the gather warp; bottom/right padding
+    # additionally covers the strip-multiple and tile-alignment slack.
+    hp_total = (S - 1) * strip + Hw
+    padded = jnp.pad(
+        frames,
+        ((0, 0), (halo, hp_total - H - halo), (halo, Wp - W - halo)),
+        mode="edge",
+    )
+    if S == 1:
+        strips = padded[:, None]  # (B, 1, Hw, Wp) — no read amplification
+    else:
+        strips = jnp.stack(
+            [
+                jax.lax.slice_in_dim(padded, s * strip, s * strip + Hw, axis=1)
+                for s in range(S)
+            ],
+            axis=1,
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (None, None, Hw, Wp), lambda b, s, iscal: (b, s, 0, 0)
+            ),
+            pl.BlockSpec(
+                (None, 2 * GHp, GWp), lambda b, s, iscal: (b, 0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec((None, strip, W), lambda b, s, iscal: (b, s, 0)),
+    )
+    out = pl.pallas_call(
+        _make_kernel(H, W, gh, gw, GHp, max_px, strip),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S * strip, W), jnp.float32),
+        interpret=interpret,
+    )(iscal, fscal, strips, fgrid)
+    out = out[:, :H, :]
+    return (out, exact > 0.5) if with_ok else out

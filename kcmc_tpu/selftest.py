@@ -307,6 +307,41 @@ def _check_warp_flow(size):
     )
 
 
+def _check_warp_field_fused(size):
+    """Fused field warp (in-kernel upsample + consumer-phase two-pass,
+    ops/pallas_warp_field.py) vs the gather oracle on the judged
+    piecewise field magnitudes — the round-5 polish re-warp route."""
+    import jax
+    import jax.numpy as jnp
+
+    from kcmc_tpu.ops.pallas_warp_field import warp_batch_field
+    from kcmc_tpu.ops.piecewise import upsample_field
+    from kcmc_tpu.ops.warp import warp_frame_flow
+
+    img = _scene((size, size), seed=13, n=1)[0]
+    rng = np.random.default_rng(1)
+    fields = []
+    for t in [(0, 0), (4.7, -3.1), (-9.4, 6.2)]:
+        coarse = rng.uniform(-2.5, 2.5, size=(8, 8, 2)).astype(np.float32)
+        fields.append(coarse + np.asarray(t, np.float32))
+    fields = jnp.asarray(np.stack(fields))
+    frames = jnp.asarray(np.stack([img] * 3))
+    flows = jax.vmap(lambda f: upsample_field(f, (size, size)))(fields)
+    ref = np.asarray(jax.vmap(warp_frame_flow)(frames, flows))
+    fast, ok_flags = warp_batch_field(frames, fields, max_px=6, with_ok=True)
+    d = np.abs(np.asarray(fast) - ref)
+    # consumer-phase-corrected: ~30x tighter than warp_flow's split
+    ok = (
+        bool(np.asarray(ok_flags).all())
+        and float(d.mean()) < 2e-4
+        and float(d.max()) < 0.02
+    )
+    return _record(
+        "warp_field_fused_vs_gather", ok,
+        f"mean={d.mean():.2e} max={d.max():.2e}",
+    )
+
+
 def _check_detect3d(shape3d):
     import jax.numpy as jnp
 
@@ -664,6 +699,7 @@ def run_selftest(size: int = 512, size3d=(32, 256, 256)) -> list[dict]:
         ),
         ("describe2d_banded_vs_jnp", lambda: _check_patch_banded()),
         ("match_banded_at_scale", lambda: _check_match_banded_scale()),
+        ("warp_field_fused_vs_gather", lambda: _check_warp_field_fused(size)),
     ]
     results = []
     for name, chk in checks:
