@@ -66,6 +66,22 @@ _SEL_ROT = np.stack(
     [_selection_matrix(ROT_PATTERNS[b], ROT_RADIUS) for b in range(N_ORIENT_BINS)]
 )  # (NB, 31^2, 512)
 
+# ORB moment correlation kernels (2, 1, 2mr+1, 2mr+1): disc-masked dx
+# and dy coordinate weights — the frame-level counterpart of _MOMENTS
+# for the bins-first describe path (round 5). Integer values <= 7, so
+# they are exact in bf16 and each conv product is exact under f32
+# accumulation.
+_MOMENT_KERNELS = np.stack(
+    [
+        (_MOMENTS[..., 0] * _MOMENTS[..., 2]).astype(np.float32),
+        (_MOMENTS[..., 1] * _MOMENTS[..., 2]).astype(np.float32),
+    ]
+)[:, None]
+
+_RUN_ALIGN = 16  # orientation-run alignment: the extraction kernel's
+# keypoint block (_KB) and the bf16 sublane tile — run starts stay
+# block-aligned so the dispatch copy moves whole blocks
+
 
 def _extract_patches(
     smooth: jnp.ndarray, xy: jnp.ndarray, radius: int
@@ -326,14 +342,21 @@ def describe_keypoints_batch(
     # bit parity is preserved up to the blend-rounding ties it already
     # had.
     if oriented:
-        pb, m10, m01 = extract_blended(
-            padded, kps.xy, P, with_moments=True, interpret=interpret,
-            out_dtype=jnp.bfloat16,  # quantized in-kernel: half the write
+        # Bins-first (round 5): orientation from frame-level moment
+        # correlations, keypoints sorted into aligned orientation runs,
+        # extraction + selection with no (B, K, L) gather or value
+        # scatter — see _describe_oriented_sorted. Replaces the
+        # extract-then-dispatch route (in-kernel moments cost 9
+        # ms/batch on top of 22 extraction; _binned_select another 25;
+        # the sorted route's overhead is ~6 ms of convs, tiny gathers,
+        # one sort, one DMA block-permutation and a packed scatter).
+        m10, m01 = _moments_at_keypoints(
+            padded, kps.xy, r, interpret=interpret
         )
-        angles = jnp.arctan2(m01[..., 0], m10[..., 0])  # (B, K)
-        bins = _quantize_bins(angles)
-        flat = pb.reshape(B, K, -1)  # (B, K, L) bf16
-        vals = jax.vmap(_binned_select)(flat, bins, kps.valid)
+        bins = _quantize_bins(jnp.arctan2(m01, m10))
+        return _describe_oriented_sorted(
+            padded, kps, bins, P, interpret=interpret
+        )
     else:
         pb = extract_blended(
             padded, kps.xy, P, interpret=interpret, out_dtype=jnp.bfloat16
@@ -342,6 +365,191 @@ def describe_keypoints_batch(
         vals = _onehot_select(flat, jnp.asarray(_SEL_UPRIGHT))
 
     return _finalize_descriptors(vals, kps.valid)
+
+
+def _moments_at_keypoints(
+    padded: jnp.ndarray, xy: jnp.ndarray, r: int,
+    use_pallas: bool = True, interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, K) ORB disc moments (m10, m01) at round-half-up(xy), from the
+    (quantized, mean-removed) padded batch — WITHOUT patch extraction.
+
+    Two frame-level moment maps (pallas_patch.moment_maps, with a conv
+    fallback) + two tiny pointwise gathers per frame (the detect
+    stage's subpixel-field pattern).
+    This is what breaks round 4's "bin-sorted extraction is circular"
+    dead end (DESIGN.md "Oriented descriptors"): orientation bins now
+    exist BEFORE extraction, so extraction can run in bin-run order.
+    Values match the in-patch moments up to f32 summation order (the
+    disc weights are small integers, exact in bf16; order differences
+    flip an orientation bin only for angles within ~1e-6 of a bin
+    boundary — sensor-noise territory).
+    """
+    from kcmc_tpu.ops.pallas_patch import moment_maps, moment_maps_supported
+
+    B = padded.shape[0]
+    mr = _MOMENT_RADIUS
+    if moment_maps_supported(padded.shape[1:]) and use_pallas:
+        m10m, m01m = moment_maps(padded, interpret=interpret)
+    else:
+        # conv fallback (off-accelerator / frames beyond the kernel's
+        # VMEM gate). NOTE: XLA lowers this 1-in/2-out-channel conv at
+        # ~27 ms for a 32x512² batch on v5e — on-chip callers want the
+        # kernel route.
+        kern = jnp.asarray(_MOMENT_KERNELS, padded.dtype)
+        maps = lax.conv_general_dilated(
+            padded[:, None], kern, (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.float32,
+        )
+        m10m, m01m = maps[:, 0], maps[:, 1]
+    # map[i, j] is the disc sum centered at padded[i + mr, j + mr] =
+    # frame pixel (i + mr - (r + 1), ...)
+    Hm, Wm = m10m.shape[-2:]
+    fx = xy[..., 0] - jnp.floor(xy[..., 0])
+    fy = xy[..., 1] - jnp.floor(xy[..., 1])
+    # round-half-up: the _moment_angles disc-center convention
+    cx = jnp.floor(xy[..., 0]).astype(jnp.int32) + (fx >= 0.5)
+    cy = jnp.floor(xy[..., 1]).astype(jnp.int32) + (fy >= 0.5)
+    iy = jnp.clip(cy + (r + 1 - mr), 0, Hm - 1)
+    ix = jnp.clip(cx + (r + 1 - mr), 0, Wm - 1)
+    flat_idx = iy * Wm + ix  # (B, K)
+    m10 = jax.vmap(lambda m, f: m.reshape(-1)[f])(m10m, flat_idx)
+    m01 = jax.vmap(lambda m, f: m.reshape(-1)[f])(m01m, flat_idx)
+    return m10, m01
+
+
+def _aligned_runs(keys: jnp.ndarray, n_groups: int, align: int):
+    """Stable sort of (N,) integer keys into align-aligned contiguous
+    runs, one per group; keys >= n_groups are dropped (sentinel).
+
+    Returns (src, astarts, aends): src (Kp,) int32 — source item index
+    per sorted slot, N for padding slots — where Kp is the static bound
+    ceil_align(N) + align * n_groups; astarts/aends (n_groups,) int32 —
+    each group's aligned run [astarts[g], aends[g]) (aends - astarts =
+    ceil_align(count)). Stability keeps detection-score order within a
+    run, so capacity overflow downstream drops each bin's weakest
+    keypoints — the segment_by_key contract.
+    """
+    N = keys.shape[0]
+    Kp = -(-N // align) * align + align * n_groups
+    order = jnp.argsort(keys)  # stable
+    sk = keys[order]
+    ids = jnp.arange(n_groups, dtype=sk.dtype)
+    starts = jnp.searchsorted(sk, ids, side="left").astype(jnp.int32)
+    ends = jnp.searchsorted(sk, ids, side="right").astype(jnp.int32)
+    padded_counts = -(-(ends - starts) // align) * align
+    astarts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded_counts)[:-1]]
+    )
+    aends = astarts + padded_counts
+    pos = jnp.arange(N, dtype=jnp.int32)
+    skc = jnp.clip(sk, 0, n_groups - 1)
+    dest = jnp.where(
+        sk < n_groups, astarts[skc] + pos - starts[skc], Kp
+    )
+    src = (
+        jnp.full((Kp + 1,), N, jnp.int32)
+        .at[dest].set(order.astype(jnp.int32))[:Kp]
+    )
+    return src, astarts, aends
+
+
+def _describe_oriented_sorted(
+    padded: jnp.ndarray,
+    kps: Keypoints,
+    bins: jnp.ndarray,
+    P: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Bins-first oriented descriptors (round 5): extraction in
+    orientation-run order, selection as contiguous per-bin matmuls.
+
+    The post-hoc bin dispatch (_binned_select) pays a (B, K, L) row
+    gather into the capacity layout and a (B, K, 512) value scatter
+    back — 25 ms/batch at K=4096, B=32, on par with extraction itself.
+    With bins known BEFORE extraction (_moments_at_keypoints), the
+    keypoint arrays are permuted ONCE (K-row copies of 2-4 values),
+    extraction emits patch rows already grouped into aligned
+    orientation runs, and the dispatch layout is a pure block
+    permutation (pallas_patch.dispatch_copy_rows). Descriptors are
+    finalized and PACKED in the dispatch layout, so the scatter back to
+    original keypoint order moves N_WORDS uint32 per keypoint — 60x
+    fewer bytes than the value scatter it replaces.
+
+    Capacity contract unchanged from _binned_select: cap ~ 2x the
+    uniform share (align-rounded); overflow drops each bin's weakest
+    keypoints to the all-zero invalid descriptor.
+    """
+    B, K = kps.xy.shape[:2]
+    nb = N_ORIENT_BINS
+    align = _RUN_ALIGN
+    cap = min(
+        -(-K // align) * align,
+        max(align, -(-2 * K // (nb * align)) * align),
+    )
+    keys = jnp.where(kps.valid, bins, nb)
+    src, astarts, aends = jax.vmap(
+        lambda k: _aligned_runs(k, nb, align)
+    )(keys)
+    Kp = src.shape[1]
+
+    safe = jnp.minimum(src, K - 1)
+    xy_s = jnp.where(
+        (src < K)[..., None],
+        jnp.take_along_axis(kps.xy, safe[..., None], axis=1),
+        0.0,
+    )  # (B, Kp, 2)
+
+    from kcmc_tpu.ops.pallas_patch import dispatch_copy_rows, extract_blended
+
+    pb = extract_blended(
+        padded, xy_s, P, interpret=interpret, out_dtype=jnp.bfloat16
+    )
+    flat = pb.reshape(B, Kp, -1)  # (B, Kp, L) bf16, orientation-run order
+
+    # block routing: align-row block i starts at sorted slot 16*i; its
+    # bin is the run covering that slot, overflow routes to trash nb
+    s_blk = jnp.arange(Kp // align, dtype=jnp.int32)[None, :] * align
+    ibin = jax.vmap(
+        lambda ae, s: jnp.searchsorted(ae, s, side="right").astype(jnp.int32)
+    )(aends, jnp.broadcast_to(s_blk, (B, Kp // align)))
+    inrun = ibin < nb
+    ibin_c = jnp.minimum(ibin, nb - 1)
+    slot_blk = (
+        s_blk - jnp.take_along_axis(astarts, ibin_c, axis=1)
+    ) // align
+    overflow = (~inrun) | (slot_blk >= cap // align)
+    ibin_r = jnp.where(overflow, nb, ibin_c)
+    islot_r = jnp.where(overflow, 0, slot_blk)
+    disp = dispatch_copy_rows(
+        flat, ibin_r, islot_r, nb, cap, align, interpret=interpret
+    )[:, :nb]  # (B, nb, cap, L)
+
+    # exact one-pass bf16 selection (0/1 one-hot weights, f32 accum —
+    # same exactness argument as _binned_select's bf16 branch)
+    sel = jnp.asarray(_SEL_ROT).astype(jnp.bfloat16)
+    vals = jnp.einsum(
+        "bncl,nlv->bncv", disp, sel, preferred_element_type=jnp.float32
+    ).astype(jnp.bfloat16)
+
+    # finalize + pack IN the dispatch layout, then scatter words back
+    vals = vals.reshape(B, nb, cap, N_BITS, 2)
+    words = _pack_bits(vals[..., 0] < vals[..., 1])  # (B, nb, cap, W)
+
+    slot = astarts[:, :, None] + jnp.arange(cap, dtype=jnp.int32)[None, None, :]
+    in_run = slot < aends[:, :, None]  # beyond a run: next bin's rows
+    src_k = jnp.take_along_axis(
+        src, jnp.minimum(slot, Kp - 1).reshape(B, -1), axis=1
+    ).reshape(B, nb, cap)
+    dest = jnp.where(in_run & (src_k < K), src_k, K)  # (B, nb, cap)
+
+    def scatter_words(w, d):
+        out = jnp.zeros((K + 1, N_WORDS), jnp.uint32)
+        return out.at[d.reshape(-1)].set(w.reshape(-1, N_WORDS))[:K]
+
+    desc = jax.vmap(scatter_words)(words, dest)
+    return jnp.where(kps.valid[..., None], desc, 0)
 
 
 def _binned_select(flat: jnp.ndarray, bins: jnp.ndarray, valid) -> jnp.ndarray:

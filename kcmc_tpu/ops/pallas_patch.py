@@ -945,3 +945,187 @@ def extract_patches(
         padded.astype(jnp.float32),
     )
     return out[:, :K]
+
+
+def dispatch_copy_rows(
+    flat: jnp.ndarray,  # (B, Kp, L) bin-sorted rows (aligned runs)
+    ibin: jnp.ndarray,  # (B, NBLK) int32 target bin per align-row block
+    islot: jnp.ndarray,  # (B, NBLK) int32 target slot-block within the bin
+    n_groups: int,
+    cap: int,
+    align: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Element-indexed block scatter: sorted rows -> dispatch layout.
+
+    The round-5 bins-first descriptor path sorts keypoints into
+    align-row orientation runs BEFORE extraction, so grouping the
+    extracted patch rows for the per-bin selection matmul is a pure
+    block permutation — each align-row block of `flat` lands whole at
+    (ibin, islot) of a (B, n_groups + 1, cap, L) buffer (group n_groups
+    is the trash row for overflow blocks). This replaces binned
+    selection's (B, K, L) row gather + row scatter — measured 25
+    ms/batch at K=4096, B=32, the describe stage's largest non-
+    extraction cost — with one DMA-speed Pallas copy whose out-block
+    index comes from scalar prefetch (the Element-indexed blocks
+    pattern, DESIGN.md).
+
+    Blocks land whole because run starts are align-aligned by
+    construction (ops/describe._aligned_runs). Unwritten slots of the
+    output (beyond each run's rows, and the trash group) are
+    UNINITIALIZED — callers must route their results to a masked
+    destination, which the packed-descriptor scatter-back does.
+    """
+    B, Kp, L = flat.shape
+    NBLK = Kp // align
+
+    def kernel(ibin_ref, islot_ref, in_ref, out_ref):
+        del ibin_ref, islot_ref
+        out_ref[...] = in_ref[...]
+
+    # two flat (B, NBLK) prefetch arrays — a stacked (B, NBLK, 2) form
+    # pads its 2-lane minor dim to 128 in SMEM (measured: a 4.25 MB
+    # "prefetched SMEM operand" compile OOM vs the 1 MB space)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NBLK),
+        in_specs=[
+            pl.BlockSpec(
+                (None, align, L), lambda b, i, ibin, islot: (b, i, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (None, None, align, L),
+            lambda b, i, ibin, islot: (b, ibin[b, i], islot[b, i], 0),
+        ),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (B, n_groups + 1, cap, L), flat.dtype
+        ),
+        interpret=interpret,
+    )(ibin.astype(jnp.int32), islot.astype(jnp.int32), flat)
+
+
+def _moment_band_structure():
+    """Disc rows grouped by half-width: {w: [dy, ...]} from the shared
+    MOMENTS constant, so the kernel and the conv fallback can never
+    disagree about the disc."""
+    from kcmc_tpu.ops.patterns import MOMENT_RADIUS, MOMENTS
+
+    mr = MOMENT_RADIUS
+    by_w: dict[int, list[int]] = {}
+    for i in range(2 * mr + 1):
+        inside = MOMENTS[i, :, 2] > 0
+        w = int(np.max(np.abs(MOMENTS[i, inside, 0]))) if inside.any() else -1
+        if w >= 0:
+            by_w.setdefault(w, []).append(i - mr)
+    return mr, by_w
+
+
+_MOM_STRIP = 128  # output rows per moment-map program. Two measured
+# constraints: (1) Mosaic keeps every shifted-view temporary of a
+# pure-value width loop live on the kernel stack (a whole-frame 512²
+# program allocated 49.8 MB of scoped vmem — ~42 map-sized temps — and
+# died), so hx/sx accumulate IN SCRATCH REFS — which still leaves a
+# measured ~35-temp stack (19.3 MB at 256-row strips: the dx-step
+# slice/product temporaries), so 128 rows it is (~10 MB); (2) small
+# strips lose to per-program overhead (64-row strips = 288 programs
+# measured ~9 ms/batch).
+
+
+def moment_maps_supported(padded_shape: tuple[int, int]) -> bool:
+    """VMEM gate for the strip moment-maps kernel: ~8 live strip-sized
+    f32 arrays (input upcast, hx/sx scratch, two out blocks, slack —
+    scratch accumulation pins the width loop's footprint)."""
+    Hp, Wp = padded_shape
+    rows = _MOM_STRIP + 14  # + 2 * MOMENT_RADIUS
+    return rows * Wp * (2 + 36 * 4) <= 16 * 1024 * 1024
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def moment_maps(padded: jnp.ndarray, interpret: bool = False):
+    """ORB intensity-centroid moment maps (m10, m01) over a padded
+    batch — the frame-level moments of the bins-first describe path.
+
+    padded: (B, Hp, Wp) (bf16 or f32, the describe quantization
+    convention). Returns two (B, Hp - 2mr, Wp - 2mr) f32 maps;
+    maps[i, j] is the disc moment centered at padded[i + mr, j + mr]
+    (identical indexing to a VALID lax.conv with the _MOMENT_KERNELS —
+    which XLA lowers at ~27 ms/batch for a 32x512² batch because the
+    1-in/2-out channel conv cannot tile the MXU).
+
+    Structure: the disc is a stack of constant-half-width row bands, so
+    each distinct width w needs ONE dx-weighted horizontal pass (for
+    m10) and ONE horizontal box pass (for m01), then its band rows
+    accumulate with pure vertical shifts (dy-weighted for m01). Row
+    strips are stacked on the host (the pallas_warp_field pattern —
+    overlapping windows cannot be Pallas block indexing), sized by the
+    measured ~45-temp kernel stack (_MOM_STRIP).
+    """
+    B, Hp, Wp = padded.shape
+    mr, by_w = _moment_band_structure()
+    Hm, Wm = Hp - 2 * mr, Wp - 2 * mr
+    R = _MOM_STRIP
+    S = -(-Hm // R)
+    rows = R + 2 * mr
+    # strip s computes output rows [s*R, s*R + R) from padded rows
+    # [s*R, s*R + R + 2mr); pad the bottom so the last strip's window
+    # exists (its extra output rows are sliced off)
+    pad_rows = (S - 1) * R + rows - Hp
+    src = jnp.pad(padded, ((0, 0), (0, max(0, pad_rows)), (0, 0)), mode="edge")
+    strips = jnp.stack(
+        [
+            jax.lax.slice_in_dim(src, s * R, s * R + rows, axis=1)
+            for s in range(S)
+        ],
+        axis=1,
+    )  # (B, S, rows, Wp)
+
+    def kernel(in_ref, m10_ref, m01_ref, hx_ref, sx_ref):
+        p = in_ref[...].astype(jnp.float32)  # (rows, Wp)
+        m10_ref[...] = jnp.zeros((R, Wm), jnp.float32)
+        m01_ref[...] = jnp.zeros((R, Wm), jnp.float32)
+        for w, dys in sorted(by_w.items()):
+            # accumulate the horizontal passes in scratch: a pure-value
+            # formulation keeps every += step's temporary live on the
+            # kernel stack (measured 49.8 MB scoped-vmem OOM)
+            hx_ref[...] = jnp.zeros((rows, Wm), jnp.float32)
+            sx_ref[...] = jnp.zeros((rows, Wm), jnp.float32)
+            for dx in range(-w, w + 1):
+                v = p[:, mr + dx : mr + dx + Wm]
+                sx_ref[...] = sx_ref[...] + v
+                if dx:
+                    hx_ref[...] = hx_ref[...] + float(dx) * v
+            for dy in dys:
+                m10_ref[...] = (
+                    m10_ref[...] + hx_ref[mr + dy : mr + dy + R, :]
+                )
+                if dy:
+                    m01_ref[...] = m01_ref[...] + float(dy) * sx_ref[
+                        mr + dy : mr + dy + R, :
+                    ]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, S),
+        in_specs=[
+            pl.BlockSpec((None, None, rows, Wp), lambda b, s: (b, s, 0, 0))
+        ],
+        out_specs=[
+            pl.BlockSpec((None, R, Wm), lambda b, s: (b, s, 0)),
+            pl.BlockSpec((None, R, Wm), lambda b, s: (b, s, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S * R, Wm), jnp.float32),
+            jax.ShapeDtypeStruct((B, S * R, Wm), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, Wm), jnp.float32),
+            pltpu.VMEM((rows, Wm), jnp.float32),
+        ],
+        interpret=interpret,
+    )(strips)
+    return out[0][:, :Hm], out[1][:, :Hm]

@@ -1,0 +1,109 @@
+"""Bins-first oriented descriptor machinery (round 5).
+
+The production contract — descriptors from the sorted bins-first route
+equal the jnp oracle up to bf16 tie level — is covered by
+test_pallas_patch/test_detect_describe_match; these tests pin the new
+pieces directly: frame-level moments vs the conv definition, the
+aligned-run sort, and the element-indexed dispatch copy.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from kcmc_tpu.ops.describe import (
+    _MOMENT_KERNELS,
+    _aligned_runs,
+    _moments_at_keypoints,
+)
+from kcmc_tpu.ops.pallas_patch import dispatch_copy_rows, moment_maps
+
+
+def test_moment_maps_match_conv():
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(
+        rng.normal(size=(2, 224, 200)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    m10, m01 = moment_maps(p, interpret=True)
+    kern = jnp.asarray(_MOMENT_KERNELS, p.dtype)
+    maps = lax.conv_general_dilated(
+        p[:, None], kern, (1, 1), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=jnp.float32,
+    )
+    np.testing.assert_allclose(
+        np.asarray(m10), np.asarray(maps[:, 0]), atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(m01), np.asarray(maps[:, 1]), atol=1e-4
+    )
+
+
+def test_moments_at_keypoints_match_patch_moments():
+    # conv-fallback route vs the in-patch oracle definition
+    from kcmc_tpu.ops.describe import _extract_patches, _moment_angles
+    from kcmc_tpu.ops.patterns import ROT_RADIUS
+
+    rng = np.random.default_rng(1)
+    r = ROT_RADIUS
+    img = rng.normal(size=(160, 160)).astype(np.float32)
+    imgq = jnp.asarray(img).astype(jnp.bfloat16).astype(jnp.float32)
+    xy = jnp.asarray(
+        rng.uniform(20, 140, size=(64, 2)).astype(np.float32)
+    )
+    padded = jnp.pad(
+        jnp.asarray(img).astype(jnp.bfloat16)[None],
+        ((0, 0), (r + 1, r + 1), (r + 1, r + 1)), mode="edge",
+    )
+    m10, m01 = _moments_at_keypoints(
+        padded, xy[None], r, use_pallas=False
+    )
+    ang_new = np.arctan2(np.asarray(m01)[0], np.asarray(m10)[0])
+    raw, _ = _extract_patches(imgq, xy, r)
+    ang_old = np.asarray(_moment_angles(raw, xy, r))
+    # identical pixels, different summation order: tie-level only
+    d = np.abs(np.angle(np.exp(1j * (ang_new - ang_old))))
+    assert d.max() < 1e-4, f"max angle diff {d.max():.2e}"
+
+
+def test_aligned_runs_structure():
+    keys = jnp.asarray([2, 0, 2, 5, 0, 2, 9, 0], jnp.int32)  # 9 = drop
+    n_groups, align = 6, 4
+    src, astarts, aends = _aligned_runs(keys, n_groups, align)
+    src = np.asarray(src)
+    astarts, aends = np.asarray(astarts), np.asarray(aends)
+    N = keys.shape[0]
+    # group 0: items 1, 4, 7 (stable order), aligned run of 4
+    assert astarts[0] == 0 and aends[0] == 4
+    assert list(src[:4]) == [1, 4, 7, N]
+    # group 2: items 0, 2, 5
+    assert astarts[2] == 4 and aends[2] == 8
+    assert list(src[4:8]) == [0, 2, 5, N]
+    # group 5: item 3; empty groups have zero-length runs
+    assert astarts[5] == 8 and aends[5] == 12 and src[8] == 3
+    assert astarts[1] == aends[1] == 4
+    # dropped key (9) appears nowhere
+    assert 6 not in src[: aends[5]]
+    # padding slots carry the sentinel
+    assert (src[aends[5]:] == N).all()
+
+
+def test_dispatch_copy_rows_places_blocks():
+    rng = np.random.default_rng(3)
+    B, Kp, L, align, nb, cap = 2, 64, 96, 16, 3, 32
+    flat = jnp.asarray(rng.normal(size=(B, Kp, L)).astype(np.float32))
+    # frame 0: blocks -> (bin, slot): run layout [b0: 2 blocks][b2: 1][trash: 1]
+    ibin = jnp.asarray([[0, 0, 2, 3], [1, 3, 3, 2]], jnp.int32)
+    islot = jnp.asarray([[0, 1, 0, 0], [1, 0, 0, 1]], jnp.int32)
+    out = np.asarray(
+        dispatch_copy_rows(flat, ibin, islot, nb, cap, align, interpret=True)
+    )
+    f = np.asarray(flat)
+    np.testing.assert_array_equal(out[0, 0, 0:16], f[0, 0:16])
+    np.testing.assert_array_equal(out[0, 0, 16:32], f[0, 16:32])
+    np.testing.assert_array_equal(out[0, 2, 0:16], f[0, 32:48])
+    np.testing.assert_array_equal(out[1, 1, 16:32], f[1, 0:16])
+    np.testing.assert_array_equal(out[1, 2, 16:32], f[1, 48:64])
