@@ -484,9 +484,13 @@ def _describe_oriented_sorted(
     B, K = kps.xy.shape[:2]
     nb = N_ORIENT_BINS
     align = _RUN_ALIGN
+    # floor 2*align = 32: the _binned_select capacity floor — align
+    # alone would halve small-K bins' capacity and drop keypoints the
+    # replaced path kept (caught in review: K=64 single-orientation
+    # scene lost 48/64 vs 32/64)
     cap = min(
         -(-K // align) * align,
-        max(align, -(-2 * K // (nb * align)) * align),
+        max(2 * align, -(-2 * K // (nb * align)) * align),
     )
     keys = jnp.where(kps.valid, bins, nb)
     src, astarts, aends = jax.vmap(

@@ -289,6 +289,15 @@ def extract_blended(
     describe._extract_patches' blended output up to float summation
     order — and, with `with_moments`, the ORB intensity-centroid
     moments (m10, m01), each (B, K, 1).
+
+    `with_moments` note (round 5): production orientation moved to the
+    frame-level `moment_maps` route (describe._moments_at_keypoints),
+    so the in-kernel moment outputs have no shipping caller. They are
+    RETAINED DELIBERATELY as the on-chip moments oracle — the
+    independent per-patch computation that tests/test_pallas_patch.py
+    and the bins-first bin-agreement checks compare the map route
+    against (bin agreement 1.0, DESIGN.md "Bins-first oriented
+    descriptors").
     """
     oy = jnp.floor(xy[..., 1]).astype(jnp.int32) + 1
     ox = jnp.floor(xy[..., 0]).astype(jnp.int32) + 1
