@@ -741,6 +741,16 @@ class JaxBackend:
             and on_tpu
         )
         if use_matrix:
+            from kcmc_tpu.ops.pallas_warp_field import (
+                supports_matrix,
+                warp_batch_matrix_pallas,
+            )
+
+            mpx = self._matrix_resid_px(shape)
+            if on_tpu and supports_matrix(shape, mpx):
+                return functools.partial(
+                    warp_batch_matrix_pallas, max_px=mpx, with_ok=True
+                )
             from kcmc_tpu.ops.warp_field import warp_batch_matrix
 
             # Single-interpolation small-field kernel: exact to ~1e-4

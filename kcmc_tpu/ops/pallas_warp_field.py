@@ -294,3 +294,247 @@ def warp_batch_field(
     )(iscal, fscal, strips, fgrid)
     out = out[:, :H, :]
     return (out, exact > 0.5) if with_ok else out
+
+
+def _fits_matrix(H: int, W: int, max_px: int, strip: int) -> bool:
+    RP, _, _, Hw, Wp = _geometry(H, W, max_px, strip)
+    CR = strip + 2 * RP
+    # ~20 live (CR, W) temporaries measured: the analytic smap chains
+    # (consumer fixed-point iterations, projective divides) each pin
+    # several stack slots (a 256-row strip at 512² compiled standalone
+    # but hit a 20.2 MB scoped-vmem OOM inside the fused batch program)
+    return (2 * Hw * Wp + 20 * CR * W + strip * W) * 4 <= _VMEM_BUDGET
+
+
+def pick_strip_matrix(shape: tuple[int, int], max_px: int = 16) -> int | None:
+    """Strip height for the matrix-warp kernel (same 256-first rationale
+    as pick_strip; the larger default residual bound widens CR)."""
+    H, W = shape
+    for strip in (256, H, 128):
+        if strip <= H and _fits_matrix(H, W, max_px, strip):
+            return strip
+    return None
+
+
+def supports_matrix(shape: tuple[int, int], max_px: int = 16) -> bool:
+    return pick_strip_matrix(shape, max_px) is not None
+
+
+def _make_matrix_kernel(H, W, max_px, strip):
+    RP = max_px + 1
+    CR = strip + 2 * RP
+
+    def kernel(iscal_ref, fscal_ref, src_ref, out_ref, maxr_ref):
+        b = pl.program_id(0)
+        s = pl.program_id(1)
+        y0 = iscal_ref[b, 0]
+        x0 = iscal_ref[b, 1]
+        m00 = fscal_ref[b, 0]
+        m01 = fscal_ref[b, 1]
+        m02 = fscal_ref[b, 2]
+        m10 = fscal_ref[b, 3]
+        m11 = fscal_ref[b, 4]
+        m12 = fscal_ref[b, 5]
+        g = fscal_ref[b, 6]
+        h = fscal_ref[b, 7]
+        tcx = fscal_ref[b, 8]
+        tcy = fscal_ref[b, 9]
+        true_h = fscal_ref[b, 10]
+
+        Hw, Wp = src_ref.shape
+        full = src_ref[:, :]
+        full = pltpu.roll(full, Hw - y0, 0)
+        full = pltpu.roll(full, Wp - x0, 1)
+
+        def smap(x, y):
+            wq = g * x + h * y + 1.0
+            wq = jnp.where(
+                jnp.abs(wq) < 1e-6, jnp.where(wq < 0, -1e-6, 1e-6), wq
+            )
+            return (
+                (m00 * x + m01 * y + m02) / wq,
+                (m10 * x + m11 * y + m12) / wq,
+            )
+
+        base = (s * strip).astype(jnp.float32)
+
+        # x-pass phases at the consumer row (two fixed-point steps —
+        # the ops/warp_field.warp_batch_matrix correction, evaluated
+        # analytically per canvas row)
+        jrows = jax.lax.broadcasted_iota(jnp.int32, (CR, W), 0).astype(
+            jnp.float32
+        )
+        xcols = jax.lax.broadcasted_iota(jnp.int32, (CR, W), 1).astype(
+            jnp.float32
+        )
+        y_b = jrows + base - float(RP)
+        y_c = y_b
+        for _ in range(2):
+            _, sy_c = smap(xcols, y_c)
+            y_c = y_b - (sy_c - y_c - tcy)
+        sx_c, _ = smap(xcols, y_c)
+        rx = sx_c - xcols - tcx
+        mx = jnp.floor(rx)
+        fxp = rx - mx
+        mxi = mx.astype(jnp.int32)
+        r1 = jnp.zeros((CR, W), jnp.float32)
+        for k in range(-max_px, max_px + 2):
+            wk = jnp.where(mxi == k, 1.0 - fxp, 0.0) + jnp.where(
+                mxi == k - 1, fxp, 0.0
+            )
+            r1 = r1 + wk * full[:CR, RP + k : RP + k + W]
+
+        # y-pass phases exact at the output pixel
+        irows = jax.lax.broadcasted_iota(jnp.int32, (strip, W), 0).astype(
+            jnp.float32
+        )
+        ocols = jax.lax.broadcasted_iota(jnp.int32, (strip, W), 1).astype(
+            jnp.float32
+        )
+        yout = irows + base
+        sx_o, sy_o = smap(ocols, yout)
+        ux = sx_o - ocols - tcx
+        uy = sy_o - yout - tcy
+        my = jnp.floor(uy)
+        fyp = uy - my
+        myi = my.astype(jnp.int32)
+        acc = jnp.zeros((strip, W), jnp.float32)
+        for k in range(-max_px, max_px + 2):
+            wk = jnp.where(myi == k, 1.0 - fyp, 0.0) + jnp.where(
+                myi == k - 1, fyp, 0.0
+            )
+            acc = acc + wk * r1[RP + k : RP + k + strip, :]
+
+        inb = (
+            (sx_o >= 0.0) & (sx_o <= float(W) - 1.0)
+            & (sy_o >= 0.0) & (sy_o <= true_h - 1.0)
+            & (yout <= true_h - 1.0)
+        )
+        resid = jnp.maximum(jnp.abs(ux), jnp.abs(uy))
+        maxr_ref[...] = jnp.full(
+            (8, 128), jnp.max(jnp.where(inb, resid, 0.0)), jnp.float32
+        )
+        out_ref[:, :] = jnp.where(inb, acc, 0.0)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_px", "strip", "interpret", "with_ok")
+)
+def warp_batch_matrix_pallas(
+    frames: jnp.ndarray,
+    transforms: jnp.ndarray,
+    max_px: int = 16,
+    strip: int | None = None,
+    interpret: bool = False,
+    with_ok: bool = False,
+) -> jnp.ndarray:
+    """Pallas form of ops/warp_field.warp_batch_matrix: correct
+    (B, H, W) frames through (B, 3, 3) affine/projective transforms
+    with ONE bilinear interpolation and zero gathers.
+
+    Identical math to the XLA kernel — analytic source map, exact
+    integer center translation (here a `pltpu.roll` window instead of
+    one-hot shift matmuls), consumer-phase-corrected two-pass bounded
+    resample — but the 2*(2*max_px + 2) masked shifted views run over
+    the VMEM-resident strip instead of HBM-sized intermediates, and
+    the mask/residual fields are computed in-kernel rather than
+    materialized at (B, H, W). Same policy: frames whose in-coverage
+    residual exceeds max_px - 0.5 (or whose center translation leaves
+    the ±PAD window, or a degenerate M[2,2]) are zeroed and flagged.
+    The residual maximum is reduced per strip in-kernel and combined
+    on the host, so the flag is exact over pixels, like the XLA form.
+    """
+    B, H, W = frames.shape
+    if strip is None:
+        strip = pick_strip_matrix((H, W), max_px)
+    if strip is None:
+        raise ValueError(
+            f"warp_batch_matrix_pallas: no strip fits VMEM for {(H, W)}; "
+            "gate on supports_matrix() and use warp_batch_matrix"
+        )
+    RP, halo, S, Hw, Wp = _geometry(H, W, max_px, strip)
+    frames = jnp.asarray(frames, jnp.float32)
+    Ms = jnp.asarray(transforms, jnp.float32)
+    cy, cx = (H - 1) / 2.0, (W - 1) / 2.0
+
+    def prep(M):
+        den = jnp.where(jnp.abs(M[2, 2]) > 1e-6, M[2, 2], 1.0)
+        m = M / den
+        g, h = m[2, 0], m[2, 1]
+        w0 = g * cx + h * cy + 1.0
+        w0 = jnp.where(jnp.abs(w0) < 1e-6, 1.0, w0)
+        sx0 = (m[0, 0] * cx + m[0, 1] * cy + m[0, 2]) / w0
+        sy0 = (m[1, 0] * cx + m[1, 1] * cy + m[1, 2]) / w0
+        tcx = jnp.round(sx0 - cx)
+        tcy = jnp.round(sy0 - cy)
+        okm = jnp.abs(M[2, 2]) > 1e-6
+        return m, tcx, tcy, okm
+
+    ms, tcxs, tcys, okm = jax.vmap(prep)(Ms)
+    exact_t = (
+        (tcys >= -PAD) & (tcys <= PAD) & (tcxs >= -PAD) & (tcxs <= PAD)
+    )
+    y0 = jnp.clip(tcys.astype(jnp.int32) + PAD, 0, 2 * PAD)
+    x0 = jnp.clip(tcxs.astype(jnp.int32) + PAD, 0, 2 * PAD)
+    iscal = jnp.stack([y0, x0], axis=-1)
+    fscal = jnp.stack(
+        [
+            ms[:, 0, 0], ms[:, 0, 1], ms[:, 0, 2],
+            ms[:, 1, 0], ms[:, 1, 1], ms[:, 1, 2],
+            ms[:, 2, 0], ms[:, 2, 1],
+            tcxs, tcys, jnp.full((B,), float(H), jnp.float32),
+            jnp.zeros((B,), jnp.float32),
+        ],
+        axis=-1,
+    )  # (B, 12)
+
+    hp_total = (S - 1) * strip + Hw
+    padded = jnp.pad(
+        frames,
+        ((0, 0), (halo, hp_total - H - halo), (halo, Wp - W - halo)),
+        mode="edge",
+    )
+    if S == 1:
+        strips = padded[:, None]
+    else:
+        strips = jnp.stack(
+            [
+                jax.lax.slice_in_dim(padded, s * strip, s * strip + Hw, axis=1)
+                for s in range(S)
+            ],
+            axis=1,
+        )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (None, None, Hw, Wp), lambda b, s, iscal: (b, s, 0, 0)
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, strip, W), lambda b, s, iscal: (b, s, 0)),
+            pl.BlockSpec(
+                (None, None, 8, 128), lambda b, s, iscal: (b, s, 0, 0)
+            ),
+        ],
+    )
+    out, maxr = pl.pallas_call(
+        _make_matrix_kernel(H, W, max_px, strip),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S * strip, W), jnp.float32),
+            jax.ShapeDtypeStruct((B, S, 8, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(iscal, fscal, strips)
+    ok = (
+        okm & exact_t
+        & (jnp.max(maxr, axis=(1, 2, 3)) <= max_px - 0.5)
+    )
+    res = jnp.where(ok[:, None, None], out[:, :H, :], 0.0)
+    return (res, ok) if with_ok else res

@@ -342,6 +342,49 @@ def _check_warp_field_fused(size):
     )
 
 
+def _check_warp_matrix_pallas(size):
+    """Pallas matrix warp vs its XLA twin: identical f32 math, so the
+    contract is BIT equality on chip (the auto route prefers the Pallas
+    form; a single differing bit means the routes diverged)."""
+    import jax.numpy as jnp
+
+    from kcmc_tpu.ops.pallas_warp_field import warp_batch_matrix_pallas
+    from kcmc_tpu.ops.warp_field import warp_batch_matrix
+
+    img = _scene((size, size), seed=21, n=1)[0]
+    c = (size - 1) / 2.0
+    cases = []
+    for th_deg, tx, ty, g, h in [
+        (0.0, 0.0, 0.0, 0.0, 0.0),
+        (0.7, 12.4, -8.9, 0.0, 0.0),
+        (-0.5, -3.1, 5.6, 1.2e-5, -8e-6),
+    ]:
+        th = np.deg2rad(th_deg)
+        R = np.array(
+            [[np.cos(th), -np.sin(th), 0], [np.sin(th), np.cos(th), 0],
+             [0, 0, 1.0]]
+        )
+        C = np.array([[1, 0, c], [0, 1, c], [0, 0, 1.0]])
+        Ci = np.array([[1, 0, -c], [0, 1, -c], [0, 0, 1.0]])
+        T = np.array([[1, 0, tx], [0, 1, ty], [0, 0, 1.0]])
+        M = (C @ R @ Ci @ T).astype(np.float64)
+        M[2, 0] = g
+        M[2, 1] = h
+        cases.append(M.astype(np.float32))
+    frames = jnp.asarray(np.stack([img] * len(cases)))
+    Ms = jnp.asarray(np.stack(cases))
+    ref, ok_ref = warp_batch_matrix(frames, Ms, max_px=12, with_ok=True)
+    fast, ok_fast = warp_batch_matrix_pallas(
+        frames, Ms, max_px=12, with_ok=True
+    )
+    nbad = int(np.sum(np.asarray(fast) != np.asarray(ref)))
+    flags = bool(np.array_equal(np.asarray(ok_fast), np.asarray(ok_ref)))
+    return _record(
+        "warp_matrix_pallas_vs_xla", nbad == 0 and flags,
+        f"differing_px={nbad} flags_equal={flags}",
+    )
+
+
 def _check_detect3d(shape3d):
     import jax.numpy as jnp
 
@@ -700,6 +743,7 @@ def run_selftest(size: int = 512, size3d=(32, 256, 256)) -> list[dict]:
         ("describe2d_banded_vs_jnp", lambda: _check_patch_banded()),
         ("match_banded_at_scale", lambda: _check_match_banded_scale()),
         ("warp_field_fused_vs_gather", lambda: _check_warp_field_fused(size)),
+        ("warp_matrix_pallas_vs_xla", lambda: _check_warp_matrix_pallas(size)),
     ]
     results = []
     for name, chk in checks:

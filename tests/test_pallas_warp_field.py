@@ -127,3 +127,58 @@ def test_supports_and_pick_strip():
     assert supports((512, 512))
     assert pick_strip((512, 512)) == 256  # measured-fastest (DESIGN.md)
     assert pick_strip((192, 192)) == 192  # whole frame below 256 rows
+
+
+def _hom(theta_deg, tx, ty, g, h, sc=1.0, c=95.5):
+    th = np.deg2rad(theta_deg)
+    R = np.array(
+        [[sc * np.cos(th), -sc * np.sin(th), 0],
+         [sc * np.sin(th), sc * np.cos(th), 0], [0, 0, 1.0]]
+    )
+    C = np.array([[1, 0, c], [0, 1, c], [0, 0, 1.0]])
+    Ci = np.array([[1, 0, -c], [0, 1, -c], [0, 0, 1.0]])
+    T = np.array([[1, 0, tx], [0, 1, ty], [0, 0, 1.0]])
+    M = (C @ R @ Ci @ T).astype(np.float64)
+    M[2, 0] = g
+    M[2, 1] = h
+    return M.astype(np.float32)
+
+
+def test_matrix_pallas_bit_equals_xla(img):
+    """The Pallas matrix warp computes the identical f32 math to
+    ops/warp_field.warp_batch_matrix — outputs must be bit-equal, so
+    routing between them can never change results."""
+    from kcmc_tpu.ops.pallas_warp_field import warp_batch_matrix_pallas
+    from kcmc_tpu.ops.warp_field import warp_batch_matrix
+
+    cases = [
+        _hom(0.0, 0.0, 0.0, 0.0, 0.0),
+        _hom(0.0, 5.2, -3.8, 2e-5, -1.5e-5),
+        _hom(1.2, -4.1, 2.6, -2e-5, 2e-5),
+        _hom(-0.8, 30.3, -17.7, 0.0, 0.0, sc=1.01),
+    ]
+    frames = jnp.asarray(np.stack([img] * len(cases)))
+    Ms = jnp.asarray(np.stack(cases))
+    ref, ok_ref = warp_batch_matrix(frames, Ms, max_px=12, with_ok=True)
+    out, ok = warp_batch_matrix_pallas(
+        frames, Ms, max_px=12, interpret=True, with_ok=True
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # forced strip path identical too
+    out2 = warp_batch_matrix_pallas(
+        frames, Ms, max_px=12, strip=128, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(ref))
+
+
+def test_matrix_pallas_over_bound_zeroes_and_flags(img):
+    from kcmc_tpu.ops.pallas_warp_field import warp_batch_matrix_pallas
+
+    frames = jnp.asarray(img[None])
+    big = jnp.asarray(_hom(8.0, 0.0, 0.0, 0.0, 0.0)[None])  # >> 4 px resid
+    out, ok = warp_batch_matrix_pallas(
+        frames, big, max_px=4, interpret=True, with_ok=True
+    )
+    assert not bool(np.asarray(ok)[0])
+    assert np.all(np.asarray(out) == 0.0)
