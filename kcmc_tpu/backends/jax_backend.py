@@ -464,18 +464,26 @@ class JaxBackend:
             if not is_pw and cfg.n_octaves > 1 and cfg.pyramid_refine:
                 # Coarse-to-fine: the multi-scale estimate's floor is
                 # the coarse octave's localization noise (subpixel
-                # error x octave factor in base coords). Exactly warp
-                # each frame by the coarse estimate (gather warp — the
-                # bounded kernels would zero large zooms) and
-                # re-register single-scale: the residual motion is
-                # near-identity, so localization is full-resolution.
+                # error x octave factor in base coords). Warp each
+                # frame by the coarse estimate and re-register single-
+                # scale: the residual motion is near-identity, so
+                # localization is full-resolution. The intermediate
+                # warp rides the resolved gather-free batch kernel —
+                # for similarity that is the separable chain, whose
+                # scale matmuls handle the pyramid's large zooms
+                # unbounded (the per-frame GATHER warp used here
+                # through round 5's first session cost ~10 ms/frame on
+                # TPU and made the pyramid row ~20x slower than
+                # single-scale, 75 vs 1505 fps). Frames the bounded
+                # kernel flags (rotation beyond the shear bound — far
+                # outside the judged regime) skip the fine pass and
+                # keep the coarse estimate instead of refining against
+                # a zeroed image.
                 # Composition: corrected0(p) = frame(M1 p), pass 2
                 # gives corrected0 = ref-aligned via M_r, so
                 # ref -> frame is M1 @ M_r.
-                from kcmc_tpu.ops.warp import warp_frame
-
                 coarse = out["transform"]
-                corrected0 = jax.vmap(warp_frame)(frames, coarse)
+                corrected0, ok0 = batch_warp(frames, coarse)
                 kps2, desc2 = self._detect_describe_2d(
                     corrected0, use_pallas_patches, multi_scale=False
                 )
@@ -485,13 +493,19 @@ class JaxBackend:
                 out2 = jax.vmap(tail)(corrected0, kps2, desc2, keys2)
                 coarse_matches = out["n_matches"]
                 out = dict(out2)
+                eye = jnp.broadcast_to(
+                    jnp.eye(3, dtype=coarse.dtype), coarse.shape
+                )
+                fine = jnp.where(
+                    ok0[:, None, None], out2["transform"], eye
+                )
                 # full-f32 compose: TPU's default einsum precision is
                 # bf16-grade, and the coarse matrix carries
                 # O(frame-size) translation entries — an unpinned
                 # compose alone injects ~0.1-0.5 px of corner error at
                 # 512² (the same trap ops/polish.py documents)
                 out["transform"] = jnp.einsum(
-                    "bij,bjk->bik", coarse, out2["transform"],
+                    "bij,bjk->bik", coarse, fine,
                     precision=jax.lax.Precision.HIGHEST,
                 )
                 # standard keys report the FINAL (fine) fit; the coarse
