@@ -227,17 +227,20 @@ class ResumableCorrector:
         import jax
         import jax.numpy as jnp
 
-        from kcmc_tpu.ops.warp import warp_frame, warp_frame_flow, warp_volume
-        from kcmc_tpu.ops.piecewise import upsample_field
+        from kcmc_tpu.ops.warp import (
+            fast_apply_fields,
+            fast_apply_matrix,
+            warp_volume,
+        )
 
         if transforms is not None and transforms.shape[-1] == 4:
             fn = jax.jit(jax.vmap(warp_volume))
             return np.asarray(fn(jnp.asarray(stack, jnp.float32), jnp.asarray(transforms)))
         if transforms is not None:
-            fn = jax.jit(jax.vmap(warp_frame))
-            return np.asarray(fn(jnp.asarray(stack, jnp.float32), jnp.asarray(transforms)))
-        shape = stack.shape[1:]
-        flow_fn = jax.jit(
-            jax.vmap(lambda f, fld: warp_frame_flow(f, upsample_field(fld, shape)))
+            return fast_apply_matrix(
+                jnp.asarray(stack, jnp.float32), jnp.asarray(transforms)
+            )
+        return fast_apply_fields(
+            jnp.asarray(stack, jnp.float32),
+            jnp.asarray(fields, jnp.float32),
         )
-        return np.asarray(flow_fn(jnp.asarray(stack, jnp.float32), jnp.asarray(fields)))

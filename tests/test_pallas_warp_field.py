@@ -182,3 +182,43 @@ def test_matrix_pallas_over_bound_zeroes_and_flags(img):
     )
     assert not bool(np.asarray(ok)[0])
     assert np.all(np.asarray(out) == 0.0)
+
+
+def test_fast_apply_matrix_kernel_route_and_fallback(img):
+    """fast_apply_matrix: kernel route within tolerance of the gather
+    warp; envelope-exceeding transforms take the exact fallback so
+    every input still applies."""
+    from kcmc_tpu.ops.warp import fast_apply_matrix, warp_batch
+
+    frames = jnp.asarray(np.stack([img] * 3))
+    Ms = np.stack([
+        _hom(0.0, 3.2, -1.8, 0.0, 0.0),
+        _hom(0.6, -2.1, 4.4, 0.0, 0.0),
+        _hom(25.0, 0.0, 0.0, 0.0, 0.0),  # far beyond the residual bound
+    ])
+    out = fast_apply_matrix(frames, jnp.asarray(Ms), force_kernel=True)
+    ref = np.asarray(warp_batch(frames, jnp.asarray(Ms)))
+    d = np.abs(out - ref)
+    assert d.max() < 5e-3, f"max {d.max():.5f}"
+    # the fallback frame (index 2) is gather-exact
+    np.testing.assert_allclose(out[2], ref[2], atol=1e-5)
+
+
+def test_fast_apply_fields_kernel_route_and_fallback():
+    from kcmc_tpu.ops.warp import fast_apply_fields
+    from kcmc_tpu.ops.piecewise import upsample_field
+    from kcmc_tpu.ops.warp import warp_frame_flow
+
+    rng = np.random.default_rng(11)
+    H = W = 192
+    img = synthetic.render_scene(rng, (H, W), n_blobs=80).astype(np.float32)
+    frames = jnp.asarray(np.stack([img] * 2))
+    f = rng.uniform(-2.0, 2.0, size=(2, 8, 8, 2)).astype(np.float32)
+    f[1, :4] = 30.0  # beyond max_px: fallback frame
+    f[1, 4:] = -30.0
+    fields = jnp.asarray(f)
+    out = fast_apply_fields(frames, fields, force_kernel=True)
+    flows = jax.vmap(lambda ff: upsample_field(ff, (H, W)))(fields)
+    ref = np.asarray(jax.vmap(warp_frame_flow)(frames, flows))
+    assert np.abs(out[0] - ref[0]).max() < 5e-3
+    np.testing.assert_allclose(out[1], ref[1], atol=1e-5)
