@@ -82,6 +82,11 @@ _RUN_ALIGN = 16  # orientation-run alignment: the extraction kernel's
 # keypoint block (_KB) and the bf16 sublane tile — run starts stay
 # block-aligned so the dispatch copy moves whole blocks
 
+_BINS_FIRST_MIN_K = 2048  # bins-first pays a B*H*W-scaled moment-map
+# cost to delete B*K-scaled dispatch traffic; crossover ~K=1250 at
+# 512² (DESIGN.md "Bins-first oriented descriptors") — gate with
+# margin so small-K configs keep the extract-then-dispatch route
+
 
 def _extract_patches(
     smooth: jnp.ndarray, xy: jnp.ndarray, radius: int
@@ -341,7 +346,7 @@ def describe_keypoints_batch(
     # of a one-hot commutes with quantization exactly), so cross-path
     # bit parity is preserved up to the blend-rounding ties it already
     # had.
-    if oriented:
+    if oriented and K >= _BINS_FIRST_MIN_K:
         # Bins-first (round 5): orientation from frame-level moment
         # correlations, keypoints sorted into aligned orientation runs,
         # extraction + selection with no (B, K, L) gather or value
@@ -350,6 +355,10 @@ def describe_keypoints_batch(
         # ms/batch on top of 22 extraction; _binned_select another 25;
         # the sorted route's overhead is ~6 ms of convs, tiny gathers,
         # one sort, one DMA block-permutation and a packed scatter).
+        # K-GATED: the moment maps cost scales with B*H*W while the
+        # dispatch route's extras scale with B*K, so below ~K=1250 at
+        # 512² the maps LOSE (measured: the K=512 similarity row
+        # regressed 2180 -> 1916 fps when bins-first ran ungated).
         m10, m01 = _moments_at_keypoints(
             padded, kps.xy, r, interpret=interpret
         )
@@ -357,6 +366,17 @@ def describe_keypoints_batch(
         return _describe_oriented_sorted(
             padded, kps, bins, P, interpret=interpret
         )
+    if oriented:
+        # small-K oriented route: in-kernel moments ride the extraction
+        # slab for free at these K, and the dispatch gather/scatter is
+        # proportionally small
+        pb, m10, m01 = extract_blended(
+            padded, kps.xy, P, with_moments=True, interpret=interpret,
+            out_dtype=jnp.bfloat16,
+        )
+        bins = _quantize_bins(jnp.arctan2(m01[..., 0], m10[..., 0]))
+        flat = pb.reshape(B, K, -1)
+        vals = jax.vmap(_binned_select)(flat, bins, kps.valid)
     else:
         pb = extract_blended(
             padded, kps.xy, P, interpret=interpret, out_dtype=jnp.bfloat16
