@@ -31,9 +31,12 @@ checkpoint=...)`` streams with the same kill-safe resume machinery as a
 TIFF. Since round 5 the WRITE side is pluggable too: ``ZarrWriter``
 implements the TiffWriter streaming protocol (incremental append,
 checkpoint_state/resume, parallel deflate) over a Zarr v2 directory
-store, so ``correct_file("in.zarr", output="out.zarr")`` round-trips
-without transcoding to TIFF. Registration-only runs have no output
-file at all.
+store, and ``HDF5Writer`` the same over a contiguous early-allocated
+HDF5 dataset (uncompressed — the layout that keeps SIGKILL from
+corrupting HDF5 metadata), so ``correct_file("in.zarr",
+output="out.zarr")`` and ``correct_file("in.h5", output="out.h5")``
+round-trip without transcoding to TIFF. Registration-only runs have no
+output file at all.
 """
 
 from __future__ import annotations
@@ -400,14 +403,134 @@ class ZarrWriter:
         pass
 
 
+class HDF5Writer:
+    """Incremental HDF5 writer with the TiffWriter streaming protocol.
+
+    Kill-safety is the design constraint: HDF5's chunked layout updates
+    a B-tree on every chunk write, and a SIGKILL mid-update can corrupt
+    the FILE — not just the tail frame — which would break the resume
+    contract (already-written frames must survive any kill). So the
+    dataset is CONTIGUOUS with early allocation: all space and all
+    metadata are written at creation, after which appends are pure data
+    writes at fixed offsets (raw-file semantics — a torn tail frame is
+    simply overwritten when re-appended; the resumed DATASET is
+    bit-identical to an uninterrupted run's, though whole-file bytes
+    are not — HDF5 object headers embed creation timestamps). Contiguous layout cannot
+    compress; `compression="deflate"` is refused with a pointer to the
+    `.zarr` egress, whose one-chunk-per-frame layout compresses AND
+    keeps the same kill-safety.
+    """
+
+    dataset_name = "data"
+
+    def __init__(
+        self,
+        path,
+        n_frames: int,
+        frame_shape: tuple,
+        dtype,
+        compression: str = "none",
+    ):
+        import h5py
+
+        if compression != "none":
+            raise ValueError(
+                "HDF5 egress is uncompressed (contiguous layout is what "
+                "makes kill+resume safe — chunked+gzip HDF5 can corrupt "
+                "the whole file on SIGKILL); use a .zarr output for "
+                "compressed kill-safe egress"
+            )
+        self.path = os.fspath(path)
+        self.compression = compression
+        self.shape = (int(n_frames),) + tuple(int(s) for s in frame_shape)
+        self.dtype = np.dtype(dtype)
+        self._f = h5py.File(self.path, "w")
+        # contiguous + ALLOC_TIME_EARLY: the whole dataset (and every
+        # byte of metadata) exists on disk before the first append
+        space = h5py.h5s.create_simple(self.shape)
+        dcpl = h5py.h5p.create(h5py.h5p.DATASET_CREATE)
+        dcpl.set_layout(h5py.h5d.CONTIGUOUS)
+        dcpl.set_alloc_time(h5py.h5d.ALLOC_TIME_EARLY)
+        h5py.h5d.create(
+            self._f.id, self.dataset_name.encode(),
+            h5py.h5t.py_create(self.dtype, logical=True), space, dcpl,
+        ).close()
+        self._f.flush()
+        self._d = self._f[self.dataset_name]
+        self.n_pages = 0
+
+    def append_batch(self, frames: np.ndarray, n_threads: int = 0) -> None:
+        del n_threads  # uncompressed: the write is I/O-bound
+        frames = np.asarray(frames)
+        if tuple(frames.shape[1:]) != self.shape[1:]:
+            raise ValueError(
+                f"frame shape {frames.shape[1:]} != dataset {self.shape[1:]}"
+            )
+        if self.n_pages + len(frames) > self.shape[0]:
+            raise ValueError(
+                f"appending {len(frames)} frames past the dataset's "
+                f"{self.shape[0]}-frame shape (at {self.n_pages})"
+            )
+        self._d[self.n_pages : self.n_pages + len(frames)] = frames.astype(
+            self.dtype, copy=False
+        )
+        self._f.flush()
+        self.n_pages += len(frames)
+
+    def checkpoint_state(self) -> dict:
+        return {"format": "hdf5", "n_pages": int(self.n_pages)}
+
+    @classmethod
+    def resume(cls, path, state: dict, compression: str = "none") -> "HDF5Writer":
+        import h5py
+
+        path = os.fspath(path)
+        if state.get("format") != "hdf5":
+            raise OSError(f"{path}: checkpoint writer state is not hdf5")
+        if compression != "none":
+            raise OSError(
+                f"{path}: HDF5 egress is uncompressed; resume asked for "
+                f"{compression!r}"
+            )
+        self = object.__new__(cls)
+        self.path = path
+        self.compression = compression
+        try:
+            self._f = h5py.File(path, "r+")
+            self._d = self._f[cls.dataset_name]
+        except (OSError, KeyError) as e:
+            raise OSError(f"{path}: unreadable HDF5 output at resume: {e}")
+        self.shape = tuple(self._d.shape)
+        self.dtype = np.dtype(self._d.dtype)
+        try:
+            n = int(state["n_pages"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise OSError(f"{path}: malformed hdf5 writer state: {e}")
+        if n > self.shape[0]:
+            raise OSError(
+                f"{path}: checkpoint cursor {n} beyond dataset "
+                f"length {self.shape[0]}"
+            )
+        self.n_pages = n
+        return self
+
+    def close(self):
+        self._f.close()
+
+
 def make_writer(
     output, n_frames: int, frame_shape: tuple, dtype,
     compression: str = "none", bigtiff: bool = False,
 ):
     """Streaming-writer factory: dispatch on the output extension
-    (.zarr -> ZarrWriter, else TiffWriter)."""
-    if os.fspath(output).lower().endswith(".zarr"):
+    (.zarr -> ZarrWriter, .h5/.hdf5 -> HDF5Writer, else TiffWriter)."""
+    out = os.fspath(output).lower()
+    if out.endswith(".zarr"):
         return ZarrWriter(
+            output, n_frames, frame_shape, dtype, compression=compression
+        )
+    if out.endswith((".h5", ".hdf5")):
+        return HDF5Writer(
             output, n_frames, frame_shape, dtype, compression=compression
         )
     from kcmc_tpu.io.tiff import TiffWriter
@@ -417,8 +540,11 @@ def make_writer(
 
 def resume_writer(output, state: dict, compression: str = "none"):
     """Resume-side counterpart of `make_writer`."""
-    if os.fspath(output).lower().endswith(".zarr"):
+    out = os.fspath(output).lower()
+    if out.endswith(".zarr"):
         return ZarrWriter.resume(output, state, compression=compression)
+    if out.endswith((".h5", ".hdf5")):
+        return HDF5Writer.resume(output, state, compression=compression)
     from kcmc_tpu.io.tiff import TiffWriter
 
     return TiffWriter.resume(output, state, compression=compression)

@@ -312,3 +312,93 @@ def test_zarr_egress_apply_file(tmp_path, drift):
     )
     with open_stack(str(zout)) as ts:
         assert len(ts) == T and ts.dtype == np.uint16
+
+
+def test_hdf5_egress_roundtrip(tmp_path, drift):
+    """h5-in -> h5-out with no transcoding: the contiguous early-alloc
+    HDF5 writer (round 5) reads back through the ingest protocol with
+    the corrected pixels."""
+    h5py = pytest.importorskip("h5py")
+    arr = _u16(drift.stack)
+    hin = tmp_path / "in.h5"
+    with h5py.File(hin, "w") as f:
+        f.create_dataset("stack", data=arr)
+    hout = tmp_path / "out.h5"
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=8)
+    res = mc.correct_file(
+        str(hin), output=str(hout), chunk_size=8, output_dtype="input",
+    )
+    with open_stack(str(hout)) as ts:
+        assert len(ts) == T
+        assert ts.dtype == np.uint16
+        got = ts.read(0, T)
+    mem = MotionCorrector(
+        model="translation", backend="jax", batch_size=8
+    ).correct_file(str(hin), chunk_size=8, output_dtype="input")
+    np.testing.assert_array_equal(got, mem.corrected)
+    err = transform_rmse(
+        res.transforms, relative_transforms(drift.transforms), SHAPE
+    )
+    assert err < 0.15
+
+
+def test_hdf5_egress_checkpoint_resume(tmp_path, drift):
+    """Kill+resume with an HDF5 output: the contiguous layout's resume
+    must reproduce an uninterrupted run's DATASET exactly. (Whole-file
+    byte identity does not hold for HDF5 — object headers embed
+    creation timestamps — so the contract is dataset bytes, which is
+    what any reader consumes.)"""
+    pytest.importorskip("h5py")
+    arr = _u16(drift.stack)
+    hin = tmp_path / "in.h5"
+    import h5py
+
+    with h5py.File(hin, "w") as f:
+        f.create_dataset("stack", data=arr)
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=4
+    )
+    ref_out = tmp_path / "ref.h5"
+    mk().correct_file(
+        str(hin), output=str(ref_out), chunk_size=8, output_dtype="input",
+    )
+
+    calls = {"n": 0}
+    orig = ChunkedStackLoader._read
+
+    def poisoned(self, lo, hi):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("simulated kill")
+        return orig(self, lo, hi)
+
+    out = tmp_path / "out.h5"
+    ckpt = tmp_path / "run.ckpt.npz"
+    ChunkedStackLoader._read = poisoned
+    try:
+        with pytest.raises(RuntimeError, match="simulated kill"):
+            mk().correct_file(
+                str(hin), output=str(out), chunk_size=8,
+                checkpoint=str(ckpt), checkpoint_every=8,
+                output_dtype="input",
+            )
+    finally:
+        ChunkedStackLoader._read = orig
+    res = mk().correct_file(
+        str(hin), output=str(out), chunk_size=8, checkpoint=str(ckpt),
+        output_dtype="input",
+    )
+    assert res.timing["restored_frames"] > 0
+    with h5py.File(ref_out, "r") as fr, h5py.File(out, "r") as fo:
+        a, b = fr["data"][...], fo["data"][...]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_hdf5_egress_refuses_compression(tmp_path):
+    pytest.importorskip("h5py")
+    from kcmc_tpu.io.formats import HDF5Writer
+
+    with pytest.raises(ValueError, match="zarr"):
+        HDF5Writer(
+            tmp_path / "o.h5", 4, SHAPE, np.uint16, compression="deflate"
+        )
