@@ -207,3 +207,43 @@ def test_translation_auto_falls_back_beyond_pallas_vmem(monkeypatch):
     assert "warp_batch_translation" in repr(small.func)
     assert "warp_batch_translation_strips" in repr(large.func)
     assert "warp_batch_affine" in repr(huge.func)
+
+
+def test_matrix_auto_routes_pallas_with_vmem_fallback(monkeypatch):
+    """warp='auto' for rigid/affine/homography prefers the Pallas
+    matrix kernel (bit-equal to the XLA one) and falls back to the XLA
+    form where its VMEM gate rejects the shape."""
+    from kcmc_tpu.backends.jax_backend import JaxBackend
+    from kcmc_tpu.config import CorrectorConfig
+    from kcmc_tpu.ops import pallas_warp_field as pwf
+
+    monkeypatch.setattr(
+        JaxBackend, "_on_accelerator", staticmethod(lambda: True)
+    )
+    for model in ("rigid", "affine", "homography"):
+        backend = JaxBackend(CorrectorConfig(model=model, warp="auto"))
+        mpx = backend._matrix_resid_px((512, 512))
+        assert pwf.supports_matrix((512, 512), mpx)
+        fn = backend._resolve_batch_warp((512, 512))
+        assert "warp_batch_matrix_pallas" in repr(fn.func)
+    backend = JaxBackend(CorrectorConfig(model="affine", warp="auto"))
+    monkeypatch.setattr(pwf, "pick_strip_matrix", lambda *a, **k: None)
+    fn = backend._resolve_batch_warp((512, 512))
+    assert "warp_batch_matrix" in repr(fn.func)
+    assert "pallas" not in repr(fn.func)
+
+
+def test_piecewise_auto_routes_fused_field_warp(monkeypatch):
+    from kcmc_tpu.backends.jax_backend import JaxBackend
+    from kcmc_tpu.config import CorrectorConfig
+    from kcmc_tpu.ops import pallas_warp_field as pwf
+
+    monkeypatch.setattr(
+        JaxBackend, "_on_accelerator", staticmethod(lambda: True)
+    )
+    backend = JaxBackend(CorrectorConfig(model="piecewise", warp="auto"))
+    fn = backend._resolve_field_warp((512, 512))
+    assert fn is not None and "warp_batch_field" in repr(fn.func)
+    # beyond the VMEM gate: None -> the XLA flow path takes over
+    monkeypatch.setattr(pwf, "pick_strip", lambda *a, **k: None)
+    assert backend._resolve_field_warp((512, 512)) is None
