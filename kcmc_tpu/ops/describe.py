@@ -119,15 +119,17 @@ def _extract_patches(
 
 def _bilinear_blend(raw: jnp.ndarray, xy: jnp.ndarray) -> jnp.ndarray:
     """(P, P, K) keypoint-last raw patches -> (P-1, P-1, K) bilinear
-    resample at each keypoint's subpixel fraction."""
+    resample at each keypoint's subpixel fraction.
+
+    Separable grouping (y-lerp then x-lerp), matching the Pallas
+    extraction kernels' static-roll blend exactly — the grouping is
+    part of the bit-parity contract between this oracle and the
+    kernel paths (same multiplies and adds per element, so f32
+    results are identical, not merely close)."""
     fx = (xy[:, 0] - jnp.floor(xy[:, 0]))[None, None, :]
     fy = (xy[:, 1] - jnp.floor(xy[:, 1]))[None, None, :]
-    return (
-        (1.0 - fy) * (1.0 - fx) * raw[:-1, :-1]
-        + (1.0 - fy) * fx * raw[:-1, 1:]
-        + fy * (1.0 - fx) * raw[1:, :-1]
-        + fy * fx * raw[1:, 1:]
-    )
+    yb = (1.0 - fy) * raw[:-1] + fy * raw[1:]
+    return (1.0 - fx) * yb[:, :-1] + fx * yb[:, 1:]
 
 
 def _moment_angles(patches: jnp.ndarray, xy: jnp.ndarray, radius: int) -> jnp.ndarray:
@@ -161,11 +163,29 @@ def _moment_angles(patches: jnp.ndarray, xy: jnp.ndarray, radius: int) -> jnp.nd
     return jnp.arctan2(m01, m10)
 
 
+_PACK_HALVES = np.zeros((N_BITS, N_WORDS * 2), np.float32)
+for _i in range(N_BITS):
+    _PACK_HALVES[_i, _i // 16] = float(1 << (_i % 16))
+
+
 def _pack_bits(bits: jnp.ndarray) -> jnp.ndarray:
-    """(..., N_BITS) bool -> (..., N_WORDS) uint32."""
-    b = bits.reshape(bits.shape[:-1] + (N_WORDS, 32)).astype(jnp.uint32)
-    shifts = jnp.arange(32, dtype=jnp.uint32)
-    return jnp.sum(b << shifts, axis=-1, dtype=jnp.uint32)
+    """(..., N_BITS) bool -> (..., N_WORDS) uint32.
+
+    Exact MXU formulation (round 5): one constant (N_BITS, 2*N_WORDS)
+    matmul producing 16-bit half-words — 0/1 bf16 bits times power-of-two
+    bf16 weights under f32 accumulation is exact (each half-word
+    <= 65535 < 2^24), and the uint32 combine is integer arithmetic. The
+    shift-and-sum form it replaces materialized a (..., N_WORDS, 32)
+    uint32 intermediate (201 MB at config-2 scale) and measured 3.0
+    ms/batch; the matmul reads the bits once.
+    """
+    halves = jnp.matmul(
+        bits.astype(jnp.bfloat16),
+        jnp.asarray(_PACK_HALVES, jnp.bfloat16),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.uint32)
+    halves = halves.reshape(bits.shape[:-1] + (N_WORDS, 2))
+    return halves[..., 0] | (halves[..., 1] << 16)
 
 
 def _quantize_bins(angles: jnp.ndarray) -> jnp.ndarray:
@@ -448,13 +468,22 @@ def _aligned_runs(keys: jnp.ndarray, n_groups: int, align: int):
     ceil_align(N) + align * n_groups; astarts/aends (n_groups,) int32 —
     each group's aligned run [astarts[g], aends[g]) (aends - astarts =
     ceil_align(count)). Stability keeps detection-score order within a
-    run, so capacity overflow downstream drops each bin's weakest
-    keypoints — the segment_by_key contract.
+    run (and makes the layout deterministic for the parity oracles).
     """
     N = keys.shape[0]
     Kp = -(-N // align) * align + align * n_groups
-    order = jnp.argsort(keys)  # stable
-    sk = keys[order]
+    # stable argsort via ONE packed-key jnp.sort: (key << sh) | index
+    # sorts by key with ties broken by ascending index — exactly a
+    # stable argsort, at ~0 measured cost vs argsort's 4.3 ms/batch
+    # key-value sort at K=4096, B=32 (the keys are tiny ints, so the
+    # pack can't overflow: n_groups << sh + N < 2^31 for any real K)
+    sh = max(1, int(N - 1).bit_length())
+    packed = jnp.sort(
+        (keys.astype(jnp.int32) << sh)
+        | jnp.arange(N, dtype=jnp.int32)
+    )
+    order = packed & ((1 << sh) - 1)
+    sk = packed >> sh
     ids = jnp.arange(n_groups, dtype=sk.dtype)
     starts = jnp.searchsorted(sk, ids, side="left").astype(jnp.int32)
     ends = jnp.searchsorted(sk, ids, side="right").astype(jnp.int32)
@@ -483,7 +512,8 @@ def _describe_oriented_sorted(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Bins-first oriented descriptors (round 5): extraction in
-    orientation-run order, selection as contiguous per-bin matmuls.
+    orientation-run order, selection as per-block dynamic matmuls in
+    the SAME sorted layout.
 
     The post-hoc bin dispatch (_binned_select) pays a (B, K, L) row
     gather into the capacity layout and a (B, K, 512) value scatter
@@ -491,31 +521,23 @@ def _describe_oriented_sorted(
     With bins known BEFORE extraction (_moments_at_keypoints), the
     keypoint arrays are permuted ONCE (K-row copies of 2-4 values),
     extraction emits patch rows already grouped into aligned
-    orientation runs, and the dispatch layout is a pure block
-    permutation (pallas_patch.dispatch_copy_rows). Descriptors are
-    finalized and PACKED in the dispatch layout, so the scatter back to
-    original keypoint order moves N_WORDS uint32 per keypoint — 60x
-    fewer bytes than the value scatter it replaces.
-
-    Capacity contract unchanged from _binned_select: cap ~ 2x the
-    uniform share (align-rounded); overflow drops each bin's weakest
-    keypoints to the all-zero invalid descriptor.
+    orientation runs, and selection is pallas_patch.binned_select_rows
+    — each align-row block multiplied by its run's matrix, no capacity
+    layout, no drops (the first sorted-route revision routed blocks
+    through a (B, nb, cap, L) dispatch copy + batched einsum; the
+    in-layout matmul replaces both at ~1/3 the cost and retires the
+    capacity-overflow contract entirely). Descriptors are finalized and
+    PACKED in the sorted layout, so the scatter back to original
+    keypoint order moves N_WORDS uint32 per keypoint — 60x fewer bytes
+    than the value scatter the round-4 path used.
     """
     B, K = kps.xy.shape[:2]
     nb = N_ORIENT_BINS
     align = _RUN_ALIGN
-    # floor 2*align = 32: the _binned_select capacity floor — align
-    # alone would halve small-K bins' capacity and drop keypoints the
-    # replaced path kept (caught in review: K=64 single-orientation
-    # scene lost 48/64 vs 32/64)
-    cap = min(
-        -(-K // align) * align,
-        max(2 * align, -(-2 * K // (nb * align)) * align),
-    )
     keys = jnp.where(kps.valid, bins, nb)
-    src, astarts, aends = jax.vmap(
+    src, _astarts, aends = jax.vmap(
         lambda k: _aligned_runs(k, nb, align)
-    )(keys)
+    )(keys)  # only src (slot -> keypoint) and aends (block bins) drive
     Kp = src.shape[1]
 
     safe = jnp.minimum(src, K - 1)
@@ -525,52 +547,34 @@ def _describe_oriented_sorted(
         0.0,
     )  # (B, Kp, 2)
 
-    from kcmc_tpu.ops.pallas_patch import dispatch_copy_rows, extract_blended
+    from kcmc_tpu.ops.pallas_patch import binned_select_rows, extract_blended
 
     pb = extract_blended(
         padded, xy_s, P, interpret=interpret, out_dtype=jnp.bfloat16
     )
     flat = pb.reshape(B, Kp, -1)  # (B, Kp, L) bf16, orientation-run order
 
-    # block routing: align-row block i starts at sorted slot 16*i; its
-    # bin is the run covering that slot, overflow routes to trash nb
+    # block routing: align-row block i starts at sorted slot align*i;
+    # its bin is the run covering that slot (alignment-padding tail
+    # blocks read nb — binned_select_rows clamps, the scatter drops)
     s_blk = jnp.arange(Kp // align, dtype=jnp.int32)[None, :] * align
     ibin = jax.vmap(
         lambda ae, s: jnp.searchsorted(ae, s, side="right").astype(jnp.int32)
     )(aends, jnp.broadcast_to(s_blk, (B, Kp // align)))
-    inrun = ibin < nb
-    ibin_c = jnp.minimum(ibin, nb - 1)
-    slot_blk = (
-        s_blk - jnp.take_along_axis(astarts, ibin_c, axis=1)
-    ) // align
-    overflow = (~inrun) | (slot_blk >= cap // align)
-    ibin_r = jnp.where(overflow, nb, ibin_c)
-    islot_r = jnp.where(overflow, 0, slot_blk)
-    disp = dispatch_copy_rows(
-        flat, ibin_r, islot_r, nb, cap, align, interpret=interpret
-    )[:, :nb]  # (B, nb, cap, L)
 
-    # exact one-pass bf16 selection (0/1 one-hot weights, f32 accum —
-    # same exactness argument as _binned_select's bf16 branch)
     sel = jnp.asarray(_SEL_ROT).astype(jnp.bfloat16)
-    vals = jnp.einsum(
-        "bncl,nlv->bncv", disp, sel, preferred_element_type=jnp.float32
-    ).astype(jnp.bfloat16)
+    vals = binned_select_rows(
+        flat, ibin, sel, align, interpret=interpret
+    )  # (B, Kp, 512) bf16, sorted layout
 
-    # finalize + pack IN the dispatch layout, then scatter words back
-    vals = vals.reshape(B, nb, cap, N_BITS, 2)
-    words = _pack_bits(vals[..., 0] < vals[..., 1])  # (B, nb, cap, W)
-
-    slot = astarts[:, :, None] + jnp.arange(cap, dtype=jnp.int32)[None, None, :]
-    in_run = slot < aends[:, :, None]  # beyond a run: next bin's rows
-    src_k = jnp.take_along_axis(
-        src, jnp.minimum(slot, Kp - 1).reshape(B, -1), axis=1
-    ).reshape(B, nb, cap)
-    dest = jnp.where(in_run & (src_k < K), src_k, K)  # (B, nb, cap)
+    # finalize + pack IN the sorted layout, then scatter words back
+    vals = vals.reshape(B, Kp, N_BITS, 2)
+    words = _pack_bits(vals[..., 0] < vals[..., 1])  # (B, Kp, W)
+    dest = jnp.where(src < K, src, K)  # padding slots drop
 
     def scatter_words(w, d):
         out = jnp.zeros((K + 1, N_WORDS), jnp.uint32)
-        return out.at[d.reshape(-1)].set(w.reshape(-1, N_WORDS))[:K]
+        return out.at[d].set(w)[:K]
 
     desc = jax.vmap(scatter_words)(words, dest)
     return jnp.where(kps.valid[..., None], desc, 0)

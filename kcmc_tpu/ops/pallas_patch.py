@@ -135,6 +135,26 @@ def _frame_fits(Hp: int, Wp: int, P: int, itemsize: int = 4) -> bool:
     return 2 * Hpp * Wpp * itemsize <= _VMEM_FRAME_BUDGET
 
 
+def _wpp_2copy(Wp: int) -> int:
+    """Lane-padded width of the narrow-slab (2-copy) layout — the
+    single source of truth for the gate AND the wrapper's padding (the
+    1-copy path routes the same role through _slab_dims)."""
+    return -(-(Wp + 128) // 128) * 128
+
+
+def _frame_fits_2copy(Hp: int, Wp: int, P: int, itemsize: int = 4) -> bool:
+    """VMEM gate for the narrow-slab (two pre-shifted copies, 128-lane
+    window) resident layout: the block is (2, Hpp, Wpp2), still
+    double-buffered. Wpp2 uses a 128-lane margin instead of _WIN.
+    The 128-lane window holds residual(<64) + patch, so the layout is
+    only CORRECT for P <= 65 — larger P must take the wide window."""
+    if P > 65:
+        return False
+    S = _slab_rows(P, itemsize)
+    Hpp = Hp + S - P
+    return 2 * 2 * Hpp * _wpp_2copy(Wp) * itemsize <= _VMEM_FRAME_BUDGET
+
+
 def band_count(shape: tuple[int, int], P: int, itemsize: int = 4) -> int:
     """Bands for the row-banded extraction layout (round 5, DESIGN.md
     "Large-frame support" item 2): 1 = whole frame resident (use the
@@ -198,7 +218,7 @@ def _moment_maps(P: int) -> np.ndarray:
 def _blended_kernel(
     oy_ref, ox_ref, fx_ref, fy_ref, mm_ref, src_ref,
     pb_ref, m10_ref, m01_ref,
-    *, P: int, KB: int, with_moments: bool,
+    *, P: int, KB: int, with_moments: bool, ncopies: int = 1,
 ):
     """Patch cut + per-keypoint bilinear blend (+ ORB moments) fused.
 
@@ -208,12 +228,22 @@ def _blended_kernel(
     keypoint-last relayout the XLA path needs (and its ~6 ms/batch
     transpose) disappears — the descriptor selection matmul consumes
     (K, L) rows directly.
+
+    `ncopies=2` is the narrow-slab variant (round 5): the frame block
+    carries a second copy pre-shifted LEFT by 64 lanes, so the lane
+    residual after picking the right copy is < 64 and a 128-lane slab
+    covers residual + patch. Mosaic lowers a dynamic roll as
+    log2(lanes) conditional shift passes over the slab's vregs
+    regardless of the amount's range — so the win is the slab's SIZE
+    (6 vregs instead of 12), which halves every pass of both rolls and
+    the upcast: measured 13.8 -> 8.2 ms/batch at B=32, K=4096, 512².
     """
     b = pl.program_id(0)
     kb = pl.program_id(1)
     itemsize = jnp.dtype(src_ref.dtype).itemsize
     align = 16 if itemsize == 2 else 8
     S = _slab_rows(P, itemsize)
+    W = 128 if ncopies == 2 else _WIN
     # Scalar stores to VMEM are unsupported: accumulate the per-keypoint
     # moment scalars into (KB, 1) vectors (iota row-select) and store once.
     row = jax.lax.broadcasted_iota(jnp.int32, (KB, 1), 0)
@@ -224,41 +254,56 @@ def _blended_kernel(
         y0 = oy_ref[b, k]
         x0 = ox_ref[b, k]
         y0a = (y0 // align) * align
-        x0a = (x0 // 128) * 128
         # Mosaic's rotate is 32-bit-only: slice the (bf16 or f32) slab
         # out of the resident block, upcast the SLAB (tiny), roll in
         # f32. The frame block's HBM->VMEM fetch keeps the input
         # dtype's bytes; only the per-keypoint slab work runs f32.
-        slab = src_ref[pl.ds(y0a, S), pl.ds(x0a, _WIN)].astype(jnp.float32)
-        slab = pltpu.roll(slab, S - (y0 - y0a), 0)
-        slab = pltpu.roll(slab, _WIN - (x0 - x0a), 1)
-        patch = slab[:P, :P]
+        if ncopies == 2:
+            c = (x0 % 128) // 64  # which pre-shifted copy
+            xp = x0 - 64 * c
+            x0a = (xp // 128) * 128
+            slab = src_ref[
+                pl.ds(c, 1), pl.ds(y0a, S), pl.ds(x0a, W)
+            ][0].astype(jnp.float32)
+            rx = xp - x0a  # in [0, 64)
+        else:
+            x0a = (x0 // 128) * 128
+            slab = src_ref[pl.ds(y0a, S), pl.ds(x0a, W)].astype(jnp.float32)
+            rx = x0 - x0a
+        ry = y0 - y0a
         fx = fx_ref[i, 0]
         fy = fy_ref[i, 0]
-        w00 = (1.0 - fy) * (1.0 - fx)
-        w01 = (1.0 - fy) * fx
-        w10 = fy * (1.0 - fx)
-        w11 = fy * fx
-        pb_ref[i] = (
-            w00 * patch[: P - 1, : P - 1]
-            + w01 * patch[: P - 1, 1:]
-            + w10 * patch[1:, : P - 1]
-            + w11 * patch[1:, 1:]
-        ).astype(pb_ref.dtype)
+        # Separable blend BEFORE the cut, as static +1 rolls on the
+        # full-width slab (round 5): the 4-tap form on the cut (P, P)
+        # patch was the kernel's LARGEST cost — each of its four
+        # 1-offset taps slices a misaligned (31, 31) view, and Mosaic
+        # pays a relayout per tap (measured 3.3 ms of a 5.8 ms kernel
+        # at B=16, K=4096). Static rolls on the tile-aligned slab are
+        # single shuffles; the wrapped row/lane lands outside the
+        # patch region for every legal origin, so values are
+        # unchanged (the jnp oracle `_bilinear_blend` uses the same
+        # separable grouping — bit parity preserved).
+        yb = (1.0 - fy) * slab + fy * pltpu.roll(slab, S - 1, 0)
+        xb = (1.0 - fx) * yb + fx * pltpu.roll(yb, W - 1, 1)
+        v = pltpu.roll(xb, S - ry, 0)
+        v = pltpu.roll(v, W - rx, 1)
+        pb_ref[i] = v[: P - 1, : P - 1].astype(pb_ref.dtype)
         if with_moments:
+            patch = pltpu.roll(slab, S - ry, 0)
+            patch = pltpu.roll(patch, W - rx, 1)[:P, :P]
             # mm_ref rows: [x00, x01, x10, x11, y00, y01, y10, y11]
-            # (yx order: row 2*ry + rx), see _moment_maps.
-            rx = fx >= 0.5
-            ry = fy >= 0.5
+            # (yx order: row 2*qy + qx), see _moment_maps.
+            qx = fx >= 0.5
+            qy = fy >= 0.5
             wx = jnp.where(
-                ry,
-                jnp.where(rx, mm_ref[3], mm_ref[2]),
-                jnp.where(rx, mm_ref[1], mm_ref[0]),
+                qy,
+                jnp.where(qx, mm_ref[3], mm_ref[2]),
+                jnp.where(qx, mm_ref[1], mm_ref[0]),
             )
             wy = jnp.where(
-                ry,
-                jnp.where(rx, mm_ref[7], mm_ref[6]),
-                jnp.where(rx, mm_ref[5], mm_ref[4]),
+                qy,
+                jnp.where(qx, mm_ref[7], mm_ref[6]),
+                jnp.where(qx, mm_ref[5], mm_ref[4]),
             )
             pf = patch.astype(jnp.float32)
             acc_x = jnp.where(row == i, jnp.sum(pf * wx), acc_x)
@@ -366,9 +411,34 @@ def extract_blended_planes(
         )
     oy, ox, fx, fy = _pad_keypoint_axis(KB, oy, ox, fx, fy)
     Kp = oy.shape[1]
-    S, Wpp = _slab_dims(P, Wp, padded.dtype.itemsize)
-    padded = jnp.pad(padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge")
+    isz = padded.dtype.itemsize
+    S = _slab_rows(P, isz)
     Hpp = Hp + S - P
+    # Narrow-slab layout when two copies fit VMEM (see _blended_kernel's
+    # ncopies note): the second copy is the frame pre-shifted left by 64
+    # lanes, so the kernel's rolled slab is (S, 128) instead of (S, 256)
+    # — roll passes touch half the vregs. Bit-identical values: every
+    # patch lane is real (edge-padded) frame data in either copy.
+    ncopies = 2 if _frame_fits_2copy(Hp, Wp, P, isz) else 1
+    if ncopies == 2:
+        Wpp = _wpp_2copy(Wp)
+        wide = jnp.pad(
+            padded, ((0, 0), (0, S - P), (0, Wpp + 64 - Wp)), mode="edge"
+        )
+        padded = jnp.stack(
+            [wide[:, :, :Wpp], wide[:, :, 64 : 64 + Wpp]], axis=1
+        )  # (B, 2, Hpp, Wpp)
+        frame_spec = pl.BlockSpec(
+            (None, 2, Hpp, Wpp), lambda b, kb, oy, ox: (b, 0, 0, 0)
+        )
+    else:
+        _, Wpp = _slab_dims(P, Wp, isz)
+        padded = jnp.pad(
+            padded, ((0, 0), (0, S - P), (0, Wpp - Wp)), mode="edge"
+        )
+        frame_spec = pl.BlockSpec(
+            (None, Hpp, Wpp), lambda b, kb, oy, ox: (b, 0, 0)
+        )
 
     Pb = P - 1
     mm = _moment_maps(P)  # constant; tiny even when moments are unused
@@ -376,7 +446,8 @@ def extract_blended_planes(
         np.concatenate([mm[:, :, 0].reshape(4, P, P), mm[:, :, 1].reshape(4, P, P)])
     )  # (8, P, P): rows [x00, x01, x10, x11, y00, y01, y10, y11]
     kernel = functools.partial(
-        _blended_kernel, P=P, KB=KB, with_moments=with_moments
+        _blended_kernel, P=P, KB=KB, with_moments=with_moments,
+        ncopies=ncopies,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -385,7 +456,7 @@ def extract_blended_planes(
             pl.BlockSpec((None, KB, 1), lambda b, kb, oy, ox: (b, kb, 0)),
             pl.BlockSpec((None, KB, 1), lambda b, kb, oy, ox: (b, kb, 0)),
             pl.BlockSpec((8, P, P), lambda b, kb, oy, ox: (0, 0, 0)),
-            pl.BlockSpec((None, Hpp, Wpp), lambda b, kb, oy, ox: (b, 0, 0)),
+            frame_spec,
         ],
         out_specs=[
             pl.BlockSpec((None, KB, Pb, Pb), lambda b, kb, oy, ox: (b, kb, 0, 0)),
@@ -624,33 +695,33 @@ def _blended_slab_kernel(*refs, P: int, KB: int, with_moments: bool):
     for i in range(KB):
         k = kb * KB + i
         slab = slabs[i][0]  # (S, _WIN)
-        slab = pltpu.roll(slab, S - ryr[b, k], 0)
-        slab = pltpu.roll(slab, _WIN - rxr[b, k], 1)
-        patch = slab[:P, :P]
+        ry = ryr[b, k]
+        rx = rxr[b, k]
         fx = fx_ref[i, 0]
         fy = fy_ref[i, 0]
-        w00 = (1.0 - fy) * (1.0 - fx)
-        w01 = (1.0 - fy) * fx
-        w10 = fy * (1.0 - fx)
-        w11 = fy * fx
-        pb_ref[i] = (
-            w00 * patch[: P - 1, : P - 1]
-            + w01 * patch[: P - 1, 1:]
-            + w10 * patch[1:, : P - 1]
-            + w11 * patch[1:, 1:]
-        ).astype(pb_ref.dtype)
+        # separable blend before the cut — identical grouping to
+        # `_blended_kernel` and `describe._bilinear_blend` (the
+        # whole-frame/slab bit-identity contract in
+        # test_slab_variant_matches_whole_frame_kernel)
+        yb = (1.0 - fy) * slab + fy * pltpu.roll(slab, S - 1, 0)
+        xb = (1.0 - fx) * yb + fx * pltpu.roll(yb, _WIN - 1, 1)
+        v = pltpu.roll(xb, S - ry, 0)
+        v = pltpu.roll(v, _WIN - rx, 1)
+        pb_ref[i] = v[: P - 1, : P - 1].astype(pb_ref.dtype)
         if with_moments:
-            rx = fx >= 0.5
-            ry = fy >= 0.5
+            patch = pltpu.roll(slab, S - ry, 0)
+            patch = pltpu.roll(patch, _WIN - rx, 1)[:P, :P]
+            qx = fx >= 0.5
+            qy = fy >= 0.5
             wx = jnp.where(
-                ry,
-                jnp.where(rx, mm_ref[3], mm_ref[2]),
-                jnp.where(rx, mm_ref[1], mm_ref[0]),
+                qy,
+                jnp.where(qx, mm_ref[3], mm_ref[2]),
+                jnp.where(qx, mm_ref[1], mm_ref[0]),
             )
             wy = jnp.where(
-                ry,
-                jnp.where(rx, mm_ref[7], mm_ref[6]),
-                jnp.where(rx, mm_ref[5], mm_ref[4]),
+                qy,
+                jnp.where(qx, mm_ref[7], mm_ref[6]),
+                jnp.where(qx, mm_ref[5], mm_ref[4]),
             )
             pf = patch.astype(jnp.float32)
             acc_x = jnp.where(row == i, jnp.sum(pf * wx), acc_x)
@@ -956,66 +1027,67 @@ def extract_patches(
     return out[:, :K]
 
 
-def dispatch_copy_rows(
+def binned_select_rows(
     flat: jnp.ndarray,  # (B, Kp, L) bin-sorted rows (aligned runs)
-    ibin: jnp.ndarray,  # (B, NBLK) int32 target bin per align-row block
-    islot: jnp.ndarray,  # (B, NBLK) int32 target slot-block within the bin
-    n_groups: int,
-    cap: int,
+    ibin: jnp.ndarray,  # (B, Kp // align) int32 bin per align-row block
+    sel: jnp.ndarray,  # (nb, L, V) per-bin selection stack
     align: int,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Element-indexed block scatter: sorted rows -> dispatch layout.
+    """Dynamic-block selection matmul, in sorted layout: each align-row
+    block of `flat` is multiplied by ITS bin's (L, V) selection matrix,
+    chosen per program via scalar prefetch driving the sel BlockSpec's
+    index map. Returns (B, Kp, V) in the same sorted layout.
 
-    The round-5 bins-first descriptor path sorts keypoints into
-    align-row orientation runs BEFORE extraction, so grouping the
-    extracted patch rows for the per-bin selection matmul is a pure
-    block permutation — each align-row block of `flat` lands whole at
-    (ibin, islot) of a (B, n_groups + 1, cap, L) buffer (group n_groups
-    is the trash row for overflow blocks). This replaces binned
-    selection's (B, K, L) row gather + row scatter — measured 25
-    ms/batch at K=4096, B=32, the describe stage's largest non-
-    extraction cost — with one DMA-speed Pallas copy whose out-block
-    index comes from scalar prefetch (the Element-indexed blocks
-    pattern, DESIGN.md).
+    This replaces the round-5 dispatch-layout pipeline (dispatch_copy
+    to a (B, nb, cap, L) capacity layout + one batched einsum) for the
+    bins-first describe path: measured 6.1 + 2.5 ms/batch at config-2
+    scale against ~3 ms here — the M=align matmul runs the MXU at
+    12.5% occupancy, but the capacity layout's extra HBM round trip,
+    its trash group, and its per-bin capacity DROPS all disappear
+    (every keypoint is selected with its run's matrix; orientation
+    skew can no longer drop descriptors). Runs are align-aligned by
+    construction so a block never spans two bins; consecutive programs
+    mostly share a bin, so the sel block is revisited, not re-fetched.
 
-    Blocks land whole because run starts are align-aligned by
-    construction (ops/describe._aligned_runs). Unwritten slots of the
-    output (beyond each run's rows, and the trash group) are
-    UNINITIALIZED — callers must route their results to a masked
-    destination, which the packed-descriptor scatter-back does.
+    Exactness: 0/1 one-hot weights with one nonzero per column under
+    f32 accumulation select bf16 values exactly in any contraction
+    order — bit-identical to the einsum it replaces.
     """
     B, Kp, L = flat.shape
-    NBLK = Kp // align
-
-    def kernel(ibin_ref, islot_ref, in_ref, out_ref):
-        del ibin_ref, islot_ref
-        out_ref[...] = in_ref[...]
-
-    # two flat (B, NBLK) prefetch arrays — a stacked (B, NBLK, 2) form
-    # pads its 2-lane minor dim to 128 in SMEM (measured: a 4.25 MB
-    # "prefetched SMEM operand" compile OOM vs the 1 MB space)
+    nb, _, V = sel.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, NBLK),
+        num_scalar_prefetch=1,
+        grid=(B, Kp // align),
         in_specs=[
+            pl.BlockSpec((None, align, L), lambda b, kb, ibin: (b, kb, 0)),
             pl.BlockSpec(
-                (None, align, L), lambda b, i, ibin, islot: (b, i, 0)
+                (None, L, V),
+                # alignment-padding tail blocks carry bin nb (sentinel):
+                # clamp to a real matrix; their rows scatter to the
+                # dropped index downstream
+                lambda b, kb, ibin: (jnp.minimum(ibin[b, kb], nb - 1), 0, 0),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (None, None, align, L),
-            lambda b, i, ibin, islot: (b, ibin[b, i], islot[b, i], 0),
+            (None, align, V), lambda b, kb, ibin: (b, kb, 0)
         ),
     )
+
+    def kernel(ibin_ref, x_ref, sel_ref, out_ref):
+        del ibin_ref
+        out_ref[...] = jax.lax.dot_general(
+            x_ref[...], sel_ref[...],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(out_ref.dtype)
+
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (B, n_groups + 1, cap, L), flat.dtype
-        ),
+        out_shape=jax.ShapeDtypeStruct((B, Kp, V), flat.dtype),
         interpret=interpret,
-    )(ibin.astype(jnp.int32), islot.astype(jnp.int32), flat)
+    )(ibin.astype(jnp.int32), flat, sel)
 
 
 def _moment_band_structure():

@@ -4,7 +4,7 @@ The production contract — descriptors from the sorted bins-first route
 equal the jnp oracle up to bf16 tie level — is covered by
 test_pallas_patch/test_detect_describe_match; these tests pin the new
 pieces directly: frame-level moments vs the conv definition, the
-aligned-run sort, and the element-indexed dispatch copy.
+aligned-run sort, and the dynamic-block selection matmul.
 """
 
 import numpy as np
@@ -19,7 +19,7 @@ from kcmc_tpu.ops.describe import (
     _aligned_runs,
     _moments_at_keypoints,
 )
-from kcmc_tpu.ops.pallas_patch import dispatch_copy_rows, moment_maps
+from kcmc_tpu.ops.pallas_patch import binned_select_rows, moment_maps
 
 
 def test_moment_maps_match_conv():
@@ -91,22 +91,31 @@ def test_aligned_runs_structure():
     assert (src[aends[5]:] == N).all()
 
 
-def test_dispatch_copy_rows_places_blocks():
+def test_binned_select_rows_uses_each_blocks_matrix():
     rng = np.random.default_rng(3)
-    B, Kp, L, align, nb, cap = 2, 64, 96, 16, 3, 32
-    flat = jnp.asarray(rng.normal(size=(B, Kp, L)).astype(np.float32))
-    # frame 0: blocks -> (bin, slot): run layout [b0: 2 blocks][b2: 1][trash: 1]
-    ibin = jnp.asarray([[0, 0, 2, 3], [1, 3, 3, 2]], jnp.int32)
-    islot = jnp.asarray([[0, 1, 0, 0], [1, 0, 0, 1]], jnp.int32)
+    B, Kp, L, V, align, nb = 2, 64, 96, 128, 16, 3
+    flat = jnp.asarray(
+        rng.normal(size=(B, Kp, L)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    sel = jnp.asarray(
+        rng.normal(size=(nb, L, V)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    # per-block bins; frame 1 includes the padding sentinel nb (clamped)
+    ibin = jnp.asarray([[0, 0, 2, 1], [1, 2, nb, 0]], jnp.int32)
     out = np.asarray(
-        dispatch_copy_rows(flat, ibin, islot, nb, cap, align, interpret=True)
+        binned_select_rows(flat, ibin, sel, align, interpret=True)
     )
-    f = np.asarray(flat)
-    np.testing.assert_array_equal(out[0, 0, 0:16], f[0, 0:16])
-    np.testing.assert_array_equal(out[0, 0, 16:32], f[0, 16:32])
-    np.testing.assert_array_equal(out[0, 2, 0:16], f[0, 32:48])
-    np.testing.assert_array_equal(out[1, 1, 16:32], f[1, 0:16])
-    np.testing.assert_array_equal(out[1, 2, 16:32], f[1, 48:64])
+    f = np.asarray(flat, np.float32)
+    s = np.asarray(sel, np.float32)
+    ib = np.asarray(ibin)
+    for b in range(B):
+        for blk in range(Kp // align):
+            ref = (
+                f[b, blk * align : (blk + 1) * align]
+                @ s[min(ib[b, blk], nb - 1)]
+            )
+            got = out[b, blk * align : (blk + 1) * align].astype(np.float32)
+            np.testing.assert_allclose(got, ref, rtol=0.05, atol=0.5)
 
 
 def test_bins_first_route_matches_oracle_at_large_k():
