@@ -222,7 +222,7 @@ def knn_match(
     q_desc, r_desc, q_valid, r_valid, ratio=0.85, max_dist=80, mutual=True
 ):
     """Same rules as ops/match.py; returns (idx, dist, second, valid)."""
-    BIG = 1 << 16
+    BIG = (1 << 16) - 1  # matches ops/match.py _BIG (uint16-compatible sentinel)
     # Zero descriptors are the invalid sentinel — same rule as
     # ops/match.py's knn_match (flat patches / masked slots never match).
     q_valid = q_valid & (q_desc != 0).any(-1)

@@ -26,7 +26,11 @@ from jax import lax
 
 from kcmc_tpu.ops.describe import N_BITS
 
-_BIG = jnp.uint32(1 << 16)  # sentinel distance for masked slots (> N_BITS)
+_BIG = jnp.uint32((1 << 16) - 1)  # sentinel distance for masked slots:
+# any value > N_BITS works; 65535 (not 65536) so the sentinel survives
+# the uint16 distance matrix (round 5 — halving the (Kq, Kr) bytes
+# halves the match stage's dominant HBM traffic; Hamming distances
+# <= 512 are exact in uint16, so nothing else changes)
 
 
 class Matches(NamedTuple):
@@ -83,9 +87,9 @@ def hamming_matrix_mxu(
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # exact integer-valued dot products in f32
-    d = ((n_bits - s) * 0.5).astype(jnp.int32)
+    d = ((n_bits - s) * 0.5).astype(jnp.uint16)
     mask = q_valid[:, None] & r_valid[None, :]
-    return jnp.where(mask, d, _BIG.astype(jnp.int32)).astype(jnp.uint32)
+    return jnp.where(mask, d, _BIG.astype(jnp.uint16))
 
 
 @functools.partial(jax.jit, static_argnames=("mutual",))
@@ -119,12 +123,12 @@ def knn_match(
     # test as a spurious correspondence.
     q_valid = q_valid & jnp.any(q_desc != 0, axis=-1)
     r_valid = r_valid & jnp.any(r_desc != 0, axis=-1)
-    Di = hamming_matrix_mxu(q_desc, r_desc, q_valid, r_valid).astype(jnp.int32)
+    Di = hamming_matrix_mxu(q_desc, r_desc, q_valid, r_valid)  # uint16
     Kq, Kr = Di.shape
     best = jnp.min(Di, axis=-1)
     idx = jnp.argmin(Di, axis=-1).astype(jnp.int32)
     taken = idx[:, None] == jnp.arange(Kr, dtype=jnp.int32)[None, :]
-    second = jnp.min(jnp.where(taken, jnp.int32(_BIG), Di), axis=-1)
+    second = jnp.min(jnp.where(taken, _BIG.astype(jnp.uint16), Di), axis=-1)
 
     ok = (best < max_dist) & (
         best.astype(jnp.float32) < ratio * second.astype(jnp.float32)
@@ -132,5 +136,10 @@ def knn_match(
     if mutual:
         rev_best = jnp.argmin(Di, axis=0)  # (Kr,) best query for each ref kp
         ok = ok & (rev_best[idx] == jnp.arange(Kq))
-    ok = ok & q_valid & (best < jnp.int32(N_BITS + 1))
-    return Matches(idx=idx, dist=best, second=second, valid=ok)
+    ok = ok & q_valid & (best < jnp.uint16(N_BITS + 1))
+    return Matches(
+        idx=idx,
+        dist=best.astype(jnp.int32),
+        second=second.astype(jnp.int32),
+        valid=ok,
+    )

@@ -39,28 +39,37 @@ class RansacResult(NamedTuple):
     rms_residual: jnp.ndarray  # () float32 RMS residual over final inliers
 
 
-def _sample_weights(key, valid: jnp.ndarray, m: int) -> jnp.ndarray:
-    """One-hot weights selecting m distinct valid indices (top-m of iid
-    uniform scores — the same uniform-random distinct subset Gumbel
-    top-m draws, with a cheaper sampler).
+def _sample_indices(key, valid: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Indices of m distinct valid matches (top-m of iid uniform
+    scores — the same uniform-random distinct subset Gumbel top-m
+    draws, with a cheaper sampler).
 
     Selection runs as m sequential argmax+mask rounds instead of
     `lax.top_k` + scatter: for the tiny m (1-4) of minimal sets the
     unrolled masked argmaxes measure ~2x faster vmapped over
-    (frames x hypotheses), and the one-hot weights build from iota
-    comparisons with no scatter. If fewer than m matches are valid the
-    extra picks land on invalid slots and are zeroed — the solver's
-    weight-mass guard then returns the identity for that hypothesis.
+    (frames x hypotheses). If fewer than m matches are valid the extra
+    rounds argmax an all-(-1) score vector and return slot 0 — usually
+    a DUPLICATE of an already-picked valid match, so the caller's
+    `valid[idx]` weights do NOT zero it and the weight-mass guard does
+    not fire; what actually protects that case is each solver's own
+    rank/pivot degeneracy guard on the duplicated-point system (a new
+    model's solver must have one — see models/transforms.py).
+
+    The minimal solve consumes the GATHERED m points, not an (N,)
+    one-hot weight vector (round 5): the weighted solve ran its ~10
+    moment reductions over all N points per hypothesis — (B, H, N)
+    traffic for m=3 real values — where an (H, m) gather from the
+    per-frame match table is on the fast small-table gather path.
     """
     u = jax.random.uniform(key, valid.shape, dtype=jnp.float32)
     scores = jnp.where(valid, u, -1.0)
     iota = lax.iota(jnp.int32, valid.shape[0])
-    w = jnp.zeros(valid.shape, jnp.float32)
+    picks = []
     for _ in range(m):
-        pick = iota == jnp.argmax(scores)
-        w = jnp.where(pick, 1.0, w)
-        scores = jnp.where(pick, -1.0, scores)
-    return w * valid.astype(jnp.float32)
+        j = jnp.argmax(scores)
+        picks.append(j)
+        scores = jnp.where(iota == j, -1.0, scores)
+    return jnp.stack(picks)
 
 
 @functools.partial(
@@ -116,8 +125,10 @@ def ransac_estimate(
 
     def one_hypothesis_from(srch, dsth, validh):
         def go(k):
-            w = _sample_weights(k, validh, model.min_samples)
-            M = model.solve(srch, dsth, w)
+            idx = _sample_indices(k, validh, model.min_samples)
+            M = model.solve(
+                srch[idx], dsth[idx], validh[idx].astype(jnp.float32)
+            )
             r = model.residual(M, src_s, dst_s)
             inl = (r < thresh_sq) & valid_s
             return M, jnp.sum(inl)
