@@ -14,6 +14,34 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def stable_argsort_small_keys(keys, max_key: int):
+    """Stable argsort of small non-negative integer keys via ONE packed
+    sort: (key << sh) | index sorts by key with ties broken by
+    ascending index — exactly a stable argsort, at ~0 measured cost vs
+    argsort's key-value sort (4.3 ms/batch at K=4096, B=32 on v5e).
+
+    `max_key` is the largest key value possible (static), including any
+    drop sentinel; the pack must fit int32, which this checks loudly at
+    trace time instead of wrapping into silently corrupted order.
+    Returns (order, sorted_keys) like (argsort(keys), keys[order]).
+    Shared by describe._aligned_runs, segment_by_key, and the describe
+    back-map's inverse-permutation sort (which packs in uint32 for one
+    extra bit — see _describe_oriented_sorted).
+    """
+    N = keys.shape[0]
+    sh = max(1, int(N - 1).bit_length())
+    if (max_key << sh) + N >= 1 << 31:
+        raise ValueError(
+            f"packed stable argsort: max_key={max_key} << {sh} | index "
+            f"overflows int32 at N={N}; use a key-value argsort for "
+            f"this scale"
+        )
+    packed = jnp.sort(
+        (keys.astype(jnp.int32) << sh) | jnp.arange(N, dtype=jnp.int32)
+    )
+    return packed & ((1 << sh) - 1), packed >> sh
+
+
 def segment_by_key(keys, n_groups: int, cap: int):
     """Group items by integer key with fixed per-group capacity.
 
@@ -26,8 +54,8 @@ def segment_by_key(keys, n_groups: int, cap: int):
     priority keep the most important ones).
     """
     N = keys.shape[0]
-    order = jnp.argsort(keys)  # stable
-    sorted_keys = keys[order]
+    order, sorted_keys = stable_argsort_small_keys(keys, n_groups)
+    sorted_keys = sorted_keys.astype(keys.dtype)
     bins = jnp.arange(n_groups, dtype=sorted_keys.dtype)
     starts = jnp.searchsorted(sorted_keys, bins, side="left")
     ends = jnp.searchsorted(sorted_keys, bins, side="right")
