@@ -439,6 +439,7 @@ class JaxBackend:
                         passes=cfg.field_passes,
                         refine_reach_scale=cfg.refine_reach_scale,
                         patch_model=cfg.patch_model,
+                        refine_hyps=cfg.refine_hypotheses,
                     )
                     # warping is batch-level for BOTH flow paths now
                     # (the correlation polish needs the warped batch)

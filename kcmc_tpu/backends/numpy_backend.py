@@ -566,9 +566,14 @@ class NumpyBackend:
                 for j in range(gw):
                     c = np.array([cx[j], cy[i]], np.float32)
                     member = gate & (((src - c) ** 2).sum(-1) < reach_r * reach_r)
+                    # refine-pass hypothesis budget mirrors the jax
+                    # backend (CorrectorConfig.refine_hypotheses)
                     Mp, n_p, _, _ = K.ransac_estimate(
                         pmodel, src, dst_resid, member, rng,
-                        n_hypotheses=cfg.patch_hypotheses, threshold=thr,
+                        n_hypotheses=(
+                            cfg.refine_hypotheses or cfg.patch_hypotheses
+                        ),
+                        threshold=thr,
                     )
                     lam = n_p / (n_p + cfg.patch_prior)
                     r[i, j] = lam * clamp(center_disp(Mp, c), 2.0 * thr)

@@ -177,10 +177,12 @@ def _select_keypoints(
     (ops/pallas_detect.py), which produce the same field triple.
     """
     H, W = nms_resp.shape
-    # Exclude a border so descriptor patches stay in bounds.
-    ys = jnp.arange(H)[:, None]
-    xs = jnp.arange(W)[None, :]
-    inb = (ys >= border) & (ys < H - border) & (xs >= border) & (xs < W - border)
+    # Candidate reduction: strongest surviving pixel per TILE x TILE
+    # tile, then an exact top-k over the tile winners. Cuts the top-k
+    # from H*W candidates to (H*W)/TILE^2 with an at-most-one-keypoint-
+    # per-tile cap (grid-bucketed detection, the ORB-style spatial
+    # spreading), which for K << #tiles is benign.
+    #
     # Threshold is relative to the max response over the SELECTABLE
     # (border-excluded) region: robust to global contrast changes, and
     # immune to the border-ring response spikes a constant background
@@ -189,21 +191,60 @@ def _select_keypoints(
     # inflated a full-frame peak ~50x and silently killed every
     # interior keypoint). The interior global max is itself an NMS
     # local max, so masking nms_resp loses nothing.
-    peak = jnp.maximum(jnp.max(jnp.where(inb, nms_resp, -jnp.inf)), 1e-12)
-    masked = jnp.where(inb & (nms_resp > threshold * peak), nms_resp, -jnp.inf)
-
-    # Candidate reduction: strongest surviving pixel per TILE x TILE tile
-    # (reshape + argmax — no gathers), then an exact top-k over the tile
-    # winners. Cuts the top-k from H*W candidates to (H*W)/TILE^2 with an
-    # at-most-one-keypoint-per-tile cap (grid-bucketed detection, the
-    # ORB-style spatial spreading), which for K << #tiles is benign.
     T = cand_tile
-    Hp, Wp = -(-H // T) * T, -(-W // T) * T
-    m = jnp.pad(masked, ((0, Hp - H), (0, Wp - W)), constant_values=-jnp.inf)
-    tiles = m.reshape(Hp // T, T, Wp // T, T).transpose(0, 2, 1, 3)
-    tiles = tiles.reshape(Hp // T, Wp // T, T * T)
-    tile_val = jnp.max(tiles, axis=-1)  # (th, tw)
-    tile_arg = jnp.argmax(tiles, axis=-1).astype(jnp.int32)
+    if border % T == 0 and H % T == 0 and W % T == 0:
+        # Tile-aligned fast path (round 5): every tile is fully inside
+        # or fully outside the border exclusion, so the border/peak/
+        # threshold masking moves to the (H/T, W/T) TILE level and the
+        # full-resolution field is read exactly twice (tile max +
+        # argmax) instead of ~4 masked-materialize passes — measured
+        # 2.5 -> ~1.2 ms/batch of the detect stage at B=64, 512².
+        # Results are IDENTICAL to the general path below: same tile
+        # maxima, same first-in-row-major argmax tie rule, same peak.
+        tile_val = lax.reduce_window(
+            nms_resp, -jnp.inf, lax.max, (T, T), (T, T), "VALID"
+        )  # (th, tw)
+        up = jnp.repeat(jnp.repeat(tile_val, T, 0), T, 1)
+        ii = (
+            lax.broadcasted_iota(jnp.int32, (H, W), 0) % T * T
+            + lax.broadcasted_iota(jnp.int32, (H, W), 1) % T
+        )  # row-major index within each tile — the argmax tie rule
+        tile_arg = lax.reduce_window(
+            jnp.where(nms_resp == up, ii, jnp.int32(1) << 20),
+            jnp.int32(1) << 20, lax.min, (T, T), (T, T), "VALID",
+        ).astype(jnp.int32)
+        th, tw = tile_val.shape
+        tys = jnp.arange(th)[:, None]
+        txs = jnp.arange(tw)[None, :]
+        bt = border // T
+        tile_inb = (
+            (tys >= bt) & (tys < th - bt) & (txs >= bt) & (txs < tw - bt)
+        )
+        peak = jnp.maximum(
+            jnp.max(jnp.where(tile_inb, tile_val, -jnp.inf)), 1e-12
+        )
+        tile_val = jnp.where(
+            tile_inb & (tile_val > threshold * peak), tile_val, -jnp.inf
+        )
+    else:
+        # General path: arbitrary border/frame-size vs tile alignment —
+        # mask at pixel level, reduce via reshape + argmax.
+        ys = jnp.arange(H)[:, None]
+        xs = jnp.arange(W)[None, :]
+        inb = (
+            (ys >= border) & (ys < H - border)
+            & (xs >= border) & (xs < W - border)
+        )
+        peak = jnp.maximum(jnp.max(jnp.where(inb, nms_resp, -jnp.inf)), 1e-12)
+        masked = jnp.where(
+            inb & (nms_resp > threshold * peak), nms_resp, -jnp.inf
+        )
+        Hp, Wp = -(-H // T) * T, -(-W // T) * T
+        m = jnp.pad(masked, ((0, Hp - H), (0, Wp - W)), constant_values=-jnp.inf)
+        tiles = m.reshape(Hp // T, T, Wp // T, T).transpose(0, 2, 1, 3)
+        tiles = tiles.reshape(Hp // T, Wp // T, T * T)
+        tile_val = jnp.max(tiles, axis=-1)  # (th, tw)
+        tile_arg = jnp.argmax(tiles, axis=-1).astype(jnp.int32)
 
     n_tiles = tile_val.size
     k = min(max_keypoints, n_tiles)

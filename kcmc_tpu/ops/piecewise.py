@@ -128,7 +128,7 @@ def sample_field_at(
     jax.jit,
     static_argnames=(
         "grid", "shape", "n_global_hyps", "patch_hyps", "smooth_sigma",
-        "passes", "refine_reach_scale", "patch_model",
+        "passes", "refine_reach_scale", "patch_model", "refine_hyps",
     ),
 )
 def estimate_field(
@@ -147,6 +147,7 @@ def estimate_field(
     passes: int = 2,
     refine_reach_scale: float = 1.0,
     patch_model: str = "translation",
+    refine_hyps: int = 0,
 ) -> FieldResult:
     """Per-patch consensus displacement field for one frame.
 
@@ -247,9 +248,14 @@ def estimate_field(
         def per_patch_resid(center, k):
             d2 = jnp.sum((src - center) ** 2, axis=-1)
             member = gate & (d2 < reach_r * reach_r)
+            # refine passes fit a 2x-threshold-gated residual: high
+            # inlier fraction, so a small budget suffices (see
+            # CorrectorConfig.refine_hypotheses) — the scoring work
+            # scales with passes x hypotheses
             res = ransac_estimate(
                 pmodel, src, dst_resid, member, k,
-                n_hypotheses=patch_hyps, threshold=patch_threshold,
+                n_hypotheses=refine_hyps or patch_hyps,
+                threshold=patch_threshold,
             )
             M = res.transform
             # precision pin: same bf16 trap as the first-pass site above
