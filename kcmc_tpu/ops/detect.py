@@ -127,6 +127,35 @@ def sorted_top_k(vals: jnp.ndarray, k: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     return -neg[:k], idx[:k]
 
 
+def tile_max_argmax(resp: jnp.ndarray, T: int):
+    """Per-(T, T)-tile max and first-in-row-major argmax of a dense
+    response, via two reduce_window passes (no reshape/transpose, no
+    full-field masked copies) — the shared core of the 2D and 3D
+    tile-aligned selection fast paths. `resp` is (..., H, W) with any
+    leading axes (the 3D path passes (D, H, W): z planes tile
+    independently); H and W must be T-multiples (callers gate).
+
+    The argmax tie rule matches `jnp.argmax` over the row-major (T, T)
+    flatten exactly: the eq-mask min over (r % T) * T + (c % T) picks
+    the lowest within-tile row-major index among maximal pixels.
+    """
+    nd = resp.ndim
+    win = (1,) * (nd - 2) + (T, T)
+    tile_val = lax.reduce_window(
+        resp, -jnp.inf, lax.max, win, win, "VALID"
+    )
+    up = jnp.repeat(jnp.repeat(tile_val, T, nd - 2), T, nd - 1)
+    ii = (
+        lax.broadcasted_iota(jnp.int32, resp.shape, nd - 2) % T * T
+        + lax.broadcasted_iota(jnp.int32, resp.shape, nd - 1) % T
+    )
+    tile_arg = lax.reduce_window(
+        jnp.where(resp == up, ii, jnp.int32(1) << 20),
+        jnp.int32(1) << 20, lax.min, win, win, "VALID",
+    ).astype(jnp.int32)
+    return tile_val, tile_arg
+
+
 def _maxpool_same(x: jnp.ndarray, size: int) -> jnp.ndarray:
     # Separable: max over rows then columns (max is associative/idempotent).
     x = lax.reduce_window(
@@ -201,18 +230,7 @@ def _select_keypoints(
         # 2.5 -> ~1.2 ms/batch of the detect stage at B=64, 512².
         # Results are IDENTICAL to the general path below: same tile
         # maxima, same first-in-row-major argmax tie rule, same peak.
-        tile_val = lax.reduce_window(
-            nms_resp, -jnp.inf, lax.max, (T, T), (T, T), "VALID"
-        )  # (th, tw)
-        up = jnp.repeat(jnp.repeat(tile_val, T, 0), T, 1)
-        ii = (
-            lax.broadcasted_iota(jnp.int32, (H, W), 0) % T * T
-            + lax.broadcasted_iota(jnp.int32, (H, W), 1) % T
-        )  # row-major index within each tile — the argmax tie rule
-        tile_arg = lax.reduce_window(
-            jnp.where(nms_resp == up, ii, jnp.int32(1) << 20),
-            jnp.int32(1) << 20, lax.min, (T, T), (T, T), "VALID",
-        ).astype(jnp.int32)
+        tile_val, tile_arg = tile_max_argmax(nms_resp, T)
         th, tw = tile_val.shape
         tys = jnp.arange(th)[:, None]
         txs = jnp.arange(tw)[None, :]

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from kcmc_tpu.ops.detect import Keypoints, sorted_top_k
+from kcmc_tpu.ops.detect import Keypoints, sorted_top_k, tile_max_argmax
 from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 
 
@@ -112,37 +112,59 @@ def _select_keypoints_3d(
     """Fixed-K selection from dense (resp, nms_resp) fields — shared by
     the jnp path and the fused Pallas kernel (ops/pallas_detect3d.py)."""
     D, H, W = resp.shape
-    zs = jnp.arange(D)[:, None, None]
-    ys = jnp.arange(H)[None, :, None]
-    xs = jnp.arange(W)[None, None, :]
     bz = min(border, max(1, D // 8))
-    inb = (
-        (zs >= bz) & (zs < D - bz)
-        & (ys >= border) & (ys < H - border)
-        & (xs >= border) & (xs < W - border)
-    )
     # Peak over the selectable region only — a constant background
     # offset creates face-wide response spikes at the volume border
     # (full-rank structure tensor there, unlike a 2D frame's rank-1
     # edge ring) that inflated a whole-volume peak ~50x and killed
     # every interior keypoint (see ops/detect.py::_select_keypoints).
-    peak = jnp.maximum(jnp.max(jnp.where(inb, nms_resp, -jnp.inf)), 1e-12)
-    masked = jnp.where(
-        inb & (nms_resp > threshold * peak), nms_resp, -jnp.inf
-    )
-
+    #
     # Candidate reduction: strongest surviving voxel per (1, T, T) tile
-    # (reshape + argmax, no gathers) then an exact top-k over the tile
-    # winners — the 3D counterpart of the 2D tile bucketing.
+    # then an exact top-k over the tile winners — the 3D counterpart of
+    # the 2D tile bucketing, including its round-5 tile-aligned fast
+    # path (z tiles are single planes, so the z border masks exactly at
+    # tile level regardless of alignment; y/x need border % T == 0).
     T = 8
-    Hp, Wp = -(-H // T) * T, -(-W // T) * T
-    m = jnp.pad(
-        masked, ((0, 0), (0, Hp - H), (0, Wp - W)), constant_values=-jnp.inf
-    )
-    tiles = m.reshape(D, Hp // T, T, Wp // T, T).transpose(0, 1, 3, 2, 4)
-    tiles = tiles.reshape(D, Hp // T, Wp // T, T * T)
-    tile_val = jnp.max(tiles, axis=-1)
-    tile_arg = jnp.argmax(tiles, axis=-1).astype(jnp.int32)
+    if border % T == 0 and H % T == 0 and W % T == 0:
+        tile_val, tile_arg = tile_max_argmax(nms_resp, T)  # (D, th, tw)
+        th, tw = tile_val.shape[1:]
+        tzs = jnp.arange(D)[:, None, None]
+        tys = jnp.arange(th)[None, :, None]
+        txs = jnp.arange(tw)[None, None, :]
+        bt = border // T
+        tile_inb = (
+            (tzs >= bz) & (tzs < D - bz)
+            & (tys >= bt) & (tys < th - bt)
+            & (txs >= bt) & (txs < tw - bt)
+        )
+        peak = jnp.maximum(
+            jnp.max(jnp.where(tile_inb, tile_val, -jnp.inf)), 1e-12
+        )
+        tile_val = jnp.where(
+            tile_inb & (tile_val > threshold * peak), tile_val, -jnp.inf
+        )
+    else:
+        zs = jnp.arange(D)[:, None, None]
+        ys = jnp.arange(H)[None, :, None]
+        xs = jnp.arange(W)[None, None, :]
+        inb = (
+            (zs >= bz) & (zs < D - bz)
+            & (ys >= border) & (ys < H - border)
+            & (xs >= border) & (xs < W - border)
+        )
+        peak = jnp.maximum(jnp.max(jnp.where(inb, nms_resp, -jnp.inf)), 1e-12)
+        masked = jnp.where(
+            inb & (nms_resp > threshold * peak), nms_resp, -jnp.inf
+        )
+        Hp, Wp = -(-H // T) * T, -(-W // T) * T
+        m = jnp.pad(
+            masked, ((0, 0), (0, Hp - H), (0, Wp - W)),
+            constant_values=-jnp.inf,
+        )
+        tiles = m.reshape(D, Hp // T, T, Wp // T, T).transpose(0, 1, 3, 2, 4)
+        tiles = tiles.reshape(D, Hp // T, Wp // T, T * T)
+        tile_val = jnp.max(tiles, axis=-1)
+        tile_arg = jnp.argmax(tiles, axis=-1).astype(jnp.int32)
 
     n_tiles = tile_val.size
     k = min(max_keypoints, n_tiles)
@@ -160,24 +182,57 @@ def _select_keypoints_3d(
     ix = jnp.clip(ix, 0, W - 1)
     valid = jnp.isfinite(scores)
 
-    # Subpixel: dense per-axis parabola offset fields (elementwise shifts)
-    # sampled at the K peaks — three tiny pointwise gathers.
-    r = jnp.pad(resp, 1, mode="edge")
+    if border >= 1:
+        # Subpixel: per-axis parabola offsets from the 6 axis neighbors
+        # of each peak — 7 tiny (K,) gathers. The dense-field form this
+        # replaces materialized an edge-padded copy of the volume plus
+        # THREE full offset fields to read K values from each (round 5;
+        # the 2D path keeps dense fields because its fused detect
+        # kernel emits them for free — here they were pure XLA cost).
+        # Values are identical for every selectable peak: border >= 1
+        # in y/x and bz >= 1 keep all six neighbors in bounds, so the
+        # edge-replicated pad the old fields used was never reached;
+        # the clamp below only moves INVALID slots, whose offsets the
+        # valid mask discards.
+        izc = jnp.clip(iz, 1, D - 2)
+        iyc = jnp.clip(iy, 1, H - 2)
+        ixc = jnp.clip(ix, 1, W - 2)
+        rf = resp.reshape(-1)
 
-    def axis_field(plus, minus):
-        d1 = 0.5 * (plus - minus)
-        d2 = plus - 2.0 * resp + minus
-        return jnp.clip(
-            jnp.where(jnp.abs(d2) > 1e-8, -d1 / d2, 0.0), -0.5, 0.5
-        )
+        def at(z, y, x):
+            return rf[(z * H + y) * W + x]
 
-    ox_f = axis_field(r[1:-1, 1:-1, 2:], r[1:-1, 1:-1, :-2])
-    oy_f = axis_field(r[1:-1, 2:, 1:-1], r[1:-1, :-2, 1:-1])
-    oz_f = axis_field(r[2:, 1:-1, 1:-1], r[:-2, 1:-1, 1:-1])
-    flat_idx = (iz * H + iy) * W + ix
-    ox = ox_f.reshape(-1)[flat_idx]
-    oy = oy_f.reshape(-1)[flat_idx]
-    oz = oz_f.reshape(-1)[flat_idx]
+        c0 = at(izc, iyc, ixc)
+
+        def axis_off(plus, minus):
+            d1 = 0.5 * (plus - minus)
+            d2 = plus - 2.0 * c0 + minus
+            return jnp.clip(
+                jnp.where(jnp.abs(d2) > 1e-8, -d1 / d2, 0.0), -0.5, 0.5
+            )
+
+        ox = axis_off(at(izc, iyc, ixc + 1), at(izc, iyc, ixc - 1))
+        oy = axis_off(at(izc, iyc + 1, ixc), at(izc, iyc - 1, ixc))
+        oz = axis_off(at(izc + 1, iyc, ixc), at(izc - 1, iyc, ixc))
+    else:
+        # border = 0: peaks may sit on the volume faces, where the old
+        # dense fields' edge-replicated pad matters — keep them.
+        r = jnp.pad(resp, 1, mode="edge")
+
+        def axis_field(plus, minus):
+            d1 = 0.5 * (plus - minus)
+            d2 = plus - 2.0 * resp + minus
+            return jnp.clip(
+                jnp.where(jnp.abs(d2) > 1e-8, -d1 / d2, 0.0), -0.5, 0.5
+            )
+
+        ox_f = axis_field(r[1:-1, 1:-1, 2:], r[1:-1, 1:-1, :-2])
+        oy_f = axis_field(r[1:-1, 2:, 1:-1], r[1:-1, :-2, 1:-1])
+        oz_f = axis_field(r[2:, 1:-1, 1:-1], r[:-2, 1:-1, 1:-1])
+        flat_idx = (iz * H + iy) * W + ix
+        ox = ox_f.reshape(-1)[flat_idx]
+        oy = oy_f.reshape(-1)[flat_idx]
+        oz = oz_f.reshape(-1)[flat_idx]
 
     xyz = jnp.stack(
         [ix.astype(jnp.float32) + ox, iy.astype(jnp.float32) + oy, iz.astype(jnp.float32) + oz],

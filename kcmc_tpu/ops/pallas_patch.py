@@ -842,18 +842,24 @@ def _blended3d_kernel(*refs, Pz: int, Pxy: int, KB: int):
         k = kb * KB + j
         slab = slabs[j][0]  # (Pz, SY, Wp)
         SY, Wp = slab.shape[1], slab.shape[2]
-        slab = pltpu.roll(slab, SY - ryr[b, k], 1)
-        slab = pltpu.roll(slab, Wp - oxr[b, k], 2)
-        raw = slab[:, :Pxy, :Pxy]  # (Pz, Pxy, Pxy)
         fx = fx_ref[j, 0]
         fy = fy_ref[j, 0]
         fz = fz_ref[j, 0]
-        pb2 = (
-            (1.0 - fy) * (1.0 - fx) * raw[:, :Pb, :Pb]
-            + (1.0 - fy) * fx * raw[:, :Pb, 1:]
-            + fy * (1.0 - fx) * raw[:, 1:, :Pb]
-            + fy * fx * raw[:, 1:, 1:]
-        )  # (Pz, Pb, Pb) in-plane bilinear per slice
+        # Separable in-plane lerp BEFORE the cut, as static +1 rolls on
+        # the full-width slab (the 2D kernels' round-5 form): the 4-tap
+        # blend's 1-offset (Pz, Pb, Pb) taps each paid a misaligned-
+        # view relayout. Wrap safety: the y-wrap garbage lands at row
+        # SY-1 (reads stop at ry + Pxy <= SY - 1 for ry < 8) and the
+        # x-wrap at lane Wp-1 (origins sit >= 128 lanes from the padded
+        # right edge). Same trilinear value, different grouping — the
+        # jnp oracle's 8-corner blend already differs from the old
+        # per-slice 4-tap at tie level, covered by the describe3d
+        # tolerance contract.
+        yb = (1.0 - fy) * slab + fy * pltpu.roll(slab, SY - 1, 1)
+        xb = (1.0 - fx) * yb + fx * pltpu.roll(yb, Wp - 1, 2)
+        v = pltpu.roll(xb, SY - ryr[b, k], 1)
+        v = pltpu.roll(v, Wp - oxr[b, k], 2)
+        pb2 = v[:, :Pb, :Pb]  # (Pz, Pb, Pb) in-plane bilinear per slice
         out_ref[j] = (1.0 - fz) * pb2[: Pz - 1] + fz * pb2[1:]
 
 

@@ -205,6 +205,38 @@ def test_piecewise_residual_passes_improve_field():
         MotionCorrector(model="piecewise", field_passes=0)
 
 
+def test_piecewise_refine_hypotheses_budget():
+    """The refine-pass hypothesis budget (round 5): 0 must fall back to
+    the full patch_hypotheses budget exactly (same PRNG stream, same
+    results), and the small default budget must hold accuracy — the
+    refine passes fit a 2x-threshold-gated residual where even 8
+    hypotheses find consensus (CorrectorConfig.refine_hypotheses)."""
+    data = synthetic.make_piecewise_stack(
+        n_frames=6, shape=(192, 192), max_disp=5.0, seed=21
+    )
+    from kcmc_tpu.utils.metrics import field_rmse
+
+    gt = data.fields - data.fields[0]
+
+    def run(**kw):
+        res = MotionCorrector(
+            model="piecewise", backend="jax", batch_size=6, **kw
+        ).correct(data.stack)
+        return res.fields, field_rmse(res.fields, gt)
+
+    f_full, e_full = run(refine_hypotheses=0)
+    f_same, _ = run(refine_hypotheses=32)  # == patch_hypotheses default
+    np.testing.assert_array_equal(np.asarray(f_full), np.asarray(f_same))
+    _, e_small = run(refine_hypotheses=8)  # the shipping default
+    # gated-residual consensus: the small budget may differ at RANSAC
+    # sampling level but must not cost measurable field accuracy
+    assert e_small <= e_full * 1.1 + 1e-3, (e_small, e_full)
+    import pytest
+
+    with pytest.raises(ValueError, match="refine_hypotheses"):
+        MotionCorrector(model="piecewise", refine_hypotheses=-1)
+
+
 def test_apply_correction_multichannel_and_valid_region():
     """Register the structural channel, apply to the functional channel
     (multi-channel microscopy workflow), then crop to the common valid
