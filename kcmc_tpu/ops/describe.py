@@ -78,9 +78,13 @@ _MOMENT_KERNELS = np.stack(
     ]
 )[:, None]
 
-_RUN_ALIGN = 16  # orientation-run alignment: the extraction kernel's
-# keypoint block (_KB) and the bf16 sublane tile — run starts stay
-# block-aligned so the dispatch copy moves whole blocks
+_RUN_ALIGN = 16  # orientation-run alignment: the bf16 sublane tile,
+# and the block size of binned_select_rows' one-bin-per-block
+# contract. Must stay a MULTIPLE of the extraction kernel's keypoint
+# block (pallas_patch._KB, re-swept to 8 in round 5) so extraction
+# blocks never straddle a run boundary — it does NOT track _KB itself
+# (lowering it to _KB would break the bf16 tile alignment this value
+# encodes)
 
 _BINS_FIRST_MIN_K = 2048  # bins-first pays a B*H*W-scaled moment-map
 # cost to delete B*K-scaled dispatch traffic; crossover ~K=1250 at

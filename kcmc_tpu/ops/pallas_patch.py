@@ -41,7 +41,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _WIN = 256  # lane window: covers the 128-alignment residual + patch width
-_KB = 16  # keypoints per program (measured best on v5e)
+_KB = 8  # keypoints per program. 16 was the measured best for the
+# original wide-slab 4-tap kernel; re-swept after the round-5
+# narrow-slab + separable-blend rewrite, 8 wins (9.3 vs 10.2 ms/batch
+# at B=32, K=4096, 512²; 32: 12.3, 64: 13.3 — shorter serial
+# per-program chains pipeline better than fewer program launches).
+# Note _RUN_ALIGN (describe) stays 16: 16-aligned orientation runs are
+# also 8-aligned, so extraction blocks never straddle a run boundary
+# and the dynamic-block selection keeps its one-bin-per-16-rows
+# contract.
 # Scalar-prefetch arrays (keypoint origins) live whole in SMEM, which is
 # 1 MB on v5e: at batch 64 x K=2048 the two (B, K) i32 origin planes
 # alone are exactly 1 MB and the compile dies with "Ran out of memory in
