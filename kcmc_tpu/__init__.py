@@ -46,6 +46,14 @@ def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only
             from kcmc_tpu.utils import trajectory
 
             return getattr(trajectory, name)
+        if name in ("FaultPlan", "RetryPolicy", "classify_transient"):
+            from kcmc_tpu.utils import faults
+
+            return getattr(faults, name)
+        if name == "RobustnessReport":
+            from kcmc_tpu.utils.metrics import RobustnessReport
+
+            return RobustnessReport
         if name in ("available_backends", "get_backend", "register_backend"):
             import kcmc_tpu.backends as _b
 

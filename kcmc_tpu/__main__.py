@@ -70,6 +70,8 @@ def _parse_reference_and_overrides(args):
         overrides["field_polish"] = args.field_polish
     if getattr(args, "transform_polish", -1) >= 0:
         overrides["transform_polish"] = args.transform_polish
+    if getattr(args, "inject_faults", ""):
+        overrides["fault_plan"] = args.inject_faults
     return ref, overrides
 
 
@@ -107,6 +109,9 @@ def _cmd_correct(args) -> int:
             payload["transforms"] = res.transforms
         if res.fields is not None:
             payload["fields"] = res.fields
+        if res.robustness is not None:
+            # 0-d unicode array: readable back without allow_pickle
+            payload["robustness"] = np.array(json.dumps(res.robustness))
         np.savez(args.transforms, **payload)
 
     fps = res.frames_per_sec
@@ -142,6 +147,11 @@ def _cmd_correct(args) -> int:
         )
     if res.timing.get("warp_escalated"):
         summary["warp_escalated"] = True
+    rb = res.robustness
+    if rb is not None and any(rb.values()):
+        # only when something actually happened: retries, failovers,
+        # rescued frames, quarantined checkpoint parts, injected faults
+        summary["robustness"] = rb
     if "template_corr" in res.diagnostics:
         # nan-aware: registration-only runs NaN out frames whose QC
         # would have been measured against an unrescued zeroed warp
@@ -412,6 +422,13 @@ def main(argv=None) -> int:
         "models (default 1 — breaks the keypoint-noise accuracy "
         "floor, ~3-10x lower RMSE; 0 = off)",
     )
+    p.add_argument(
+        "--inject-faults", default="", metavar="SPEC",
+        help="deterministic chaos run: inject faults per SPEC (e.g. "
+        "'io_read:step=3:raise, device:step=7:transient, "
+        "checkpoint:corrupt_part=1'; grammar in docs/ROBUSTNESS.md). "
+        "Also settable via the KCMC_FAULT_PLAN env var",
+    )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
 
@@ -460,6 +477,10 @@ def main(argv=None) -> int:
                    choices=["none", "deflate", "packbits"])
     p.add_argument("--output-dtype", default="input")
     p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument(
+        "--inject-faults", default="", metavar="SPEC",
+        help="deterministic chaos run (see `correct --inject-faults`)",
+    )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_stabilize)
 

@@ -104,6 +104,27 @@ def _coverage_field(fields: jnp.ndarray, shape) -> jnp.ndarray:
     )(fields)
 
 
+@functools.cache
+def _runtime_error_types() -> tuple:
+    """Device-runtime exception types whose instances MAY be transient
+    (wedged link, exhausted HBM, preempted donation). The corrector's
+    retry engine still gates on the message's status markers
+    (utils/faults.classify_transient), so compile/shape errors of the
+    same type stay fatal."""
+    types = []
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+
+        types.append(XlaRuntimeError)
+    except ImportError:
+        pass
+    try:  # newer jaxlib re-exports under jax.errors
+        types.append(jax.errors.JaxRuntimeError)
+    except AttributeError:
+        pass
+    return tuple(types)
+
+
 @register_backend("jax")
 class JaxBackend:
     """XLA-compiled pipeline; runs on TPU (or any JAX backend)."""
@@ -113,6 +134,13 @@ class JaxBackend:
     # their native dtype (uint16 etc.) only to backends declaring this;
     # the batch program casts to float32 on device.
     accepts_native_dtype = True
+
+    # Robustness seam: exception types the retry engine may classify as
+    # transient device errors (message status markers decide per
+    # instance). Plugin backends can declare their own tuple.
+    @property
+    def transient_error_types(self) -> tuple:
+        return _runtime_error_types()
 
     def __init__(self, config: CorrectorConfig, mesh=None, **_options):
         self.config = config

@@ -234,6 +234,39 @@ class CorrectorConfig:
     # garbage unless the caller opts in.
     sanitize_input: bool = False
 
+    # -- robustness --------------------------------------------------------
+    # Total attempt budget per retryable operation (chunk reads, device
+    # batches): 1 = no retry; the default absorbs two transient faults
+    # per operation before walking the degradation ladder. Fatal errors
+    # (shape/config bugs) are never retried — see
+    # utils/faults.classify_transient and docs/ROBUSTNESS.md.
+    retry_attempts: int = 3
+    # Exponential-backoff base for retries, seconds (doubles per
+    # attempt, clipped to retry_backoff_max_s, jittered so parallel
+    # workers don't thundering-herd shared storage/links).
+    retry_backoff_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    retry_jitter: float = 0.25  # uniform fraction in [0, 1]
+    # Degradation-ladder rung 2: after device retries are exhausted on
+    # a batch, re-run it on this backend through the get_backend seam
+    # (None disables — exhausted retries then fall to the mark-failed
+    # rung, or raise). The numpy backend implements the identical
+    # algorithm (the parity oracle), so a failed-over batch loses
+    # throughput, not correctness.
+    failover_backend: str | None = "numpy"
+    # Degradation-ladder rung 3: when the failover also fails, mark the
+    # batch's frames failed (identity transform, zero inliers, raw
+    # pixels) instead of aborting; matrix-model transforms are then
+    # rescued post-run by interpolate_failed trajectory interpolation.
+    # False = exhausted ladders re-raise.
+    degrade_mark_failed: bool = True
+    # Deterministic fault-injection spec for chaos runs (None = off;
+    # also settable via the KCMC_FAULT_PLAN env var or the CLI's
+    # --inject-faults). Grammar in utils/faults.py / docs/ROBUSTNESS.md,
+    # e.g. "io_read:step=3:raise, device:step=7:transient,
+    # checkpoint:corrupt_part=1". Injection is seeded by `seed`.
+    fault_plan: str | None = None
+
     # -- execution ---------------------------------------------------------
     batch_size: int = 32  # frames per jitted device step
     # Warp kernel selection: "jnp" = XLA gather warp (all models, exact,
@@ -389,6 +422,30 @@ class CorrectorConfig:
                 "patch_model must be one of translation/rigid/"
                 f"similarity/affine, got {self.patch_model!r}"
             )
+        if self.retry_attempts < 1:
+            raise ValueError(
+                f"retry_attempts must be >= 1 (1 = no retry), got "
+                f"{self.retry_attempts}"
+            )
+        if self.retry_backoff_s <= 0.0:
+            raise ValueError(
+                f"retry_backoff_s must be positive, got {self.retry_backoff_s}"
+            )
+        if self.retry_backoff_max_s < self.retry_backoff_s:
+            raise ValueError(
+                "retry_backoff_max_s must be >= retry_backoff_s, got "
+                f"{self.retry_backoff_max_s} < {self.retry_backoff_s}"
+            )
+        if not 0.0 <= self.retry_jitter <= 1.0:
+            raise ValueError(
+                f"retry_jitter must be in [0, 1], got {self.retry_jitter}"
+            )
+        if self.fault_plan is not None:
+            # Parse-validate eagerly so a typo'd chaos spec fails at
+            # construction, not mid-run at the first armed surface.
+            from kcmc_tpu.utils.faults import FaultPlan
+
+            FaultPlan.from_spec(self.fault_plan)
         if not 0.0 < self.rescue_warn_fraction <= 1.0:
             raise ValueError(
                 "rescue_warn_fraction must be in (0, 1], got "

@@ -22,6 +22,19 @@ from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.utils.metrics import StageTimer
 
 
+# Config fields that shape failure recovery but never the happy-path
+# results; pinned to their defaults inside the checkpoint resume
+# signature so changing them between runs doesn't invalidate a resume.
+_ROBUSTNESS_SIG_NEUTRAL = {
+    f: CorrectorConfig.__dataclass_fields__[f].default
+    for f in (
+        "fault_plan", "retry_attempts", "retry_backoff_s",
+        "retry_backoff_max_s", "retry_jitter", "failover_backend",
+        "degrade_mark_failed",
+    )
+}
+
+
 def _fingerprint(ref) -> str:
     """Stable identity string for a reference selector: explicit arrays
     hash by content (two different arrays must not collide in a resume-
@@ -130,6 +143,13 @@ class CorrectionResult:
     @property
     def frames_per_sec(self) -> float | None:
         return self.timing.get("frames_per_sec")
+
+    @property
+    def robustness(self) -> dict | None:
+        """Recovery telemetry of the run (retries, failovers, rescued
+        frames, quarantined checkpoint parts) — the RobustnessReport
+        dict, or None when the run had no retry machinery active."""
+        return self.timing.get("robustness")
 
 
 def apply_correction(
@@ -565,6 +585,315 @@ class MotionCorrector:
         self._escalated = False
         self._escalation_allowed = True
         self._rescue_warned = False
+        # Robustness machinery (reset per run by _begin_robust_run).
+        self._fault_plan = None
+        self._retry_policy = None
+        self._io_retry_policy = None
+        self._robustness = None
+        self._out_template = None
+        self._failover_backend = None
+        self._failover_ref = None
+
+    # -- robustness: retry engine + degradation ladder ------------------
+
+    def _begin_robust_run(self) -> None:
+        """Arm the per-run robustness state: the fault plan (config spec
+        or KCMC_FAULT_PLAN env var), the retry policy, and a fresh
+        RobustnessReport. Called at the top of correct/correct_file so
+        injection counters and telemetry are run-scoped."""
+        from kcmc_tpu.utils.faults import RetryPolicy, resolve_fault_plan
+        from kcmc_tpu.utils.metrics import RobustnessReport
+
+        cfg = self.config
+        self._fault_plan = resolve_fault_plan(cfg.fault_plan, seed=cfg.seed)
+
+        def policy(seed):
+            return RetryPolicy(
+                attempts=cfg.retry_attempts,
+                backoff_s=cfg.retry_backoff_s,
+                backoff_max_s=cfg.retry_backoff_max_s,
+                jitter=cfg.retry_jitter,
+                seed=seed,
+            )
+
+        if cfg.retry_attempts > 1:
+            # Separate instances per surface: the device policy runs in
+            # the main thread, the io policy in the prefetch thread —
+            # numpy Generators are not thread-safe, and per-surface
+            # streams keep the jitter sequences seed-deterministic
+            # regardless of thread interleaving.
+            self._retry_policy = policy(cfg.seed)
+            self._io_retry_policy = policy(cfg.seed + 1)
+        else:
+            self._retry_policy = None
+            self._io_retry_policy = None
+        self._robustness = RobustnessReport()
+        self._out_template = None
+        # Drop the previous run's cached failover reference — it pins a
+        # full prepared reference (frame, keypoints, descriptors). The
+        # failover BACKEND stays cached: it is config-derived and holds
+        # reusable compiled batch programs.
+        self._failover_ref = None
+
+    def _robust_active(self) -> bool:
+        return self._retry_policy is not None or self._fault_plan is not None
+
+    @staticmethod
+    def _materialize_host(out: dict) -> dict:
+        """Force device outputs to host — this is where an async batch's
+        deferred device error surfaces, so the ladder can catch it."""
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    def _note_out_template(self, out: dict) -> None:
+        """Record per-key (frame-shape, dtype) of a successful batch —
+        the synthesis template for the ladder's mark-failed rung."""
+        if self._out_template is None:
+            self._out_template = {
+                k: (tuple(np.shape(v)[1:]), np.asarray(v).dtype)
+                for k, v in out.items()
+            }
+
+    def _get_failover_backend(self):
+        """Degradation-ladder rung 2: the failover backend instance
+        (config.failover_backend through the get_backend seam), or None
+        when disabled, identical to the primary, or unconstructible for
+        this config."""
+        cfg = self.config
+        name = cfg.failover_backend
+        if not name or name == self.backend_name:
+            return None
+        if self._failover_backend is None:
+            fb_cfg = cfg
+            if cfg.match_radius is not None:
+                # the numpy oracle refuses banded-matching configs; the
+                # dense matcher recovers a superset of banded matches,
+                # so failover falls back to it
+                fb_cfg = cfg.replace(match_radius=None)
+            try:
+                self._failover_backend = get_backend(name, fb_cfg)
+            except Exception:
+                return None
+        return self._failover_backend
+
+    def _failover_reference(self, fb, ref: dict):
+        """The failover backend's own prepared reference, rebuilt from
+        the raw reference frame (backend ref dicts are internally
+        backend-specific); cached per ref identity so repeated failed
+        batches don't re-detect."""
+        cached = self._failover_ref
+        if cached is not None and cached[0] is ref:
+            return cached[1]
+        fb_ref = fb.prepare_reference(np.asarray(ref["frame"], np.float32))
+        if ref.get("_skip_quality"):
+            fb_ref = dict(fb_ref, _skip_quality=True)
+        self._failover_ref = (ref, fb_ref)
+        return fb_ref
+
+    def _attempt_batch(self, backend, batch, ref, idx, kw: dict) -> dict:
+        """One synchronous (re-)attempt of a batch on `backend`, with
+        the same output options (cast/emit seams) as the original
+        dispatch, materialized to host."""
+        dispatch = getattr(backend, "process_batch_async", None)
+        if dispatch is not None:
+            out = dispatch(batch, ref, idx, **kw)
+        else:
+            out = backend.process_batch(batch, ref, idx)
+        return self._materialize_host(out)
+
+    def _apply_out_options(
+        self, out: dict, emit_frames: bool, cast_dtype
+    ) -> dict:
+        """Normalize a ladder result to the fast path's output contract:
+        drop frames on registration-only runs, apply the integer output
+        cast the device-side path would have applied."""
+        if not emit_frames and "corrected" in out:
+            out = {k: v for k, v in out.items() if k != "corrected"}
+        if cast_dtype is not None and "corrected" in out:
+            dt = np.dtype(cast_dtype)
+            if np.issubdtype(dt, np.integer):
+                out = dict(out)
+                out["corrected"] = _cast_output(
+                    np.asarray(out["corrected"]), dt
+                )
+        return out
+
+    def _synthesize_failed_batch(
+        self, batch, idx, emit_frames: bool, cast_dtype
+    ) -> dict:
+        """Degradation-ladder rung 3: a placeholder output for a batch
+        every backend refused — identity transforms (rescued post-run by
+        interpolate_failed), raw input pixels, zero inliers, NaN QC —
+        shaped to the run's output template so the merge stays uniform.
+        `batch` may be None on registration-only runs (whose outputs
+        carry no frames, so none are needed to synthesize)."""
+        template = self._out_template
+        B = len(idx)
+        frames = None if batch is None else np.asarray(batch, np.float32)
+        tshape = template.get("transform", ((3, 3), None))[0]
+        d = tshape[-1] if tshape else 3
+        out: dict[str, np.ndarray] = {}
+        for k, (shape, dt) in template.items():
+            if k == "corrected":
+                out[k] = _cast_output(frames, dt)
+            elif k == "transform":
+                out[k] = np.tile(np.eye(d, dtype=dt), (B, 1, 1))
+            elif k == "warp_ok":
+                # False: these pixels were never registered — rolling-
+                # template updates must not blend them into the
+                # reference (the drain-side rescue is skipped for
+                # synthesized batches, so this stays False)
+                out[k] = np.zeros(B, dt)
+            elif k in ("template_corr", "coverage"):
+                out[k] = np.full((B,) + shape, np.nan, dt)
+            else:  # field, n_keypoints, n_matches, n_inliers, rms_residual
+                out[k] = np.zeros((B,) + shape, dt)
+        return self._apply_out_options(out, emit_frames, cast_dtype)
+
+    def _ladder_batch(
+        self, first_exc, backend, batch, ref, idx, kw: dict, step,
+        n: int, emit_frames: bool, cast_dtype,
+    ) -> tuple[dict, bool]:
+        """Walk the degradation ladder for one failed device batch.
+
+        Rungs: (1) bounded retries with backoff on the same backend,
+        transient errors only; (2) re-run on the failover backend
+        (numpy — same algorithm, slower); (3) mark the batch's frames
+        failed so interpolate_failed trajectory rescue covers them
+        post-run. Fatal errors raise immediately from any rung — the
+        ladder exists to outlive infrastructure, not to hide bugs.
+
+        Returns (host output dict, mark_failed) — mark_failed True only
+        for a rung-3 synthesized output, whose frames must bypass the
+        drain-side warp rescue (it would re-flag them as successfully
+        warped and blend unregistered pixels into rolling templates).
+        """
+        import warnings
+
+        from kcmc_tpu.utils import faults
+
+        plan, policy = self._fault_plan, self._retry_policy
+        report = self._robustness
+        extra = getattr(backend, "transient_error_types", ())
+        if not faults.classify_transient(first_exc, extra):
+            raise first_exc
+        last = first_exc
+        # batch is None only for drain-time failures of registration-
+        # only spans (whose input frames are deliberately not pinned in
+        # flight): re-execution rungs are unavailable, rung 3 still is.
+        attempts = (
+            policy.attempts if policy is not None and batch is not None else 1
+        )
+        for retry in range(attempts - 1):
+            report.device_retries += 1
+            policy.sleep(policy.delay(retry))
+            try:
+                if plan is not None:
+                    plan.maybe_fail("device", step)
+                out = self._attempt_batch(backend, batch, ref, idx, kw)
+                self._note_out_template(out)
+                return (
+                    self._apply_out_options(out, emit_frames, cast_dtype),
+                    False,
+                )
+            except Exception as e:
+                last = e
+                if not faults.classify_transient(e, extra):
+                    raise
+        fb = self._get_failover_backend() if batch is not None else None
+        if fb is not None:
+            try:
+                if plan is not None:
+                    plan.maybe_fail("failover", step)
+                fb_ref = self._failover_reference(fb, ref)
+                out = self._materialize_host(
+                    fb.process_batch(np.asarray(batch), fb_ref, idx)
+                )
+                self._note_out_template(out)
+                report.backend_failovers += 1
+                warnings.warn(
+                    f"kcmc: device batch at frames {int(idx[0])}.."
+                    f"{int(idx[n - 1])} failed {attempts} attempt(s) "
+                    f"({type(last).__name__}: {last}); recovered on the "
+                    f"'{self.config.failover_backend}' failover backend",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return (
+                    self._apply_out_options(out, emit_frames, cast_dtype),
+                    False,
+                )
+            except Exception as e:
+                # The ladder's contract holds on every rung: a FATAL
+                # failover error (a real bug, an injected fatal) raises
+                # instead of being silently converted to failed frames.
+                # Classified against BOTH backends' transient types —
+                # this rung still touches the primary's device arrays
+                # (materializing ref["frame"]), so a wedged-link error
+                # here must fall through to mark-failed, not abort.
+                if not faults.classify_transient(
+                    e,
+                    tuple(extra)
+                    + tuple(getattr(fb, "transient_error_types", ())),
+                ):
+                    raise
+                last = e
+        if (
+            not self.config.degrade_mark_failed
+            or self._out_template is None
+            or (batch is None and "corrected" in self._out_template)
+        ):
+            raise last
+        report.failed_frame_indices.extend(int(i) for i in idx[:n])
+        warnings.warn(
+            f"kcmc: device batch at frames {int(idx[0])}..{int(idx[n - 1])} "
+            f"failed on every ladder rung ({type(last).__name__}: {last}); "
+            f"marking its {n} frame(s) failed — matrix-model transforms "
+            "are rescued by trajectory interpolation, pixels stay "
+            "uncorrected (diagnostics['frames_failed'])",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return (
+            self._synthesize_failed_batch(batch, idx, emit_frames, cast_dtype),
+            True,
+        )
+
+    def _finalize_robustness(
+        self, merged: dict, transforms, offset: int, length: int,
+        timing: dict, host: bool = True,
+    ):
+        """Post-merge tail of the degradation ladder: publish the
+        RobustnessReport into timing, expose the frames_failed mask,
+        and rescue failed frames' matrix transforms via
+        interpolate_failed (piecewise fields have no matrix trajectory
+        to interpolate — their failures stay marked only). Returns the
+        (possibly rescued) transforms."""
+        report = self._robustness
+        if report is None:
+            return transforms
+        if self._fault_plan is not None:
+            report.faults_injected = self._fault_plan.injected
+        if report.failed_frame_indices and length > 0:
+            local = np.asarray(report.failed_frame_indices, int) - offset
+            local = local[(local >= 0) & (local < length)]
+            mask = np.zeros(length, bool)
+            mask[local] = True
+            merged["frames_failed"] = mask
+            if (
+                host
+                and transforms is not None
+                and (~mask).any()
+                and mask.any()
+            ):
+                from kcmc_tpu.utils.trajectory import interpolate_failed
+
+                transforms = interpolate_failed(
+                    np.asarray(transforms), ~mask
+                )
+                report.rescued_frames += int(mask.sum())
+        if self._robust_active() or report.any():
+            timing["robustness"] = report.as_dict()
+        return transforms
 
     # ------------------------------------------------------------------
 
@@ -730,6 +1059,7 @@ class MotionCorrector:
         if stack.ndim == 3 and self.config.model == "rigid3d":
             raise ValueError("model='rigid3d' requires a (T, D, H, W) stack")
 
+        self._begin_robust_run()
         timer = StageTimer()
         cfg = self.config
         T = len(stack) if end_frame is None else min(end_frame, len(stack))
@@ -822,6 +1152,10 @@ class MotionCorrector:
         fields = merged.pop("field", None)
         timing = timer.report(n_frames=len(indices))
         timing["warp_escalated"] = self._escalated
+        transforms = self._finalize_robustness(
+            merged, transforms, start_frame, T - start_frame, timing,
+            host=not device_outputs,
+        )
         return CorrectionResult(
             corrected=corrected,
             transforms=transforms,
@@ -911,9 +1245,25 @@ class MotionCorrector:
             self._escalated = False
             self._escalation_allowed = allow_escalation
             self._rescue_warned = False
-        inflight: list[tuple[int, dict, Any]] = []
+        inflight: list[tuple] = []
         accepts_cast: dict = {}  # per-backend kwarg support, inspected once
         native_ok: dict[int, bool] = {}
+        plan = self._fault_plan
+        # The ladder can only re-attempt a drained batch when host
+        # outputs are requested and the retry machinery is armed — and
+        # pinning `depth` extra input batches is only free where frames
+        # are already retained (keep_frames) or emitted. Registration-
+        # only spans (emit_frames=False) deliberately don't pin: their
+        # drain-time failures skip the re-execution rungs and go
+        # straight to mark-failed, which needs no frames there.
+        keep_for_ladder = (
+            self._robust_active() and to_host and (keep_frames or emit_frames)
+        )
+
+        def flush_inflight():
+            while inflight:
+                self._drain_entry(inflight.pop(0), drain, ref, to_host)
+
         for n, batch, idx in batches:
             backend = (
                 self._get_escalation_backend() if self._escalated else self.backend
@@ -927,11 +1277,11 @@ class MotionCorrector:
                 batch = batch.astype(np.float32)
             dispatch = getattr(backend, "process_batch_async", None)
             kept = batch if keep_frames else None
+            kw = {}
             if dispatch is not None:
                 # Only pass non-default options the backend declares:
                 # plugin backends implementing the original 3-arg seam
                 # keep working for the (default) host-output path.
-                kw = {}
                 if not to_host:
                     kw["to_host"] = False
                 if cast_dtype is not None:
@@ -950,21 +1300,77 @@ class MotionCorrector:
                         )
                     if accepts_cast[key]:
                         kw["emit_frames"] = False
-                out = dispatch(batch, ref, idx, **kw)
-                if not emit_frames and "corrected" in out:
-                    # backends without the emit_frames seam still drop
-                    # the frames here (no D2H saving, same results)
-                    out = {k: v for k, v in out.items() if k != "corrected"}
-                inflight.append((n, out, kept))
+            step = plan.op_index("device") if plan is not None else None
+            try:
+                if plan is not None:
+                    plan.maybe_fail("device", step)
+                if dispatch is not None:
+                    out = dispatch(batch, ref, idx, **kw)
+                else:
+                    out = backend.process_batch(batch, ref, idx)
+            except Exception as e:
+                # Degradation ladder (retry -> failover -> mark-failed).
+                # Flush in-flight batches first so drained outputs stay
+                # ordered and the ladder's synthesis template exists.
+                flush_inflight()
+                out, failed = self._ladder_batch(
+                    e, backend, batch, ref, idx, kw, step, n,
+                    emit_frames, cast_dtype,
+                )
+                drain((n, out, self._failed_kept(out, kept, failed)))
+                continue
+            if not emit_frames and "corrected" in out:
+                # backends without the emit_frames seam still drop
+                # the frames here (no D2H saving, same results)
+                out = {k: v for k, v in out.items() if k != "corrected"}
+            if dispatch is not None:
+                inflight.append(
+                    (n, out, kept, batch if keep_for_ladder else None,
+                     idx, step, backend, kw, emit_frames, cast_dtype)
+                )
                 if len(inflight) >= depth:
-                    drain(inflight.pop(0))
+                    self._drain_entry(inflight.pop(0), drain, ref, to_host)
             else:
-                out = backend.process_batch(batch, ref, idx)
-                if not emit_frames and "corrected" in out:
-                    out = {k: v for k, v in out.items() if k != "corrected"}
+                if self._robust_active():
+                    self._note_out_template(out)
                 drain((n, out, kept))
-        for entry in inflight:
-            drain(entry)
+        flush_inflight()
+
+    def _drain_entry(self, entry, drain, ref, to_host) -> None:
+        """Drain one in-flight async batch. With the retry engine armed
+        and host outputs requested, device arrays are materialized here
+        first — this is where a deferred (async) device error surfaces,
+        and it enters the same degradation ladder as a dispatch-time
+        failure."""
+        n, out, kept, batch, idx, step, backend, kw, emit2, cast2 = entry
+        if self._robust_active() and to_host:
+            try:
+                out = self._materialize_host(out)
+                self._note_out_template(out)
+            except Exception as e:
+                out, failed = self._ladder_batch(
+                    e, backend, batch, ref, idx, kw, step, n, emit2, cast2
+                )
+                kept = self._failed_kept(out, kept, failed)
+        drain((n, out, kept))
+
+    def _failed_kept(self, out: dict, kept, failed: bool):
+        """Drain-side handling of a rung-3 (mark-failed) ladder result:
+        the kept frames are withheld from drain so `_rescue_flagged`
+        cannot re-warp the synthesized output (which would flip its
+        warp_ok back to True and blend unregistered pixels into rolling
+        templates). The `warp_rescued` diagnostic the rescue pass would
+        have added is pre-set (all False) to keep merge keys uniform
+        across batches."""
+        if not failed:
+            return kept
+        if (
+            kept is not None
+            and "warp_ok" in out
+            and getattr(self.backend, "rescue_warp", None) is not None
+        ):
+            out["warp_rescued"] = np.zeros(len(out["warp_ok"]), bool)
+        return None
 
     @staticmethod
     def _dispatch_accepts(dispatch, name: str) -> bool:
@@ -1178,6 +1584,7 @@ class MotionCorrector:
         """
         from kcmc_tpu.io import ChunkedStackLoader, open_stack
 
+        self._begin_robust_run()
         timer = StageTimer()
         cfg = self.config
         B = cfg.batch_size
@@ -1246,7 +1653,13 @@ class MotionCorrector:
                 from kcmc_tpu.utils.checkpoint import load_stream_checkpoint
 
                 ckpt_sig = {
-                    "config": repr(cfg),
+                    # Robustness knobs are normalized out of the resume
+                    # signature: they only shape failure RECOVERY, never
+                    # the happy-path results — an operator bumping
+                    # retry_attempts mid-incident (or a chaos rerun via
+                    # KCMC_FAULT_PLAN / fault_plan) must resume the run,
+                    # not silently restart it from zero.
+                    "config": repr(cfg.replace(**_ROBUSTNESS_SIG_NEUTRAL)),
                     "n_frames": len(ts),
                     "frame_shape": list(ts.frame_shape),
                     "dtype": str(ts.dtype),
@@ -1269,7 +1682,12 @@ class MotionCorrector:
                     "compression": compression,
                 }
                 n_parts = 0
-                state = load_stream_checkpoint(checkpoint)
+                part_history: list = []
+                state = load_stream_checkpoint(
+                    checkpoint,
+                    fault_plan=self._fault_plan,
+                    report=self._robustness,
+                )
                 if state is not None and state[0].get("sig") == ckpt_sig:
                     meta, segments = state
                     try:
@@ -1281,6 +1699,18 @@ class MotionCorrector:
                         start = int(meta["done"])
                         outs = segments
                         n_parts = int(meta.get("n_parts", 0))
+                        part_history = list(meta.get("parts", []))[:n_parts]
+                        # frames the degradation ladder marked failed
+                        # BEFORE the kill: restore them so the resumed
+                        # run still reports frames_failed and applies
+                        # the interpolate_failed rescue (a corrupt-part
+                        # rewind recomputes frames >= start, so only
+                        # restored frames keep their failed status)
+                        self._robustness.failed_frame_indices.extend(
+                            int(i)
+                            for i in meta.get("failed", [])
+                            if int(i) < start
+                        )
                         tmpl = meta.get("arrays", {}).get("template")
                         if tmpl is not None:
                             # rolling-template runs: resume with the
@@ -1291,6 +1721,7 @@ class MotionCorrector:
                         # output file vanished/shorter than the cursor:
                         # restart from scratch
                         writer, start, outs, n_parts = None, 0, [], 0
+                        part_history = []
                 # signature mismatch: stale checkpoint, restart
             if writer is None and output:
                 # Extension-dispatched: .zarr -> ZarrWriter, else TIFF
@@ -1310,18 +1741,26 @@ class MotionCorrector:
                 "saved": start,
                 "part": n_parts if checkpoint is not None else 0,
                 "seg_saved": len(outs),
+                # per-part {done, writer, checksum} snapshots: the
+                # rewind points corrupt-part quarantine resumes from
+                "history": part_history if checkpoint is not None else [],
             }
 
             def save_ckpt():
                 from kcmc_tpu.utils.checkpoint import save_stream_checkpoint
 
-                save_stream_checkpoint(
+                saved_meta = save_stream_checkpoint(
                     checkpoint,
                     {
                         "sig": ckpt_sig,
                         "done": cursor["done"],
                         "n_parts": cursor["part"],
                         "writer": writer.checkpoint_state(),
+                        "parts": cursor["history"],
+                        "failed": [
+                            int(i)
+                            for i in self._robustness.failed_frame_indices
+                        ],
                     },
                     outs[cursor["seg_saved"] :],
                     cursor["part"],
@@ -1331,6 +1770,7 @@ class MotionCorrector:
                         else None
                     ),
                 )
+                cursor["history"] = saved_meta.get("parts", cursor["history"])
                 if len(outs) > cursor["seg_saved"]:
                     cursor["part"] += 1
                 cursor["seg_saved"] = len(outs)
@@ -1467,7 +1907,10 @@ class MotionCorrector:
                             spans = [(slo, shi, emit_frames)]
                         for lo2, hi2, emit2 in spans:
                             loader = ChunkedStackLoader(
-                                ts, chunk_size=chunk, start=lo2, stop=hi2
+                                ts, chunk_size=chunk, start=lo2, stop=hi2,
+                                fault_plan=self._fault_plan,
+                                retry=self._io_retry_policy,
+                                report=self._robustness,
                             )
                             batch_gen = batches(loader)
                             try:
@@ -1541,9 +1984,13 @@ class MotionCorrector:
         timing["warp_escalated"] = self._escalated
         if checkpoint is not None:
             timing["restored_frames"] = restored
+        transforms = merged.pop("transform", None)
+        transforms = self._finalize_robustness(
+            merged, transforms, 0, cursor["done"], timing
+        )
         return CorrectionResult(
             corrected=corrected,
-            transforms=merged.pop("transform", None),
+            transforms=transforms,
             fields=merged.pop("field", None),
             diagnostics=merged,
             timing=timing,

@@ -6,6 +6,13 @@ queue of decoded (lo, hi, ndarray) chunks ahead of the consumer, so the
 TPU never waits on disk or decompression. This is the host half of the
 streaming pipeline; the device half is the orchestrator's dispatch-ahead
 window (corrector.py).
+
+Chunk reads are the run's storage-failure surface: with a
+`RetryPolicy` attached (corrector runs pass theirs), transient read
+errors (flaky NFS, dropped object-store connections — anything
+`classify_transient` accepts) are retried with exponential backoff
+before surfacing; a `FaultPlan` injects deterministic faults here for
+chaos testing (surface ``io_read``).
 """
 
 from __future__ import annotations
@@ -26,6 +33,12 @@ class ChunkedStackLoader:
     HDF5Stack, ...), a path (dispatched via open_stack), or any
     array-like with numpy-style slicing along axis 0 (ndarray, memmap,
     zarr-ish).
+
+    fault_plan / retry / report: optional robustness wiring
+    (utils/faults.FaultPlan, utils/faults.RetryPolicy,
+    utils/metrics.RobustnessReport) — chunk reads are retried per the
+    policy, injected faults fire per the plan, retries are counted in
+    the report. All None by default: the bare loader reads exactly once.
     """
 
     def __init__(
@@ -36,6 +49,9 @@ class ChunkedStackLoader:
         stop: int | None = None,
         prefetch: int = 2,
         n_threads: int = 0,
+        fault_plan=None,
+        retry=None,
+        report=None,
     ):
         self._own = False
         if isinstance(source, (str, os.PathLike)):
@@ -49,11 +65,42 @@ class ChunkedStackLoader:
         self.stop = self.n_total if stop is None else min(stop, self.n_total)
         self.chunk_size = chunk_size
         self.prefetch = max(1, prefetch)
+        self._fault_plan = fault_plan
+        self._retry = retry
+        self._report = report
 
-    def _read(self, lo: int, hi: int) -> np.ndarray:
+    def _read_raw(self, lo: int, hi: int) -> np.ndarray:
         if hasattr(self.source, "read"):  # io.formats protocol readers
             return self.source.read(lo, hi)
         return np.asarray(self.source[lo:hi])
+
+    def _read(self, lo: int, hi: int) -> np.ndarray:
+        """One chunk read, retried per the attached policy.
+
+        Transient failures (OS-level IO errors, injected transient
+        faults) back off and retry up to the policy's attempt budget;
+        fatal errors and exhausted budgets raise to the consumer.
+        """
+        plan, policy = self._fault_plan, self._retry
+        if plan is None and policy is None:
+            return self._read_raw(lo, hi)  # zero-overhead happy path
+        from kcmc_tpu.utils.faults import classify_transient
+
+        step = plan.op_index("io_read") if plan is not None else None
+        attempts = policy.attempts if policy is not None else 1
+        for attempt in range(attempts):
+            try:
+                if plan is not None:
+                    plan.maybe_fail("io_read", step)
+                return self._read_raw(lo, hi)
+            except Exception as e:
+                if attempt == attempts - 1 or not classify_transient(e):
+                    raise
+                if self._report is not None:
+                    self._report.io_retries += 1
+                if policy is not None:
+                    policy.sleep(policy.delay(attempt))
+        raise AssertionError("unreachable")  # loop always returns/raises
 
     def __len__(self) -> int:
         return self.stop - self.start
@@ -69,9 +116,21 @@ class ChunkedStackLoader:
                         return
                     hi = min(lo + self.chunk_size, self.stop)
                     q.put((lo, hi, self._read(lo, hi)))
-            except BaseException as e:  # surface decode errors to consumer
+            except Exception as e:  # surface decode errors to consumer
                 q.put(e)
                 return
+            except BaseException as e:
+                # KeyboardInterrupt/SystemExit in the producer thread
+                # are NOT decode errors, but a clean end-of-stream here
+                # would let the consumer finish successfully on
+                # truncated data — surface a loud, correctly-attributed
+                # error instead, and let the original exception
+                # terminate this thread.
+                q.put(RuntimeError(
+                    f"stack read interrupted by {type(e).__name__} in "
+                    "the prefetch thread (not an input decode error)"
+                ))
+                raise
             q.put(None)
 
         t = threading.Thread(target=producer, daemon=True)
@@ -81,7 +140,9 @@ class ChunkedStackLoader:
                 item = q.get()
                 if item is None:
                     return
-                if isinstance(item, BaseException):
+                if isinstance(item, Exception):
+                    # the exception object still carries the producer-
+                    # side traceback; raising appends the consumer frame
                     raise item
                 yield item
         finally:

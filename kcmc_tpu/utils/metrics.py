@@ -107,6 +107,56 @@ def crispness(stack: np.ndarray) -> float:
 
 
 @dataclasses.dataclass
+class RobustnessReport:
+    """Per-run recovery telemetry (utils/faults.py's consumer side).
+
+    Counts every rung of the degradation ladder a run touched: IO and
+    device retries, batches failed over to the numpy backend, frames
+    marked failed (and later rescued by `interpolate_failed` trajectory
+    interpolation), and checkpoint parts quarantined on resume.
+    Surfaced as ``CorrectionResult.timing["robustness"]`` (and from
+    there the CLI summary line and the ``--transforms`` npz), so an
+    unattended multi-hour run leaves an audit trail of everything it
+    survived.
+    """
+
+    io_retries: int = 0  # chunk-read attempts beyond the first
+    device_retries: int = 0  # device-batch attempts beyond the first
+    backend_failovers: int = 0  # batches re-run on the failover backend
+    failed_frame_indices: list = dataclasses.field(default_factory=list)
+    rescued_frames: int = 0  # failed frames trajectory-interpolated
+    quarantined_parts: list = dataclasses.field(default_factory=list)
+    faults_injected: int = 0  # faults a FaultPlan actually fired
+
+    @property
+    def failed_frames(self) -> int:
+        return len(self.failed_frame_indices)
+
+    def any(self) -> bool:
+        return bool(
+            self.io_retries
+            or self.device_retries
+            or self.backend_failovers
+            or self.failed_frame_indices
+            or self.rescued_frames
+            or self.quarantined_parts
+            or self.faults_injected
+        )
+
+    def as_dict(self) -> dict:
+        """JSON-serializable summary (the timing/CLI payload)."""
+        return {
+            "io_retries": int(self.io_retries),
+            "device_retries": int(self.device_retries),
+            "backend_failovers": int(self.backend_failovers),
+            "failed_frames": int(self.failed_frames),
+            "rescued_frames": int(self.rescued_frames),
+            "quarantined_parts": [str(p) for p in self.quarantined_parts],
+            "faults_injected": int(self.faults_injected),
+        }
+
+
+@dataclasses.dataclass
 class StageTimer:
     """Structured per-stage wall-clock timing (SURVEY.md §5).
 

@@ -1,0 +1,399 @@
+"""Chaos suite: deterministic fault injection, retry/backoff, and the
+graceful-degradation ladder (retry -> numpy failover -> mark-failed +
+interpolate_failed rescue), plus checkpoint corrupt-part quarantine."""
+
+import traceback
+import warnings
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.utils import synthetic
+from kcmc_tpu.utils.faults import (
+    FatalFaultError,
+    FaultPlan,
+    RetryPolicy,
+    TransientFaultError,
+    classify_transient,
+)
+from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+SHAPE = (96, 96)
+# near-zero backoff: chaos tests exercise the retry LOGIC, not the sleeps
+FAST_RETRY = dict(retry_backoff_s=1e-4, retry_backoff_max_s=2e-4)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return synthetic.make_drift_stack(
+        n_frames=12, shape=SHAPE, model="translation", max_drift=4.0, seed=7
+    )
+
+
+# -- spec grammar / plan mechanics ----------------------------------------
+
+
+def test_fault_spec_grammar():
+    plan = FaultPlan.from_spec(
+        "io_read:step=3:raise, device:step=7:transient:times=2, "
+        "checkpoint:corrupt_part=1"
+    )
+    io, dev, ck = plan.clauses
+    assert (io.surface, io.step, io.action, io.times) == ("io_read", 3, "fatal", 1)
+    assert (dev.surface, dev.step, dev.action, dev.times) == (
+        "device", 7, "transient", 2,
+    )
+    assert (ck.surface, ck.corrupt_part) == ("checkpoint", 1)
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault surface"):
+        FaultPlan.from_spec("gpu:step=1")
+    with pytest.raises(ValueError, match="unknown fault-clause key"):
+        FaultPlan.from_spec("device:wat=1")
+    with pytest.raises(ValueError, match="corrupt_part"):
+        FaultPlan.from_spec("checkpoint:step=1")
+    with pytest.raises(ValueError, match="checkpoint surface only"):
+        FaultPlan.from_spec("device:corrupt_part=0")
+    with pytest.raises(ValueError, match="no clauses"):
+        FaultPlan.from_spec("  ,  ")
+
+
+def test_fault_plan_step_and_times_semantics():
+    plan = FaultPlan.from_spec("device:step=1:times=2")
+    plan.maybe_fail("device", 0)  # wrong step: no fault
+    with pytest.raises(TransientFaultError):
+        plan.maybe_fail("device", 1)
+    with pytest.raises(TransientFaultError):
+        plan.maybe_fail("device", 1)
+    plan.maybe_fail("device", 1)  # clause spent after times=2 attempts
+    assert plan.injected == 2
+    assert [plan.op_index("device") for _ in range(3)] == [0, 1, 2]
+    assert plan.op_index("io_read") == 0  # per-surface counters
+
+
+def test_config_validates_fault_plan_eagerly():
+    with pytest.raises(ValueError, match="unknown fault surface"):
+        MotionCorrector(model="translation", fault_plan="nope:1")
+
+
+def test_classify_transient_split():
+    assert classify_transient(TransientFaultError("x"))
+    assert classify_transient(OSError("read failed"))
+    assert classify_transient(TimeoutError("slow nfs"))
+    assert classify_transient(ConnectionResetError("peer"))
+    assert not classify_transient(FatalFaultError("x"))
+    assert not classify_transient(ValueError("bad shape"))
+    assert not classify_transient(KeyboardInterrupt())
+    # permanent OS conditions are NOT retried: a deleted input or
+    # revoked credentials cannot be outlived by backoff
+    assert not classify_transient(FileNotFoundError("gone.tif"))
+    assert not classify_transient(PermissionError("revoked"))
+    assert not classify_transient(IsADirectoryError("dir"))
+
+    class FakeXla(Exception):
+        pass
+
+    # declared device types are transient only with a status marker
+    assert classify_transient(FakeXla("UNAVAILABLE: link down"), (FakeXla,))
+    assert classify_transient(FakeXla("RESOURCE_EXHAUSTED: hbm"), (FakeXla,))
+    assert not classify_transient(FakeXla("rank mismatch"), (FakeXla,))
+
+
+def test_retry_policy_backoff_bounds():
+    p = RetryPolicy(attempts=5, backoff_s=0.1, backoff_max_s=0.5,
+                    jitter=0.5, seed=1)
+    for k in range(6):
+        base = min(0.1 * 2.0 ** k, 0.5)
+        d = p.delay(k)
+        assert 0.5 * base <= d <= 1.5 * base
+    p0 = RetryPolicy(jitter=0.0, backoff_s=0.1, backoff_max_s=10.0)
+    assert p0.delay(0) == pytest.approx(0.1)
+    assert p0.delay(3) == pytest.approx(0.8)
+
+
+# -- device surface: retry / failover / mark-failed ladder -----------------
+
+
+@pytest.mark.slow
+def test_transient_device_fault_absorbed_bit_identical(data):
+    kw = dict(model="translation", backend="jax", batch_size=4, **FAST_RETRY)
+    clean = MotionCorrector(**kw).correct(data.stack)
+    mc = MotionCorrector(**kw, fault_plan="device:step=1:transient:times=2")
+    res = mc.correct(data.stack)
+    np.testing.assert_array_equal(res.transforms, clean.transforms)
+    np.testing.assert_array_equal(res.corrected, clean.corrected)
+    rb = res.robustness
+    assert rb["device_retries"] == 2
+    assert rb["faults_injected"] == 2
+    assert rb["backend_failovers"] == 0
+    assert rb["failed_frames"] == 0
+
+
+@pytest.mark.slow
+def test_device_fatal_fault_aborts(data):
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        fault_plan="device:step=0:fatal", **FAST_RETRY,
+    )
+    with pytest.raises(FatalFaultError):
+        mc.correct(data.stack)
+
+
+@pytest.mark.slow
+def test_permanent_device_failure_fails_over_to_numpy(data):
+    kw = dict(model="translation", backend="jax", batch_size=4, **FAST_RETRY)
+    mc = MotionCorrector(**kw, fault_plan="device:step=1:always:transient")
+    with pytest.warns(RuntimeWarning, match="failover backend"):
+        res = mc.correct(data.stack)
+    rb = res.robustness
+    assert rb["device_retries"] == 2  # retries exhausted first
+    assert rb["backend_failovers"] == 1
+    assert rb["failed_frames"] == 0
+    assert "frames_failed" not in res.diagnostics
+    # the failed-over batch still registers (numpy = same algorithm)
+    rmse = transform_rmse(
+        res.transforms, relative_transforms(data.transforms), SHAPE
+    )
+    assert rmse < 0.6
+    assert np.isfinite(res.corrected).all()
+
+
+@pytest.mark.slow
+def test_exhausted_ladder_marks_frames_failed_and_rescues(data):
+    kw = dict(model="translation", backend="jax", batch_size=4, **FAST_RETRY)
+    clean = MotionCorrector(**kw).correct(data.stack)
+    mc = MotionCorrector(
+        **kw,
+        fault_plan="device:step=1:always:transient, failover:always:transient",
+    )
+    with pytest.warns(RuntimeWarning, match="marking its"):
+        res = mc.correct(data.stack)
+    rb = res.robustness
+    assert rb["failed_frames"] == 4
+    assert rb["rescued_frames"] == 4
+    mask = res.diagnostics["frames_failed"]
+    assert mask.shape == (12,)
+    assert mask[4:8].all() and mask.sum() == 4
+    assert (np.asarray(res.diagnostics["n_inliers"])[4:8] == 0).all()
+    # failed frames were never registered: warp_ok stays False (rolling
+    # templates must not blend them) and they are not "rescued" frames
+    assert not res.diagnostics["warp_ok"][4:8].any()
+    assert res.diagnostics["warp_ok"][~mask].all()
+    assert not res.diagnostics["warp_rescued"].any()
+    # good frames are untouched bit-for-bit; failed frames' transforms
+    # are trajectory-interpolated (finite, near the drift path)
+    np.testing.assert_array_equal(res.transforms[~mask], clean.transforms[~mask])
+    assert np.isfinite(res.transforms).all()
+    rmse = transform_rmse(
+        res.transforms, relative_transforms(data.transforms), SHAPE
+    )
+    assert rmse < 3.0  # interpolation across the gap stays near the walk
+
+
+@pytest.mark.slow
+def test_retry_disabled_transient_raises(data):
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        retry_attempts=1, failover_backend=None, degrade_mark_failed=False,
+        fault_plan="device:step=0:transient",
+    )
+    with pytest.raises(TransientFaultError):
+        mc.correct(data.stack)
+
+
+@pytest.mark.slow
+def test_env_var_activates_fault_plan(monkeypatch, data):
+    monkeypatch.setenv("KCMC_FAULT_PLAN", "device:step=0:fatal")
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=4)
+    with pytest.raises(FatalFaultError):
+        mc.correct(data.stack)
+
+
+@pytest.mark.slow
+def test_happy_path_reports_clean(data):
+    res = MotionCorrector(
+        model="translation", backend="jax", batch_size=4
+    ).correct(data.stack)
+    rb = res.robustness
+    assert rb is not None
+    assert rb["io_retries"] == 0
+    assert rb["device_retries"] == 0
+    assert rb["backend_failovers"] == 0
+    assert rb["failed_frames"] == 0
+    assert rb["quarantined_parts"] == []
+    assert "frames_failed" not in res.diagnostics
+
+
+# -- io_read surface -------------------------------------------------------
+
+
+def _write_tiff(tmp_path, data, name="in.tif"):
+    from kcmc_tpu.io.tiff import write_stack
+
+    path = tmp_path / name
+    write_stack(path, data.stack)
+    return path
+
+
+@pytest.mark.slow
+def test_io_read_fault_retried(tmp_path, data):
+    src = _write_tiff(tmp_path, data)
+    kw = dict(model="translation", backend="jax", batch_size=4, **FAST_RETRY)
+    clean = MotionCorrector(**kw).correct_file(str(src))
+    mc = MotionCorrector(**kw, fault_plan="io_read:step=0:transient:times=2")
+    res = mc.correct_file(str(src))
+    np.testing.assert_array_equal(res.transforms, clean.transforms)
+    assert res.robustness["io_retries"] == 2
+
+
+@pytest.mark.slow
+def test_io_read_fatal_fault_aborts(tmp_path, data):
+    src = _write_tiff(tmp_path, data)
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        fault_plan="io_read:step=0:raise", **FAST_RETRY,
+    )
+    with pytest.raises(FatalFaultError):
+        mc.correct_file(str(src))
+
+
+def test_loader_decode_error_keeps_producer_traceback():
+    from kcmc_tpu.io import ChunkedStackLoader
+
+    class BadSource:
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, s):
+            raise ValueError("decode exploded")
+
+    with pytest.raises(ValueError, match="decode exploded") as ei:
+        list(iter(ChunkedStackLoader(BadSource(), chunk_size=2)))
+    names = [
+        f.name for f in traceback.extract_tb(ei.value.__traceback__)
+    ]
+    assert "_read_raw" in names  # producer-side frames preserved
+
+
+# -- checkpoint surface ----------------------------------------------------
+
+
+def test_corrupt_checkpoint_meta_warns_quarantines_restarts(tmp_path):
+    from kcmc_tpu.utils.checkpoint import load_stream_checkpoint
+
+    p = tmp_path / "run.ckpt.npz"
+    p.write_bytes(b"definitely not an npz")
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        assert load_stream_checkpoint(str(p)) is None
+    assert (tmp_path / "run.ckpt.npz.corrupt").exists()
+    assert not p.exists()
+    # absent checkpoint stays silent (fresh run, nothing to report)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert load_stream_checkpoint(str(tmp_path / "absent.npz")) is None
+
+
+@pytest.mark.slow
+def test_checkpoint_corrupt_part_quarantined_and_resumed(tmp_path):
+    """A resume over a checkpoint with one corrupted part must
+    quarantine the part, rewind to the last good chunk, recompute only
+    the lost frames, and end byte-identical to the uninterrupted run."""
+    from kcmc_tpu.io.tiff import write_stack
+
+    data = synthetic.make_drift_stack(
+        n_frames=32, shape=SHAPE, model="translation", max_drift=4.0, seed=11
+    )
+    u16 = np.clip(data.stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, u16)
+    out = tmp_path / "out.tif"
+    ckpt = tmp_path / "run.ckpt.npz"
+    kw = dict(model="translation", backend="jax", batch_size=4, **FAST_RETRY)
+
+    res_a = MotionCorrector(**kw).correct_file(
+        str(src), output=str(out), checkpoint=str(ckpt),
+        checkpoint_every=8, chunk_size=8,
+    )
+    clean_bytes = out.read_bytes()
+    part1 = tmp_path / "run.ckpt.npz.part00001.npz"
+    assert part1.exists()  # saves at frames 8/16/24 + the final save
+
+    mc = MotionCorrector(**kw, fault_plan="checkpoint:corrupt_part=1")
+    with pytest.warns(RuntimeWarning, match="last good chunk"):
+        res_b = mc.correct_file(
+            str(src), output=str(out), checkpoint=str(ckpt),
+            checkpoint_every=8, chunk_size=8,
+        )
+    rb = res_b.robustness
+    assert len(rb["quarantined_parts"]) == 1
+    assert (tmp_path / "run.ckpt.npz.part00001.npz.corrupt").exists()
+    # rewound to the part-0 save point (frame 8), recomputed the rest
+    assert res_b.timing["restored_frames"] == 8
+    np.testing.assert_array_equal(res_b.transforms, res_a.transforms)
+    assert out.read_bytes() == clean_bytes
+
+
+@pytest.mark.slow
+def test_failed_frames_persist_across_checkpoint_resume(tmp_path, data):
+    """Frames the ladder marked failed before a kill must keep their
+    failed status (and the interpolate_failed rescue) when the run is
+    restored from the checkpoint."""
+    src = _write_tiff(tmp_path, data)
+    out = tmp_path / "out.tif"
+    ckpt = tmp_path / "run.ckpt.npz"
+    kw = dict(model="translation", backend="jax", batch_size=4, **FAST_RETRY)
+    args = dict(
+        output=str(out), checkpoint=str(ckpt), checkpoint_every=4,
+        chunk_size=4,
+    )
+    mc = MotionCorrector(
+        **kw,
+        fault_plan="device:step=1:always:transient, failover:always:transient",
+    )
+    with pytest.warns(RuntimeWarning, match="marking its"):
+        res1 = mc.correct_file(str(src), **args)
+    assert res1.robustness["failed_frames"] == 4
+
+    # Rerun with identical arguments and no faults: everything restores
+    # from the checkpoint, and the failed-frame record must survive.
+    res2 = MotionCorrector(**kw).correct_file(str(src), **args)
+    assert res2.timing["restored_frames"] == 12
+    assert res2.robustness["failed_frames"] == 4
+    assert res2.robustness["rescued_frames"] == 4
+    np.testing.assert_array_equal(
+        res2.diagnostics["frames_failed"], res1.diagnostics["frames_failed"]
+    )
+    np.testing.assert_array_equal(res2.transforms, res1.transforms)
+
+
+@pytest.mark.slow
+def test_cli_inject_faults_reports_robustness(tmp_path, data):
+    import json
+    import subprocess
+    import sys
+
+    src = _write_tiff(tmp_path, data)
+    tpath = tmp_path / "t.npz"
+    env_script = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import warnings; warnings.simplefilter('ignore');"
+        "import kcmc_tpu.__main__ as m; import sys; sys.exit(m.main(%r))"
+    )
+    args = [
+        "correct", str(src), "--transforms", str(tpath),
+        "--model", "translation", "--batch-size", "4",
+        "--inject-faults", "device:step=1:transient:times=1",
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-c", env_script % (args,)],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["robustness"]["device_retries"] == 1
+    assert summary["robustness"]["faults_injected"] == 1
+    saved = np.load(tpath)
+    rb = json.loads(str(saved["robustness"]))
+    assert rb["device_retries"] == 1

@@ -178,6 +178,50 @@ def test_interpolate_failed_validation():
     )
 
 
+def test_interpolate_failed_single_survivor():
+    """One good frame: every failed frame copies it (np.interp clamps
+    to the lone sample on both sides) — finite, never identity."""
+    from kcmc_tpu import interpolate_failed
+
+    T = 7
+    Ms = np.stack([_translation(3.0 * t, t) for t in range(T)])
+    good = np.zeros(T, bool)
+    good[3] = True
+    bad = Ms.copy()
+    bad[~good] = np.eye(3)
+    fixed = interpolate_failed(bad, good)
+    assert np.isfinite(fixed).all()
+    for t in range(T):
+        np.testing.assert_allclose(fixed[t], Ms[3])
+    # survivor passes through bit-unchanged
+    np.testing.assert_array_equal(fixed[3], bad[3])
+
+
+def test_interpolate_failed_ends_and_interior_homography():
+    """Failed runs at BOTH ends plus an interior gap, projective
+    family: output stays finite, renormalized (M[2,2] == 1), ends copy
+    the nearest good frame, and dtype is preserved."""
+    from kcmc_tpu import interpolate_failed
+
+    T = 9
+    Ms = np.stack(
+        [_translation(1.5 * t, -0.5 * t) for t in range(T)]
+    ).astype(np.float32)
+    Ms[:, 2, 0] = 1e-4  # mild projective row
+    good = np.ones(T, bool)
+    good[[0, 1, 4, 7, 8]] = False
+    bad = Ms.copy()
+    bad[~good] = np.eye(3, dtype=np.float32)
+    fixed = interpolate_failed(bad, good)
+    assert fixed.dtype == np.float32
+    assert np.isfinite(fixed).all()
+    np.testing.assert_allclose(fixed[:, 2, 2], 1.0, atol=1e-7)
+    np.testing.assert_allclose(fixed[0], fixed[1], atol=1e-6)
+    np.testing.assert_allclose(fixed[0], Ms[2], atol=1e-3)
+    np.testing.assert_allclose(fixed[8], Ms[6], atol=1e-3)
+    np.testing.assert_allclose(fixed[4], Ms[4], atol=1e-3)  # interior gap
+
+
 def test_interpolate_failed_pipeline_recipe():
     """The documented repair: a blank (artifact) frame mid-drift gets
     its motion back from the neighbors instead of identity."""
