@@ -197,6 +197,51 @@ def run_bench_host(
     return {"fps": n_frames / dt, "seconds": dt, "rmse_px": rmse, "n_frames": n_frames}
 
 
+def run_bench_streaming(
+    n_frames: int, size: int, batch: int, **mc_overrides,
+) -> dict:
+    """The zero-stall streaming path: `correct_file` over an in-memory
+    source with ROLLING template updates and TIFF writeback — exercises
+    the prefetch thread, the dispatch-ahead window, device-resident
+    template updates at segment boundaries, and the bounded background
+    writer, and reports the per-seam stall accounting alongside fps so
+    a pipeline regression is attributable (docs/PERFORMANCE.md,
+    "Streaming pipeline anatomy")."""
+    import tempfile
+
+    from kcmc_tpu import MotionCorrector
+
+    data = _build_stack(n_frames, size, "translation")
+    base = len(data.stack)
+    reps = (n_frames + base - 1) // base
+    stack = np.tile(data.stack, (reps, 1, 1))[:n_frames]
+    stack = np.clip(stack * 40000, 0, 65535).astype(np.uint16)
+    E = max(2 * batch, n_frames // 8)
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=batch,
+        template_update_every=E, template_window=min(batch, E),
+        **mc_overrides,
+    )
+    mc.correct(stack[: batch * 2])  # warmup/compile outside the timing
+    with tempfile.TemporaryDirectory() as td:
+        t0 = time.perf_counter()
+        res = mc.correct_file(
+            stack, output=f"{td}/corrected.tif", output_dtype="input"
+        )
+        dt = time.perf_counter() - t0
+    return {
+        "fps": n_frames / dt,
+        "seconds": dt,
+        "rmse_px": _rmse(data, "translation", res.transforms, None),
+        "n_frames": n_frames,
+        "stalls_s": {
+            k: round(v, 4)
+            for k, v in res.timing.get("stalls_s", {}).items()
+        },
+        "pipeline": res.timing.get("pipeline"),
+    }
+
+
 def _run_with_retry(run, *args, **kw):
     """This image's tunneled TPU occasionally drops a remote_compile
     mid-flight; that is infrastructure, not a benchmark failure — one
@@ -239,7 +284,25 @@ def main() -> None:
         "--stages", action="store_true",
         help="also print the per-stage incremental cost breakdown (stderr)",
     )
+    ap.add_argument(
+        "--streaming", action="store_true",
+        help="also time the zero-stall streaming config (correct_file, "
+        "rolling template updates, background writeback) and report its "
+        "per-seam stall accounting",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="tiny CPU-friendly run (64 frames @ 64², flagship + "
+        "streaming rows only) — the CI guard for the throughput path; "
+        "NOT a performance measurement",
+    )
     args = ap.parse_args()
+    if args.smoke:
+        args.frames = min(args.frames, 64)
+        args.size = min(args.size, 64)
+        args.batch = min(args.batch, 16)
+        args.flagship_only = True
+        args.streaming = True
 
     import jax
 
@@ -334,6 +397,22 @@ def main() -> None:
         print(
             f"[bench] rigid3d (32x{args.size // 2}x{args.size // 2}): "
             f"{rr['fps']:.1f} vol/s, rmse {rr['rmse_px']:.3f} px",
+            file=sys.stderr,
+        )
+
+    if args.streaming:
+        rs = _run_with_retry(
+            run_bench_streaming, args.frames, args.size, args.batch
+        )
+        configs = dict(configs or {})
+        configs["streaming_rolling"] = dict(
+            _config_row(rs), stalls_s=rs["stalls_s"], pipeline=rs["pipeline"]
+        )
+        print(
+            f"[bench] streaming_rolling {args.size}x{args.size}: "
+            f"{rs['fps']:.1f} fps, rmse {rs['rmse_px']:.3f} px, "
+            f"stalls {json.dumps(rs['stalls_s'])}, "
+            f"pipeline {json.dumps(rs['pipeline'])}",
             file=sys.stderr,
         )
 

@@ -72,6 +72,8 @@ def _parse_reference_and_overrides(args):
         overrides["transform_polish"] = args.transform_polish
     if getattr(args, "inject_faults", ""):
         overrides["fault_plan"] = args.inject_faults
+    if getattr(args, "writer_depth", -1) >= 0:
+        overrides["writer_depth"] = args.writer_depth
     return ref, overrides
 
 
@@ -147,6 +149,15 @@ def _cmd_correct(args) -> int:
         )
     if res.timing.get("warp_escalated"):
         summary["warp_escalated"] = True
+    # Pipeline-stall accounting: seconds the streaming consumer spent
+    # blocked on each seam that should overlap (prefetch, drain device
+    # sync, writer backpressure/flush, template updates) — the
+    # throughput-debugging view of a run (docs/PERFORMANCE.md).
+    stalls = res.timing.get("stalls_s")
+    if stalls:
+        summary["stalls_s"] = {k: round(v, 3) for k, v in stalls.items()}
+    if res.timing.get("pipeline"):
+        summary["pipeline"] = res.timing["pipeline"]
     rb = res.robustness
     if rb is not None and any(rb.values()):
         # only when something actually happened: retries, failovers,
@@ -371,6 +382,12 @@ def main(argv=None) -> int:
     p.add_argument("--compression", default="none",
                    choices=["none", "deflate", "packbits"])
     p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument(
+        "--writer-depth", type=int, default=-1,
+        help="background-writeback queue depth in batches (default 2: "
+        "output encode+write overlaps device dispatch; 0 = synchronous "
+        "writes). Blocked-queue time shows as stalls_s.writer_backpressure",
+    )
     p.add_argument(
         "--output-dtype", default="input",
         help="corrected-frame dtype: 'input' (match source, default), "

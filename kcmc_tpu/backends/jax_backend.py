@@ -80,6 +80,26 @@ def _sanitize_nonfinite(frames: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(finite, frames, mean)
 
 
+@jax.jit
+def _blend_template(
+    ref_frame: jnp.ndarray,
+    frames: jnp.ndarray,
+    ok: jnp.ndarray,
+    alpha: jnp.ndarray,
+) -> jnp.ndarray:
+    """Rolling-template blend ON DEVICE: (1 - alpha) * template + alpha *
+    mean of the window's successfully-warped frames (the corrector's
+    `_rolled_template` math; masked-sum formulation so the program is
+    shape-static). An all-out-of-bounds window keeps the template
+    unchanged, exactly like the host path."""
+    okf = ok.astype(jnp.float32)
+    n = jnp.sum(okf)
+    w = okf.reshape((-1,) + (1,) * (frames.ndim - 1))
+    mean = jnp.sum(frames * w, axis=0) / jnp.maximum(n, 1.0)
+    blended = (1.0 - alpha) * ref_frame + alpha * mean
+    return jnp.where(n > 0.0, blended, ref_frame)
+
+
 @functools.partial(jax.jit, static_argnames=("shape",))
 def _coverage_matrix(transforms: jnp.ndarray, shape) -> jnp.ndarray:
     from kcmc_tpu.ops.warp import coverage_mask
@@ -218,6 +238,44 @@ class JaxBackend:
         )
         desc = describe_keypoints_3d(frame, kps, blur_sigma=cfg.blur_sigma)
         return {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
+
+    def update_reference(
+        self, ref: dict, tail_corrected, tail_ok, window: int, alpha: float
+    ) -> dict:
+        """Device-resident rolling-template update (the zero-stall seam).
+
+        `tail_corrected` / `tail_ok`: per-batch corrected-frame and
+        warp_ok arrays (device jax.Arrays straight from in-flight batch
+        outputs, or host arrays) whose concatenation covers AT LEAST
+        the last `window` frames — only the trailing `window` frames
+        are blended, frame-exactly. Returns the newly prepared
+        reference dict; the blended template rides in ``ref["frame"]``.
+
+        Nothing here synchronizes the device stream or touches the
+        host: the blend is one jitted program over arrays that may
+        still be executing asynchronously, and the descriptor
+        re-extraction reuses `prepare_reference`'s jitted pipeline on
+        the device-resident result. Bit-compatibility note: frames the
+        bounded warp kernels flagged (warp_ok False) are EXCLUDED from
+        the blend here, where the host path blends their per-frame
+        exact-warp rescue — identical whenever no frame exceeds the
+        warp bounds (the steady-state regime this path exists for).
+        """
+        if not tail_corrected:
+            return ref
+        frames = jnp.concatenate(
+            [jnp.asarray(c, jnp.float32) for c in tail_corrected]
+        )[-window:]
+        ok = jnp.concatenate(
+            [jnp.asarray(k).astype(bool) for k in tail_ok]
+        )[-window:]
+        new_frame = _blend_template(
+            jnp.asarray(ref["frame"], jnp.float32),
+            frames,
+            ok,
+            jnp.float32(alpha),
+        )
+        return self.prepare_reference(new_frame)
 
     # -- batch processing --------------------------------------------------
 

@@ -336,6 +336,29 @@ class NumpyBackend:
         xy, score, valid, desc = self._detect_describe_2d(ref_frame)
         return {"xy": xy, "desc": desc, "valid": valid, "frame": ref_frame}
 
+    def update_reference(
+        self, ref: dict, tail_corrected, tail_ok, window: int, alpha: float
+    ) -> dict:
+        """Host-side mirror of the jax backend's device-resident
+        rolling-template seam: same signature, same frame-exact window
+        semantics, and BIT-IDENTICAL blend math to the corrector's
+        legacy `_rolled_template` path (np.mean over the ok-masked
+        window, then the (1-alpha)/alpha blend in the same order)."""
+        if not tail_corrected:
+            return ref
+        frames = np.concatenate(
+            [np.asarray(c, np.float32) for c in tail_corrected]
+        )[-window:]
+        ok = np.concatenate([np.asarray(k, bool) for k in tail_ok])[-window:]
+        frames = frames[ok]
+        if len(frames) == 0:  # every frame out of warp bounds: keep ref
+            return ref
+        mean = np.mean(frames, axis=0, dtype=np.float32)
+        new_frame = (1.0 - alpha) * np.asarray(
+            ref["frame"], np.float32
+        ) + alpha * mean
+        return self.prepare_reference(new_frame)
+
     def process_batch(
         self, frames: np.ndarray, ref: dict, frame_indices: np.ndarray
     ) -> dict:

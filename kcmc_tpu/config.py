@@ -269,6 +269,28 @@ class CorrectorConfig:
 
     # -- execution ---------------------------------------------------------
     batch_size: int = 32  # frames per jitted device step
+    # Bounded background writeback queue depth for file-streaming runs
+    # (correct_file with output=): TIFF/Zarr/HDF5 encode+write runs on a
+    # writer thread up to this many batches behind the consumer, so
+    # output IO overlaps device dispatch instead of serializing with it.
+    # Appends stay ordered, writer exceptions surface on the consumer,
+    # and checkpoint saves flush to the writer's durable high-water mark
+    # first (resume semantics are byte-identical to synchronous writes).
+    # 0 = synchronous writes (the pre-round-6 behavior). Time blocked on
+    # a full queue is reported as the `writer_backpressure` stall.
+    writer_depth: int = 2
+    # Device-resident rolling-template updates (template_update_every):
+    # when the backend implements the `update_reference` seam, segment
+    # boundaries blend the averaging window into the template and
+    # re-extract reference descriptors ON DEVICE — one small jitted
+    # program instead of draining the in-flight pipeline and round-
+    # tripping the template through host numpy. Results match the host
+    # path within float32 reduction-order tolerance, with one
+    # documented semantic difference: frames a bounded warp kernel
+    # flagged (warp_ok False) are EXCLUDED from the device blend, where
+    # the host path blends their per-frame exact-warp rescue instead.
+    # False = always use the host blend path.
+    device_templates: bool = True
     # Warp kernel selection: "jnp" = XLA gather warp (all models, exact,
     # slow on TPU); "pallas" = gather-free Pallas kernel (translation
     # only); "separable" = gather-free shear/scale multi-pass (affine
@@ -450,6 +472,11 @@ class CorrectorConfig:
             raise ValueError(
                 "rescue_warn_fraction must be in (0, 1], got "
                 f"{self.rescue_warn_fraction}"
+            )
+        if self.writer_depth < 0:
+            raise ValueError(
+                f"writer_depth must be >= 0 batches (0 = synchronous "
+                f"writes), got {self.writer_depth}"
             )
         if self.warp not in ("auto", "jnp", "pallas", "separable", "matrix"):
             raise ValueError(

@@ -22,15 +22,20 @@ from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.utils.metrics import StageTimer
 
 
-# Config fields that shape failure recovery but never the happy-path
-# results; pinned to their defaults inside the checkpoint resume
-# signature so changing them between runs doesn't invalidate a resume.
+# Config fields that shape failure recovery or IO scheduling but never
+# the happy-path results; pinned to their defaults inside the checkpoint
+# resume signature so changing them between runs doesn't invalidate a
+# resume. (`writer_depth` only reorders WHEN bytes hit disk, never which
+# bytes — checkpoints flush to the durable mark first. `device_templates`
+# is deliberately NOT neutral: the device blend's reduction order differs
+# from the host path at float32 precision, so flipping it mid-run must
+# restart, not resume.)
 _ROBUSTNESS_SIG_NEUTRAL = {
     f: CorrectorConfig.__dataclass_fields__[f].default
     for f in (
         "fault_plan", "retry_attempts", "retry_backoff_s",
         "retry_backoff_max_s", "retry_jitter", "failover_backend",
-        "degrade_mark_failed",
+        "degrade_mark_failed", "writer_depth",
     )
 }
 
@@ -255,9 +260,12 @@ def apply_correction_file(
     n_threads: int = 0,
     progress: bool = False,
     reader_options: dict | None = None,
+    writer_depth: int = 2,
 ) -> None:
     """Streaming `apply_correction`: TIFF in, corrected TIFF out,
-    constant host memory.
+    constant host memory. `writer_depth` bounds the background
+    writeback queue (encode+write overlaps the resample of the next
+    chunk; 0 = synchronous writes).
 
     Completes the file-scale versions of the two-pass workflows:
 
@@ -294,6 +302,10 @@ def apply_correction_file(
             compression=compression,
             bigtiff=_wants_bigtiff(len(ts), ts.frame_shape, out_dt),
         )
+        if writer_depth > 0:
+            from kcmc_tpu.io.async_writer import AsyncBatchWriter
+
+            writer = AsyncBatchWriter(writer, depth=writer_depth)
         loader = ChunkedStackLoader(ts, chunk_size=chunk_size)
         chunks = iter(loader)  # background-threaded decode prefetch
         try:
@@ -565,6 +577,11 @@ class MotionCorrector:
             if template_window is not None
             else max(reference_window, 32)
         )
+        if self.template_window < 1:
+            raise ValueError(
+                f"template_window must be >= 1 frame, got "
+                f"{self.template_window}"
+            )
         if template_update_every < 0:
             raise ValueError(
                 f"template_update_every must be >= 0 frames, got "
@@ -989,6 +1006,44 @@ class MotionCorrector:
         a = self.template_update_alpha
         return (1.0 - a) * np.asarray(ref_frame, np.float32) + a * mean
 
+    def _make_dev_tail(self, window: int):
+        """(on_dispatched hook, tail list) for the device-resident
+        rolling-template path: the hook collects each dispatched batch's
+        still-async (n_valid, corrected, warp_ok) device refs, trimmed
+        at batch granularity to cover the last `window` frames (the
+        update seam slices frame-exactly). Shared by correct() and
+        correct_file() so the two copies cannot diverge."""
+        tail: list[tuple] = []
+
+        def on_dispatched(n, out, idx):
+            if "corrected" not in out:
+                return
+            tail.append((n, out["corrected"], out.get("warp_ok")))
+            while (
+                len(tail) > 1
+                and sum(t[0] for t in tail) - tail[0][0] >= window
+            ):
+                tail.pop(0)
+
+        return on_dispatched, tail
+
+    def _update_reference_device(self, ref: dict, dev_tail: list, window: int):
+        """One segment-boundary update through the backend's
+        update_reference seam (device path); consumes and clears the
+        collected tail. Returns the new prepared reference."""
+        ref = self.backend.update_reference(
+            ref,
+            [c[:n] for n, c, _ in dev_tail],
+            [
+                np.ones(n, bool) if k is None else k[:n]
+                for n, _, k in dev_tail
+            ],
+            window,
+            self.template_update_alpha,
+        )
+        dev_tail.clear()
+        return ref
+
     def _template_tail(self, outs: list[dict], window: int):
         """(corrected, warp_ok) arrays covering the last `window` frames
         recorded in `outs` (host or device arrays; converted by the
@@ -1104,10 +1159,14 @@ class MotionCorrector:
         )
 
         def drain(entry):
-            n, out, batch = entry
-            host = {k: convert(v)[:n] for k, v in out.items()}
+            n, out, batch, eref = entry
+            if device_outputs:
+                host = {k: convert(v)[:n] for k, v in out.items()}
+            else:
+                with timer.stall("drain_sync"):
+                    host = {k: convert(v)[:n] for k, v in out.items()}
             if do_rescue:
-                self._rescue_flagged(host, batch, n, ref)
+                self._rescue_flagged(host, batch, n, eref)
             outs.append(host)
 
         def batches(slo, shi):
@@ -1118,21 +1177,51 @@ class MotionCorrector:
                     print(f"[kcmc] frames {hi}/{T}", flush=True)
 
         segs = self._segment_bounds(start_frame, T)
+        # Device-resident rolling templates (the zero-stall path): with
+        # the backend's update_reference seam, segment boundaries blend
+        # the averaging window and re-extract reference descriptors on
+        # device from the STILL-IN-FLIGHT batch outputs — no pipeline
+        # flush, no host round trip. The tail window is collected at
+        # dispatch time (`on_dispatched`), trimmed at batch granularity;
+        # the seam slices frame-exactly.
+        dev_tmpl = (
+            len(segs) > 1
+            and cfg.device_templates
+            and hasattr(self.backend, "update_reference")
+        )
+        state = self._new_dispatch_state()
+        E = self.template_update_every
+        on_dispatched, dev_tail = self._make_dev_tail(
+            min(self.template_window, E) if E > 0 else 0
+        )
+        n_updates = 0
         with timer.stage("register_batches"):
             for si, (slo, shi) in enumerate(segs):
+                last = si == len(segs) - 1
                 self._dispatch_batches(
                     batches(slo, shi), ref, drain,
                     to_host=not device_outputs,
                     keep_frames=do_rescue, cast_dtype=cast,
                     reset_telemetry=si == 0,
+                    state=state, flush=last or not dev_tmpl,
+                    on_dispatched=on_dispatched if dev_tmpl else None,
+                    timer=timer,
                 )
-                if si < len(segs) - 1:  # rolling template update
+                if not last:  # rolling template update
                     W = min(self.template_window, shi - slo)
-                    tail_c, tail_ok = self._template_tail(outs, W)
-                    ref_frame = self._rolled_template(
-                        ref_frame, tail_c, tail_ok, W
-                    )
-                    ref = self.backend.prepare_reference(ref_frame)
+                    n_updates += 1
+                    with timer.stall("template_update"):
+                        if dev_tmpl:
+                            ref = self._update_reference_device(
+                                ref, dev_tail, W
+                            )
+                            ref_frame = ref["frame"]
+                        else:
+                            tail_c, tail_ok = self._template_tail(outs, W)
+                            ref_frame = self._rolled_template(
+                                ref_frame, tail_c, tail_ok, W
+                            )
+                            ref = self.backend.prepare_reference(ref_frame)
 
         if device_outputs:
             import jax.numpy as jnp
@@ -1152,6 +1241,11 @@ class MotionCorrector:
         fields = merged.pop("field", None)
         timing = timer.report(n_frames=len(indices))
         timing["warp_escalated"] = self._escalated
+        timing["pipeline"] = {
+            "drain_flushes": state["flushes"],
+            "template_updates": n_updates,
+            "device_templates": bool(dev_tmpl),
+        }
         transforms = self._finalize_robustness(
             merged, transforms, start_frame, T - start_frame, timing,
             host=not device_outputs,
@@ -1198,10 +1292,25 @@ class MotionCorrector:
             idx = np.concatenate([idx, np.repeat(idx[-1:], pad)])
         return n, batch, idx
 
+    def _new_dispatch_state(self) -> dict:
+        """Fresh cross-call dispatch-pipeline state: the in-flight batch
+        window, per-backend capability caches, and flush telemetry.
+        Segmented runs (rolling template updates) pass ONE state through
+        every `_dispatch_batches` call so the in-flight window survives
+        segment boundaries instead of draining at each one."""
+        return {
+            "inflight": [],  # queued async entries, oldest first
+            "accepts": {},  # per-backend kwarg support, inspected once
+            "native_ok": {},  # per-backend accepts_native_dtype flag
+            "flushes": 0,  # full-pipeline drains (stall telemetry)
+            "timer": None,  # StageTimer for drain-sync stall accounting
+        }
+
     def _dispatch_batches(
         self, batches, ref, drain, depth: int = 3, to_host=True,
         keep_frames=False, cast_dtype=None, allow_escalation=True,
-        emit_frames=True, reset_telemetry=True,
+        emit_frames=True, reset_telemetry=True, state=None, flush=True,
+        on_dispatched=None, timer=None,
     ):
         """Pipelined dispatch: keep `depth` batches in flight so the
         host->device upload of batch i+1, the compute of batch i, and
@@ -1209,11 +1318,24 @@ class MotionCorrector:
         process_batch_async seam; backends without it run synchronously).
 
         batches yields (n_valid, frames, indices); drain receives
-        (n_valid, output dict, frames) in order. `keep_frames` threads
+        (n_valid, output dict, frames, ref) in order — ref is the
+        reference the batch was DISPATCHED against, which matters for
+        segmented runs whose reference advances while old batches are
+        still in flight. `keep_frames` threads
         the input frames through to drain (the exact-warp rescue needs
         them); off, drain gets None and in-flight batches don't pin
         ~depth extra batch arrays alive. `to_host=False` skips the
         eager device->host copies (device-resident output pipelines).
+
+        `state` (from `_new_dispatch_state`) carries the in-flight
+        window across calls; with `flush=False` the call returns with
+        batches still in flight (the zero-stall segment-boundary path —
+        the caller flushes via a final flush=True call). `on_dispatched`
+        is invoked as (n_valid, output dict, indices) right after each
+        batch's dispatch, BEFORE any drain — the device-resident
+        rolling-template path collects its averaging window from the
+        still-async device outputs here. `timer` (a StageTimer) records
+        drain-side device-sync stalls.
 
         The out-of-bound telemetry (`_maybe_escalate`) can flip the
         run to the unbounded-warp backend mid-stream: the backend is
@@ -1245,9 +1367,13 @@ class MotionCorrector:
             self._escalated = False
             self._escalation_allowed = allow_escalation
             self._rescue_warned = False
-        inflight: list[tuple] = []
-        accepts_cast: dict = {}  # per-backend kwarg support, inspected once
-        native_ok: dict[int, bool] = {}
+        if state is None:
+            state = self._new_dispatch_state()
+        if timer is not None:
+            state["timer"] = timer
+        inflight: list[tuple] = state["inflight"]
+        accepts_cast: dict = state["accepts"]
+        native_ok: dict[int, bool] = state["native_ok"]
         plan = self._fault_plan
         # The ladder can only re-attempt a drained batch when host
         # outputs are requested and the retry machinery is armed — and
@@ -1261,8 +1387,10 @@ class MotionCorrector:
         )
 
         def flush_inflight():
+            if inflight:
+                state["flushes"] += 1
             while inflight:
-                self._drain_entry(inflight.pop(0), drain, ref, to_host)
+                self._drain_entry(inflight.pop(0), drain, to_host, state)
 
         for n, batch, idx in batches:
             backend = (
@@ -1317,8 +1445,15 @@ class MotionCorrector:
                     e, backend, batch, ref, idx, kw, step, n,
                     emit_frames, cast_dtype,
                 )
-                drain((n, out, self._failed_kept(out, kept, failed)))
+                if on_dispatched is not None:
+                    on_dispatched(n, out, idx)
+                drain((n, out, self._failed_kept(out, kept, failed), ref))
                 continue
+            if on_dispatched is not None:
+                # pre-drop hook: the device-template tail needs the
+                # still-async "corrected" arrays even on spans whose
+                # drain never materializes them
+                on_dispatched(n, out, idx)
             if not emit_frames and "corrected" in out:
                 # backends without the emit_frames seam still drop
                 # the frames here (no D2H saving, same results)
@@ -1326,33 +1461,42 @@ class MotionCorrector:
             if dispatch is not None:
                 inflight.append(
                     (n, out, kept, batch if keep_for_ladder else None,
-                     idx, step, backend, kw, emit_frames, cast_dtype)
+                     idx, step, backend, kw, emit_frames, cast_dtype, ref)
                 )
                 if len(inflight) >= depth:
-                    self._drain_entry(inflight.pop(0), drain, ref, to_host)
+                    self._drain_entry(inflight.pop(0), drain, to_host, state)
             else:
                 if self._robust_active():
                     self._note_out_template(out)
-                drain((n, out, kept))
-        flush_inflight()
+                drain((n, out, kept, ref))
+        if flush:
+            flush_inflight()
 
-    def _drain_entry(self, entry, drain, ref, to_host) -> None:
+    def _drain_entry(self, entry, drain, to_host, state=None) -> None:
         """Drain one in-flight async batch. With the retry engine armed
         and host outputs requested, device arrays are materialized here
         first — this is where a deferred (async) device error surfaces,
         and it enters the same degradation ladder as a dispatch-time
-        failure."""
-        n, out, kept, batch, idx, step, backend, kw, emit2, cast2 = entry
+        failure. The reference is the one the batch was dispatched
+        against (carried in the entry), so ladder re-attempts of a
+        pre-boundary batch never re-register it against a template that
+        advanced while it was in flight."""
+        n, out, kept, batch, idx, step, backend, kw, emit2, cast2, ref = entry
         if self._robust_active() and to_host:
+            timer = state.get("timer") if state is not None else None
             try:
-                out = self._materialize_host(out)
+                if timer is not None:
+                    with timer.stall("drain_sync"):
+                        out = self._materialize_host(out)
+                else:
+                    out = self._materialize_host(out)
                 self._note_out_template(out)
             except Exception as e:
                 out, failed = self._ladder_batch(
                     e, backend, batch, ref, idx, kw, step, n, emit2, cast2
                 )
                 kept = self._failed_kept(out, kept, failed)
-        drain((n, out, kept))
+        drain((n, out, kept, ref))
 
     def _failed_kept(self, out: dict, kept, failed: bool):
         """Drain-side handling of a rung-3 (mark-failed) ladder result:
@@ -1734,6 +1878,14 @@ class MotionCorrector:
                     compression=compression,
                     bigtiff=_wants_bigtiff(len(ts), ts.frame_shape, out_dt),
                 )
+            if writer is not None and cfg.writer_depth > 0:
+                # Overlapped writeback: encode+write runs on a bounded
+                # background thread instead of serializing with device
+                # dispatch on the consumer; checkpoint saves flush to
+                # the durable high-water mark first (io/async_writer.py)
+                from kcmc_tpu.io.async_writer import AsyncBatchWriter
+
+                writer = AsyncBatchWriter(writer, depth=cfg.writer_depth)
             restored = start
 
             cursor = {
@@ -1745,6 +1897,19 @@ class MotionCorrector:
                 # rewind points corrupt-part quarantine resumes from
                 "history": part_history if checkpoint is not None else [],
             }
+
+            def _tmpl_at_cursor():
+                # The template governing a resume at cursor["done"]: the
+                # latest boundary update at or before it. With the
+                # zero-stall pipeline, boundary updates land while older
+                # batches are still draining, so the CURRENT template
+                # may already be one segment ahead of the drained
+                # cursor — pairing the cursor with it would make a
+                # resume re-register pre-boundary frames against the
+                # wrong template.
+                while len(tmpl_hist) > 1 and tmpl_hist[1][0] <= cursor["done"]:
+                    tmpl_hist.pop(0)
+                return tmpl_hist[0][1]
 
             def save_ckpt():
                 from kcmc_tpu.utils.checkpoint import save_stream_checkpoint
@@ -1765,7 +1930,7 @@ class MotionCorrector:
                     outs[cursor["seg_saved"] :],
                     cursor["part"],
                     arrays=(
-                        {"template": np.asarray(ref_frame, np.float32)}
+                        {"template": np.asarray(_tmpl_at_cursor(), np.float32)}
                         if self.template_update_every > 0
                         else None
                     ),
@@ -1781,15 +1946,39 @@ class MotionCorrector:
 
             E = self.template_update_every
             W_roll = min(self.template_window, E) if roll else 0
+            # Device-resident rolling templates (zero-stall path): the
+            # averaging window is collected at DISPATCH time from the
+            # still-async device outputs, and boundary updates run
+            # through the backend's update_reference seam without
+            # draining the in-flight window or touching host numpy.
+            dev_tmpl = (
+                roll
+                and cfg.device_templates
+                and hasattr(self.backend, "update_reference")
+            )
+            dp_state = self._new_dispatch_state()
+            on_dispatched, dev_tail = self._make_dev_tail(W_roll)
+            # (boundary frame, template) pairs: save_ckpt pairs the
+            # drained cursor with the template that governs it.
+            # Checkpoint-only state — un-checkpointed runs must not
+            # accumulate a template per boundary for the whole run.
+            tmpl_hist: list[tuple] = [(start, ref_frame)]
+            n_updates = 0
 
             def drain(entry):
-                n, out, batch = entry
-                host = {k: np.asarray(v)[:n] for k, v in out.items()}
+                n, out, batch, eref = entry
+                if dev_tmpl and writer is None and not emit_frames:
+                    # averaging-window span of a registration-only run:
+                    # the window feeds the DEVICE tail, so its frames
+                    # are never materialized on host at all
+                    out = {k: v for k, v in out.items() if k != "corrected"}
+                with timer.stall("drain_sync"):
+                    host = {k: np.asarray(v)[:n] for k, v in out.items()}
                 tail_src = host
                 if cfg.rescue_warp and batch is not None and emit_frames:
-                    self._rescue_flagged(host, batch, n, ref)
+                    self._rescue_flagged(host, batch, n, eref)
                 else:
-                    if cfg.rescue_warp and batch is not None:
+                    if cfg.rescue_warp and batch is not None and not dev_tmpl:
                         # Averaging-window span of a REGISTRATION-ONLY
                         # rolling run: the template must blend
                         # exact-warped pixels, but the run's host
@@ -1797,9 +1986,11 @@ class MotionCorrector:
                         # frame-free spans (no warp_rescued key, NaN
                         # QC) — rescue a scratch copy for the tail
                         # only. (_rescue_flagged replaces, never
-                        # mutates, the arrays it fixes.)
+                        # mutates, the arrays it fixes.) The device-
+                        # template path excludes flagged frames from
+                        # the blend instead — no host tail to rescue.
                         tail_src = dict(host)
-                        self._rescue_flagged(tail_src, batch, n, ref)
+                        self._rescue_flagged(tail_src, batch, n, eref)
                     if "template_corr" in host and "warp_ok" in host:
                         # Out-of-bound frames were never rescue-
                         # rewarped here, so their on-device
@@ -1811,7 +2002,7 @@ class MotionCorrector:
                             host["warp_ok"], host["template_corr"], np.nan
                         )
                 corrected = host.pop("corrected", None)
-                if roll and corrected is not None:
+                if roll and not dev_tmpl and corrected is not None:
                     # rolling-template window: PRE-cast float32 pixels
                     # (post-rescue), trimmed at batch granularity —
                     # _rolled_template slices frame-exactly.
@@ -1840,12 +2031,21 @@ class MotionCorrector:
                 # Rolling runs may save mid-segment only OUTSIDE the
                 # next boundary's averaging window — a resume landing
                 # inside the window could not rebuild the frames
-                # already written before the kill — and never AT the
-                # boundary itself (the segment loop saves there, after
-                # the template update; a drain-side save would pair the
-                # boundary cursor with the pre-update template).
-                # Boundary saves cover the rest.
-                safe = not roll or 0 < cursor["done"] % E <= E - W_roll
+                # already written before the kill. AT a boundary a
+                # drain-side save is valid exactly when the boundary's
+                # template update has been recorded (tmpl_hist carries
+                # it) — the zero-stall pipeline reaches boundary
+                # cursors only through drains, since it never flushes
+                # there; the host path's boundary saves still happen in
+                # the segment loop, after its flush.
+                done = cursor["done"]
+                boundary_ok = (
+                    roll
+                    and done > 0
+                    and done % E == 0
+                    and any(b == done for b, _ in tmpl_hist)
+                )
+                safe = not roll or boundary_ok or 0 < done % E <= E - W_roll
                 if (
                     safe
                     and checkpoint is not None
@@ -1895,7 +2095,11 @@ class MotionCorrector:
                         # each segment's averaging window to the host:
                         # the leading span stays frame-free, the
                         # trailing `template_window` frames feed the
-                        # update. The final segment has no update.
+                        # update. The final segment has no update. (On
+                        # the device-template path the window span's
+                        # frames feed the device tail and are dropped
+                        # pre-materialization in drain — the span split
+                        # is what makes the backend keep them at all.)
                         if roll and not emit_frames and not last_seg:
                             W = min(self.template_window, shi - slo)
                             spans = (
@@ -1905,18 +2109,25 @@ class MotionCorrector:
                             )
                         else:
                             spans = [(slo, shi, emit_frames)]
-                        for lo2, hi2, emit2 in spans:
+                        for spi, (lo2, hi2, emit2) in enumerate(spans):
                             loader = ChunkedStackLoader(
                                 ts, chunk_size=chunk, start=lo2, stop=hi2,
                                 fault_plan=self._fault_plan,
                                 retry=self._io_retry_policy,
                                 report=self._robustness,
+                                on_wait=lambda s: timer.add_stall(
+                                    "prefetch_wait", s
+                                ),
                             )
                             batch_gen = batches(loader)
                             try:
                                 self._dispatch_batches(
                                     batch_gen, ref, drain,
-                                    keep_frames=cfg.rescue_warp and emit2,
+                                    # device-template window spans pin
+                                    # no frames: their tail needs no
+                                    # host rescue
+                                    keep_frames=cfg.rescue_warp and emit2
+                                    and (emit_frames or not dev_tmpl),
                                     cast_dtype=cast, emit_frames=emit2,
                                     # checkpointed runs stay on one warp
                                     # kernel so a resume is byte-
@@ -1926,6 +2137,16 @@ class MotionCorrector:
                                     # level for in-bound frames)
                                     allow_escalation=checkpoint is None,
                                     reset_telemetry=first_span,
+                                    state=dp_state,
+                                    # zero-stall: segment boundaries
+                                    # keep the window in flight; only
+                                    # the very last span flushes
+                                    flush=not dev_tmpl
+                                    or (last_seg and spi == len(spans) - 1),
+                                    on_dispatched=(
+                                        on_dispatched if dev_tmpl else None
+                                    ),
+                                    timer=timer,
                                 )
                             finally:
                                 batch_gen.close()
@@ -1935,14 +2156,35 @@ class MotionCorrector:
                             # rolling template update at the boundary,
                             # then checkpoint (resume restores exactly
                             # this template at exactly this frame)
-                            ref_frame = self._rolled_template(
-                                ref_frame,
-                                [t["corrected"] for t in tail],
-                                [t["warp_ok"] for t in tail],
-                                min(self.template_window, shi - slo),
-                            )
-                            tail.clear()
-                            ref = self.backend.prepare_reference(ref_frame)
+                            W = min(self.template_window, shi - slo)
+                            n_updates += 1
+                            with timer.stall("template_update"):
+                                if dev_tmpl:
+                                    ref = self._update_reference_device(
+                                        ref, dev_tail, W
+                                    )
+                                    ref_frame = ref["frame"]
+                                else:
+                                    ref_frame = self._rolled_template(
+                                        ref_frame,
+                                        [t["corrected"] for t in tail],
+                                        [t["warp_ok"] for t in tail],
+                                        W,
+                                    )
+                                    tail.clear()
+                                    ref = self.backend.prepare_reference(
+                                        ref_frame
+                                    )
+                            if checkpoint is not None:
+                                tmpl_hist.append((shi, ref_frame))
+                                # trim entries the drain cursor has
+                                # passed — bounded by the in-flight
+                                # window, never by run length
+                                while (
+                                    len(tmpl_hist) > 1
+                                    and tmpl_hist[1][0] <= cursor["done"]
+                                ):
+                                    tmpl_hist.pop(0)
                             # Boundaries are always window-safe resume
                             # points (a resume replays the full
                             # averaging window before the next
@@ -1951,9 +2193,14 @@ class MotionCorrector:
                             # with small template_update_every an
                             # unconditional save would multiply
                             # checkpoint IO (and part files) far beyond
-                            # checkpoint_every.
+                            # checkpoint_every. The cursor==shi gate
+                            # holds exactly when the pipeline drained
+                            # to the boundary (always, for the host
+                            # path's flush; on the zero-stall path the
+                            # drain-side saves cover it instead).
                             if (
                                 checkpoint is not None
+                                and cursor["done"] == shi
                                 and cursor["done"] - cursor["saved"]
                                 >= checkpoint_every
                             ):
@@ -1978,10 +2225,22 @@ class MotionCorrector:
         corrected = merged.pop(
             "corrected", np.empty((0,) + ts.frame_shape, np.float32)
         )
+        if writer is not None and hasattr(writer, "stats"):
+            wst = writer.stats()
+            timer.add_stall(
+                "writer_backpressure", wst["backpressure_s"],
+                count=int(wst["batches"]),
+            )
+            timer.add_stall("writer_flush", wst["flush_s"])
         # fps over frames THIS run actually registered (restored frames
         # took no wall time here and would overstate throughput).
         timing = timer.report(n_frames=cursor["done"] - restored)
         timing["warp_escalated"] = self._escalated
+        timing["pipeline"] = {
+            "drain_flushes": dp_state["flushes"],
+            "template_updates": n_updates,
+            "device_templates": bool(dev_tmpl),
+        }
         if checkpoint is not None:
             timing["restored_frames"] = restored
         transforms = merged.pop("transform", None)

@@ -2,6 +2,7 @@
 streaming ingest (Zarr/HDF5/npy/raw/array via one reader protocol),
 and chunked prefetch loading."""
 
+from kcmc_tpu.io.async_writer import AsyncBatchWriter
 from kcmc_tpu.io.formats import (
     ArrayStack,
     HDF5Stack,
@@ -15,6 +16,7 @@ from kcmc_tpu.io.tiff import TiffStack, read_stack, write_stack
 
 __all__ = [
     "ArrayStack",
+    "AsyncBatchWriter",
     "ChunkedStackLoader",
     "HDF5Stack",
     "NpyStack",

@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -39,6 +40,10 @@ class ChunkedStackLoader:
     utils/metrics.RobustnessReport) — chunk reads are retried per the
     policy, injected faults fire per the plan, retries are counted in
     the report. All None by default: the bare loader reads exactly once.
+
+    on_wait: optional callback(seconds) invoked whenever the CONSUMER
+    blocks waiting for the prefetch thread — the pipeline-stall
+    telemetry hook (a well-fed pipeline never calls it).
     """
 
     def __init__(
@@ -52,6 +57,7 @@ class ChunkedStackLoader:
         fault_plan=None,
         retry=None,
         report=None,
+        on_wait=None,
     ):
         self._own = False
         if isinstance(source, (str, os.PathLike)):
@@ -68,6 +74,7 @@ class ChunkedStackLoader:
         self._fault_plan = fault_plan
         self._retry = retry
         self._report = report
+        self._on_wait = on_wait
 
     def _read_raw(self, lo: int, hi: int) -> np.ndarray:
         if hasattr(self.source, "read"):  # io.formats protocol readers
@@ -137,7 +144,13 @@ class ChunkedStackLoader:
         t.start()
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    if self._on_wait is not None:
+                        self._on_wait(time.perf_counter() - t0)
                 if item is None:
                     return
                 if isinstance(item, Exception):

@@ -231,6 +231,23 @@ def _solve_sym3(
     return _mm(adj, rhs) / jnp.where(ok, det, 1.0), ok
 
 
+def _normalized_spread_ok(sn, dn, w):
+    """Degenerate-sample detector shared by the affine/homography
+    solvers: Hartley conditioning maps a HEALTHY sample to ~unit RMS
+    radius, so its weighted spread is O(d * Σw) — while a duplicated/
+    coincident minimal sample has ~zero spread that the _EPS-clamped
+    normalization scale cannot restore. The ridge then makes the normal
+    system "well-conditioned relative to itself", sailing past the
+    RELATIVE det/pivot checks into a finite COLLAPSE map (everything ->
+    the dst centroid) — exactly what _guard exists to prevent, caught
+    here at the source. Both sides are checked: a spread src mapped to
+    a coincident dst is the same collapse from the other end."""
+    tot = jnp.maximum(jnp.sum(w), _EPS)
+    return (jnp.sum(w[:, None] * sn * sn) > 1e-6 * tot) & (
+        jnp.sum(w[:, None] * dn * dn) > 1e-6 * tot
+    )
+
+
 def _affine_normal_system(src, dst, w):
     Ts, _ = _normalization(src, w)
     Td, Td_inv = _normalization(dst, w)
@@ -241,7 +258,7 @@ def _affine_normal_system(src, dst, w):
     Aw = A * w[:, None]
     M33 = _mm(A.T, Aw) + _EPS * jnp.eye(3, dtype=src.dtype)
     rhs = _mm(Aw.T, dn)  # (3, 2)
-    return M33, rhs, Ts, Td_inv
+    return M33, rhs, Ts, Td_inv, _normalized_spread_ok(sn, dn, w)
 
 
 def _affine_from_P(P, Ts, Td_inv, ok):
@@ -252,10 +269,11 @@ def _affine_from_P(P, Ts, Td_inv, ok):
 def solve_affine(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     """Weighted least-squares 6-DoF affine via conditioned normal
     equations — the cheap hypothesis solver (closed-form Cramer)."""
-    M33, rhs, Ts, Td_inv = _affine_normal_system(src, dst, w)
+    M33, rhs, Ts, Td_inv, spread_ok = _affine_normal_system(src, dst, w)
     P, det_ok = _solve_sym3(M33, rhs)
     return _affine_from_P(
-        P.T, Ts, Td_inv, ok=det_ok & (jnp.sum(w) > _MIN_MASS)
+        P.T, Ts, Td_inv,
+        ok=det_ok & spread_ok & (jnp.sum(w) > _MIN_MASS),
     )
 
 
@@ -264,9 +282,11 @@ def solve_affine_accurate(
 ) -> jnp.ndarray:
     """LU-based affine solve: the model's refine_solve, used ~100x less
     often than the hypothesis solver (IRLS refinement + final polish)."""
-    M33, rhs, Ts, Td_inv = _affine_normal_system(src, dst, w)
+    M33, rhs, Ts, Td_inv, spread_ok = _affine_normal_system(src, dst, w)
     P = jnp.linalg.solve(M33, rhs).T
-    return _affine_from_P(P, Ts, Td_inv, ok=jnp.sum(w) > _MIN_MASS)
+    return _affine_from_P(
+        P, Ts, Td_inv, ok=spread_ok & (jnp.sum(w) > _MIN_MASS)
+    )
 
 
 def _homography_normal_system(src, dst, w):
@@ -285,7 +305,7 @@ def _homography_normal_system(src, dst, w):
     rows = jnp.concatenate([r1, r2], axis=0)  # (2N, 9)
     rw = jnp.concatenate([w, w], axis=0)
     ATA = _mm(rows.T, rows * rw[:, None])  # (9, 9)
-    return ATA, Ts, Td_inv
+    return ATA, Ts, Td_inv, _normalized_spread_ok(sn, dn, w)
 
 
 def _homography_from_h(h, Ts, Td_inv, w, ok=None):
@@ -347,11 +367,11 @@ def solve_homography(src: jnp.ndarray, dst: jnp.ndarray, w: jnp.ndarray) -> jnp.
     8x8 normal system with the unrolled Cholesky. Dramatically cheaper
     than the eigh null-vector route (and than a batched LU) when
     vmapped over frames x hypotheses."""
-    ATA, Ts, Td_inv = _homography_normal_system(src, dst, w)
+    ATA, Ts, Td_inv, spread_ok = _homography_normal_system(src, dst, w)
     A8 = ATA[:8, :8] + 1e-8 * jnp.eye(8, dtype=ATA.dtype)
     h8, ok = _cholesky_solve_unrolled(A8, -ATA[:8, 8], 8)
     h = jnp.concatenate([h8, jnp.ones((1,), ATA.dtype)])
-    return _homography_from_h(h, Ts, Td_inv, w, ok=ok)
+    return _homography_from_h(h, Ts, Td_inv, w, ok=ok & spread_ok)
 
 
 def solve_homography_accurate(
@@ -361,9 +381,9 @@ def solve_homography_accurate(
     matrix — the refinement/polish-stage solver (tens of calls per
     batch, where the extra accuracy over the inhomogeneous form matters
     and the eigh cost doesn't)."""
-    ATA, Ts, Td_inv = _homography_normal_system(src, dst, w)
+    ATA, Ts, Td_inv, spread_ok = _homography_normal_system(src, dst, w)
     _, evecs = jnp.linalg.eigh(ATA)
-    return _homography_from_h(evecs[:, 0], Ts, Td_inv, w)
+    return _homography_from_h(evecs[:, 0], Ts, Td_inv, w, ok=spread_ok)
 
 
 def _cross_covariance3(src, dst, w, with_norms: bool = False):

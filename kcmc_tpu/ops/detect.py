@@ -197,6 +197,7 @@ def _select_keypoints(
     threshold: float,
     border: int,
     cand_tile: int = CAND_TILE,
+    _force_general: bool = False,
 ) -> Keypoints:
     """Fixed-K keypoint selection from dense detection fields.
 
@@ -204,6 +205,9 @@ def _select_keypoints(
     elsewhere; ox_f/oy_f are the dense subpixel offset fields. Shared by
     the jnp path (`detect_keypoints`) and the fused Pallas path
     (ops/pallas_detect.py), which produce the same field triple.
+    `_force_general` routes tile-aligned geometry through the general
+    (pixel-masked) path anyway — the test seam that lets the fast-path
+    IDENTICAL-results claim below be asserted mechanically.
     """
     H, W = nms_resp.shape
     # Candidate reduction: strongest surviving pixel per TILE x TILE
@@ -221,7 +225,10 @@ def _select_keypoints(
     # interior keypoint). The interior global max is itself an NMS
     # local max, so masking nms_resp loses nothing.
     T = cand_tile
-    if border % T == 0 and H % T == 0 and W % T == 0:
+    if (
+        not _force_general
+        and border % T == 0 and H % T == 0 and W % T == 0
+    ):
         # Tile-aligned fast path (round 5): every tile is fully inside
         # or fully outside the border exclusion, so the border/peak/
         # threshold masking moves to the (H/T, W/T) TILE level and the

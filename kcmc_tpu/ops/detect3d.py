@@ -108,9 +108,13 @@ def _select_keypoints_3d(
     max_keypoints: int,
     threshold: float,
     border: int,
+    _force_general: bool = False,
 ) -> Keypoints:
     """Fixed-K selection from dense (resp, nms_resp) fields — shared by
-    the jnp path and the fused Pallas kernel (ops/pallas_detect3d.py)."""
+    the jnp path and the fused Pallas kernel (ops/pallas_detect3d.py).
+    `_force_general` is the test seam asserting the tile-aligned fast
+    path's results are identical to the general path's (ops/detect.py
+    has the same seam)."""
     D, H, W = resp.shape
     bz = min(border, max(1, D // 8))
     # Peak over the selectable region only — a constant background
@@ -125,7 +129,10 @@ def _select_keypoints_3d(
     # path (z tiles are single planes, so the z border masks exactly at
     # tile level regardless of alignment; y/x need border % T == 0).
     T = 8
-    if border % T == 0 and H % T == 0 and W % T == 0:
+    if (
+        not _force_general
+        and border % T == 0 and H % T == 0 and W % T == 0
+    ):
         tile_val, tile_arg = tile_max_argmax(nms_resp, T)  # (D, th, tw)
         th, tw = tile_val.shape[1:]
         tzs = jnp.arange(D)[:, None, None]

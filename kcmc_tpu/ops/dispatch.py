@@ -23,6 +23,14 @@ def stable_argsort_small_keys(keys, max_key: int):
     `max_key` is the largest key value possible (static), including any
     drop sentinel; the pack must fit int32, which this checks loudly at
     trace time instead of wrapping into silently corrupted order.
+    RUNTIME key values are clamped to [0, max_key] before packing: a
+    negative or too-large key (upstream arithmetic bug, corrupted
+    input) would otherwise shift into the index bits — or past the
+    int32 sign bit — and silently scramble the whole sort order, the
+    worst possible failure mode for a primitive every dispatch-shaped
+    stage shares. Clamped keys are still WRONG keys (negatives land in
+    group 0, oversized ones in max_key); the clamp only guarantees the
+    corruption stays local to the bad item.
     Returns (order, sorted_keys) like (argsort(keys), keys[order]).
     Shared by describe._aligned_runs, segment_by_key, and the describe
     back-map's inverse-permutation sort (which packs in uint32 for one
@@ -36,8 +44,9 @@ def stable_argsort_small_keys(keys, max_key: int):
             f"overflows int32 at N={N}; use a key-value argsort for "
             f"this scale"
         )
+    keys = jnp.clip(keys.astype(jnp.int32), 0, max_key)
     packed = jnp.sort(
-        (keys.astype(jnp.int32) << sh) | jnp.arange(N, dtype=jnp.int32)
+        (keys << sh) | jnp.arange(N, dtype=jnp.int32)
     )
     return packed & ((1 << sh) - 1), packed >> sh
 
@@ -45,8 +54,12 @@ def stable_argsort_small_keys(keys, max_key: int):
 def segment_by_key(keys, n_groups: int, cap: int):
     """Group items by integer key with fixed per-group capacity.
 
-    keys: (N,) int — group id per item; ids outside [0, n_groups) are
-    dropped (use n_groups as the drop sentinel). Returns
+    keys: (N,) int — group id per item, REQUIRED non-negative and
+    <= n_groups; ids outside [0, n_groups) are dropped (use n_groups as
+    the drop sentinel — never a negative). Runtime values beyond that
+    contract are clamped into it (stable_argsort_small_keys), so a
+    corrupted key cannot scramble other items' grouping: a negative id
+    joins group 0, an oversized one the drop sentinel. Returns
     (slot_idx (n_groups, cap) int32 — item index per slot — and
     slot_ok (n_groups, cap) bool). The argsort is stable, so items
     keep their original relative order within a group and overflow

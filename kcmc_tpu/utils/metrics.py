@@ -162,10 +162,24 @@ class StageTimer:
 
     Accumulates seconds per named stage across chunks; `report(n_frames)`
     yields the frames/sec/chip numbers the driver benchmarks.
+
+    Beyond the coarse stages, the timer carries *stall* accounting for
+    the streaming pipeline: time the CONSUMER thread spent blocked on a
+    seam that should overlap with device compute — waiting on the
+    prefetch thread (`prefetch_wait`), synchronizing device outputs at
+    drain (`drain_sync`), backpressured by the background writer
+    (`writer_backpressure`), flushing it for a checkpoint
+    (`writer_flush`), or updating the rolling template at a segment
+    boundary (`template_update`). Stalls are a subset of the stage time
+    (they happen *inside* register_batches), reported separately as
+    `stalls_s`/`stall_counts` so a throughput regression is attributable
+    to a specific pipeline seam instead of a single opaque total.
     """
 
     totals: dict = dataclasses.field(default_factory=dict)
     counts: dict = dataclasses.field(default_factory=dict)
+    stalls: dict = dataclasses.field(default_factory=dict)
+    stall_counts: dict = dataclasses.field(default_factory=dict)
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -177,6 +191,21 @@ class StageTimer:
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
 
+    @contextlib.contextmanager
+    def stall(self, name: str):
+        """Time one blocking wait on a pipeline seam (see class doc)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_stall(name, time.perf_counter() - t0)
+
+    def add_stall(self, name: str, seconds: float, count: int = 1) -> None:
+        """Accumulate stall seconds measured elsewhere (e.g. the
+        background writer's own backpressure counter)."""
+        self.stalls[name] = self.stalls.get(name, 0.0) + float(seconds)
+        self.stall_counts[name] = self.stall_counts.get(name, 0) + count
+
     @property
     def total_seconds(self) -> float:
         return sum(self.totals.values())
@@ -186,6 +215,9 @@ class StageTimer:
             "stages_s": dict(self.totals),
             "total_s": self.total_seconds,
         }
+        if self.stalls:
+            out["stalls_s"] = dict(self.stalls)
+            out["stall_counts"] = dict(self.stall_counts)
         if n_frames and self.total_seconds > 0:
             out["frames_per_sec"] = n_frames / self.total_seconds
         return out

@@ -160,3 +160,33 @@ def test_bins_first_route_matches_oracle_at_large_k():
     # random orientations at K=2048, cap=2x share => none expected
     dropped = (dn[valid] == 0).all(-1).sum()
     assert dropped == 0, f"{dropped} dropped descriptors"
+
+
+def test_backmap_scatter_matches_gather(rng):
+    """The sorted-layout back-map's two routes must agree exactly: the
+    packed-sort inverse-permutation GATHER (common K) and the drop-mode
+    word SCATTER it falls back to when K exceeds the lossless 32-bit
+    pack (> ~32768, where raising used to abandon the run entirely)."""
+    from kcmc_tpu.ops.describe import _backmap_words
+
+    B, K, NW, Kp = 3, 40, 4, 64
+    words = jnp.asarray(
+        rng.integers(0, 2**32, size=(B, Kp, NW), dtype=np.uint32)
+    )
+    src = np.full((B, Kp), K, np.int32)  # padding sentinel everywhere
+    for b in range(B):
+        pos = rng.choice(Kp, size=K, replace=False)
+        src[b, pos] = rng.permutation(K)
+    g = np.asarray(_backmap_words(words, jnp.asarray(src), K))
+    s = np.asarray(
+        _backmap_words(words, jnp.asarray(src), K, force_scatter=True)
+    )
+    assert g.shape == (B, K, NW)
+    np.testing.assert_array_equal(g, s)
+    # spot-check the permutation semantics directly
+    wnp = np.asarray(words)
+    for b in range(B):
+        for slot in range(Kp):
+            k = src[b, slot]
+            if k < K:
+                np.testing.assert_array_equal(g[b, k], wnp[b, slot])

@@ -1,0 +1,55 @@
+"""The shared fixed-capacity dispatch primitive (ops/dispatch.py):
+stability, and the runtime key clamp that keeps a corrupted key from
+scrambling the whole packed sort (ADVICE r5)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from kcmc_tpu.ops.dispatch import segment_by_key, stable_argsort_small_keys
+
+
+def test_stable_argsort_matches_numpy_stable(rng):
+    keys = rng.integers(0, 7, size=100).astype(np.int32)
+    order, sk = stable_argsort_small_keys(jnp.asarray(keys), 7)
+    np.testing.assert_array_equal(
+        np.asarray(order), np.argsort(keys, kind="stable")
+    )
+    np.testing.assert_array_equal(np.asarray(sk), np.sort(keys))
+
+
+def test_out_of_range_keys_clamp_instead_of_corrupting():
+    """A negative key would shift into the index bits (or the sign bit)
+    and scramble EVERY item's order; the clamp keeps the damage local —
+    the result is exactly the stable argsort of the clamped keys."""
+    keys = np.array([-5, 0, 3, 99, 2, -1, 3], np.int32)
+    order, sk = stable_argsort_small_keys(jnp.asarray(keys), 4)
+    clamped = np.clip(keys, 0, 4)
+    np.testing.assert_array_equal(
+        np.asarray(order), np.argsort(clamped, kind="stable")
+    )
+    np.testing.assert_array_equal(np.asarray(sk), np.sort(clamped))
+
+
+def test_segment_by_key_basic_grouping_and_overflow():
+    keys = np.array([1, 0, 1, 2, 1, 0, 1], np.int32)
+    idx, ok = segment_by_key(jnp.asarray(keys), 3, cap=3)
+    idx, ok = np.asarray(idx), np.asarray(ok)
+    np.testing.assert_array_equal(idx[0][ok[0]], [1, 5])
+    # stable within the group; overflow drops the LAST items
+    np.testing.assert_array_equal(idx[1][ok[1]], [0, 2, 4])
+    np.testing.assert_array_equal(idx[2][ok[2]], [3])
+
+
+def test_segment_by_key_out_of_range_ids_stay_local():
+    """ids > n_groups clamp to the drop sentinel; a negative id clamps
+    into group 0 (wrong for that item, documented) — but OTHER items'
+    grouping must be untouched either way."""
+    keys = np.array([1, -3, 0, 1, 7, 2, 1], np.int32)
+    idx, ok = segment_by_key(jnp.asarray(keys), 3, cap=4)
+    idx, ok = np.asarray(idx), np.asarray(ok)
+    np.testing.assert_array_equal(idx[1][ok[1]], [0, 3, 6])
+    np.testing.assert_array_equal(idx[2][ok[2]], [5])
+    # the -3 joins group 0 (clamped), the 7 is dropped entirely
+    np.testing.assert_array_equal(idx[0][ok[0]], [1, 2])
+    kept = np.concatenate([idx[g][ok[g]] for g in range(3)])
+    assert 4 not in kept

@@ -147,3 +147,43 @@ def test_score_cap_sparse_frame_still_recovers():
         - (corners @ M_true[:2, :2].T + M_true[:2, 2])
     ).max()
     assert err < 1.0, err
+
+
+def test_every_model_guards_degenerate_duplicated_samples():
+    """ADVICE r5: _sample_indices can return the SAME valid match
+    `min_samples` times (fewer valid matches than the minimal set), so
+    every solver carries a mechanical obligation — a duplicated-point
+    system must come back as the identity guard (a non-collapsing map),
+    never NaN and never a finite map that collapses the plane onto the
+    dst point (which would spuriously out-score honest hypotheses)."""
+    from kcmc_tpu.models import MODELS
+
+    for name, model in MODELS.items():
+        d = model.ndim
+        p = np.full((model.min_samples, d), 3.25, np.float32)
+        w = np.ones(model.min_samples, np.float32)
+        for label, dst in (("coincident", p), ("shifted", p + 2.0)):
+            for solver in (model.solve, model.resolved_refine_solve):
+                M = np.asarray(
+                    solver(jnp.asarray(p), jnp.asarray(dst), jnp.asarray(w))
+                )
+                assert np.isfinite(M).all(), (name, label)
+                lin = M[:d, :d]
+                det = float(np.linalg.det(lin))
+                assert abs(det) > 0.5, (name, label, M)
+                if label == "coincident":
+                    # no motion information at all: the guard identity
+                    np.testing.assert_allclose(
+                        M, np.eye(d + 1), atol=1e-5,
+                        err_msg=f"{name}/{label}",
+                    )
+                else:
+                    # a repeated point moved by a constant: identity
+                    # (the guard) or a pure shift (translation's — and
+                    # a centroid-matching rigid refine's — legitimate
+                    # exact fit) are both fine; what is FORBIDDEN is a
+                    # collapsing/shearing linear part
+                    np.testing.assert_allclose(
+                        lin @ lin.T, np.eye(d), atol=1e-3,
+                        err_msg=f"{name}/{label}",
+                    )

@@ -1,0 +1,339 @@
+"""Zero-stall streaming: device-resident rolling templates, overlapped
+writeback, and pipeline-stall telemetry (round 6).
+
+Contracts under test:
+
+* with a backend implementing the `update_reference` seam, segment
+  boundaries neither flush the in-flight dispatch window nor round-trip
+  the template through host numpy — `prepare_reference` (the host seam)
+  runs exactly once per run and the pipeline drains exactly once;
+* device-path rolling results match the legacy host blend path within
+  float32 reduction-order tolerance (bit-identical on the numpy
+  backend, whose seam mirrors the host math exactly);
+* output writeback runs on a bounded background thread: ordered,
+  backpressured, exception-surfacing, and checkpoint-synchronized
+  (kill/resume output stays byte-identical);
+* `timing` and the CLI summary report the per-seam stall accounting.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.io import AsyncBatchWriter, ChunkedStackLoader
+from kcmc_tpu.io.tiff import TiffWriter, write_stack
+from kcmc_tpu.utils import synthetic
+
+SHAPE = (64, 64)
+T = 32
+E = 8  # template_update_every
+
+
+@pytest.fixture(scope="module")
+def drifting():
+    rng = np.random.default_rng(7)
+    scene = synthetic.render_scene(rng, SHAPE, n_blobs=60)
+    drift = np.cumsum(rng.uniform(-0.8, 0.8, size=(T, 2)), axis=0)
+    mats = np.tile(np.eye(3, dtype=np.float32), (T, 1, 1))
+    mats[:, :2, 2] = drift
+    frames = [synthetic._warp_scene(scene, m) for m in mats]
+    return np.stack(frames).astype(np.float32), mats
+
+
+def mk(backend="jax", **kw):
+    return MotionCorrector(
+        model="translation", backend=backend, batch_size=4,
+        template_update_every=E, template_window=8, **kw,
+    )
+
+
+# -- device-resident rolling templates ----------------------------------
+
+
+def test_boundaries_skip_host_prepare_and_pipeline_flush(drifting):
+    """The zero-stall acceptance counters: ONE host prepare_reference
+    (the initial template), one update_reference per interior boundary,
+    ONE pipeline drain-flush for the whole run (the final one)."""
+    stack, _ = drifting
+    mc = mk()
+    host_prepares, updates = [], []
+    orig_prep = mc.backend.prepare_reference
+    orig_up = mc.backend.update_reference
+
+    def spy_prep(frame):
+        if isinstance(frame, np.ndarray):  # host template round trip
+            host_prepares.append(1)
+        return orig_prep(frame)
+
+    def spy_up(*a, **kw):
+        updates.append(1)
+        return orig_up(*a, **kw)
+
+    mc.backend.prepare_reference = spy_prep
+    mc.backend.update_reference = spy_up
+    res = mc.correct(stack)
+    assert len(host_prepares) == 1
+    assert len(updates) == T // E - 1
+    pipe = res.timing["pipeline"]
+    assert pipe["device_templates"] is True
+    assert pipe["template_updates"] == T // E - 1
+    assert pipe["drain_flushes"] == 1
+
+
+def test_host_path_flushes_every_segment(drifting):
+    stack, _ = drifting
+    res = mk(device_templates=False).correct(stack)
+    pipe = res.timing["pipeline"]
+    assert pipe["device_templates"] is False
+    assert pipe["template_updates"] == T // E - 1
+    assert pipe["drain_flushes"] == T // E  # legacy: drain per segment
+
+
+def test_device_path_matches_host_blend(drifting):
+    stack, mats = drifting
+    dev = mk().correct(stack)
+    host = mk(device_templates=False).correct(stack)
+    np.testing.assert_allclose(
+        dev.transforms, host.transforms, atol=1e-3
+    )
+
+
+def test_numpy_backend_seam_is_bit_identical(drifting):
+    """NumpyBackend.update_reference mirrors the legacy host blend
+    exactly — same math, same order — so routing through the seam must
+    not move a single bit."""
+    stack, _ = drifting
+    a = mk(backend="numpy").correct(stack)
+    b = mk(backend="numpy", device_templates=False).correct(stack)
+    np.testing.assert_array_equal(a.transforms, b.transforms)
+
+
+def test_streaming_matches_memory_on_device_path(drifting, tmp_path):
+    stack, _ = drifting
+    path = tmp_path / "in.tif"
+    write_stack(path, stack)
+    mem = mk().correct(stack)
+    stream = mk().correct_file(path, chunk_size=16)
+    np.testing.assert_allclose(stream.transforms, mem.transforms, atol=1e-5)
+    st = stream.timing["stalls_s"]
+    assert "template_update" in st and "drain_sync" in st
+    assert stream.timing["pipeline"]["device_templates"] is True
+
+
+def test_registration_only_rolling_device_path(drifting, tmp_path):
+    """emit_frames=False + device templates: the averaging window feeds
+    the device tail (never materialized on host) and transforms match
+    the frame-emitting run."""
+    stack, _ = drifting
+    path = tmp_path / "in.tif"
+    write_stack(path, stack)
+    full = mk().correct_file(path, chunk_size=16)
+    reg = mk().correct_file(path, chunk_size=16, emit_frames=False)
+    assert reg.corrected.shape[0] == 0
+    np.testing.assert_allclose(reg.transforms, full.transforms, atol=1e-5)
+
+
+def test_template_window_must_cover_a_frame():
+    with pytest.raises(ValueError, match="template_window"):
+        MotionCorrector(template_window=0)
+
+
+# -- overlapped writeback ------------------------------------------------
+
+
+class _StubWriter:
+    """Minimal TiffWriter-protocol stub with optional slowness/failure."""
+
+    def __init__(self, delay=0.0, fail_at=None):
+        self.pages = []
+        self.delay = delay
+        self.fail_at = fail_at
+        self.closed = False
+        self.n_pages = 0
+
+    def append_batch(self, frames, n_threads=0):
+        if self.delay:
+            time.sleep(self.delay)
+        if self.fail_at is not None and self.n_pages >= self.fail_at:
+            raise OSError("disk full (simulated)")
+        self.pages.append(np.array(frames))
+        self.n_pages += len(frames)
+
+    def checkpoint_state(self):
+        return {"n_pages": self.n_pages}
+
+    def close(self):
+        self.closed = True
+
+
+def test_async_writer_ordered_and_checkpoint_synchronized():
+    inner = _StubWriter(delay=0.005)
+    w = AsyncBatchWriter(inner, depth=2)
+    batches = [np.full((2, 4, 4), i, np.float32) for i in range(6)]
+    for b in batches:
+        w.append_batch(b)
+    # checkpoint_state flushes: the state IS the durable high-water mark
+    assert w.checkpoint_state() == {"n_pages": 12}
+    w.close()
+    np.testing.assert_array_equal(
+        np.concatenate(inner.pages), np.concatenate(batches)
+    )
+    assert inner.closed
+    assert w.stats()["batches"] == 6
+
+
+def test_async_writer_backpressure_bounded_and_recorded():
+    inner = _StubWriter(delay=0.03)
+    w = AsyncBatchWriter(inner, depth=1)
+    for _ in range(4):
+        w.append_batch(np.zeros((1, 2, 2), np.float32))
+    w.close()
+    assert inner.n_pages == 4
+    assert w.stats()["backpressure_s"] > 0
+
+
+def test_async_writer_surfaces_worker_exception():
+    inner = _StubWriter(fail_at=2)
+    w = AsyncBatchWriter(inner, depth=2)
+    with pytest.raises(OSError, match="disk full"):
+        for _ in range(10):
+            w.append_batch(np.zeros((2, 2, 2), np.float32))
+            time.sleep(0.01)
+    w.close()  # already-surfaced failure: close is clean
+    assert inner.closed
+
+
+def test_correct_file_surfaces_write_failure(drifting, tmp_path, monkeypatch):
+    stack, _ = drifting
+    path = tmp_path / "in.tif"
+    write_stack(path, stack)
+
+    def boom(self, frames, n_threads=0):
+        raise OSError("no space left (simulated)")
+
+    monkeypatch.setattr(TiffWriter, "append_batch", boom)
+    mc = MotionCorrector(model="translation", backend="jax", batch_size=4)
+    with pytest.raises(OSError, match="no space left"):
+        mc.correct_file(path, output=str(tmp_path / "out.tif"))
+
+
+class _PoisonAfter:
+    def __init__(self, allow):
+        self.allow = allow
+        self.calls = 0
+
+    def __call__(self, orig, loader, lo, hi):
+        self.calls += 1
+        if self.calls > self.allow:
+            raise RuntimeError("simulated kill")
+        return orig(loader, lo, hi)
+
+
+@pytest.mark.slow
+def test_slow_writer_kill_resume_byte_identical(
+    drifting, tmp_path, monkeypatch
+):
+    """Backpressured background writer + mid-run kill + resume: the
+    resumed output must stay byte-identical (the checkpoint can only
+    claim frames the writer made durable)."""
+    stack, _ = drifting
+    u16 = np.clip(stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, u16)
+    orig_append = TiffWriter.append_batch
+    monkeypatch.setattr(
+        TiffWriter, "append_batch",
+        lambda self, frames, n_threads=0: (
+            time.sleep(0.01),
+            orig_append(self, frames, n_threads=n_threads),
+        )[1],
+    )
+    orig_read = ChunkedStackLoader._read
+
+    def run(output, checkpoint=None, poison=None):
+        mc = mk()
+        if poison is not None:
+            monkeypatch.setattr(
+                ChunkedStackLoader, "_read",
+                lambda self, lo, hi: poison(orig_read, self, lo, hi),
+            )
+        else:
+            monkeypatch.setattr(ChunkedStackLoader, "_read", orig_read)
+        return mc.correct_file(
+            str(src), output=str(output), chunk_size=8,
+            checkpoint=checkpoint and str(checkpoint), checkpoint_every=8,
+        )
+
+    ref = run(tmp_path / "ref.tif")
+    assert "writer_backpressure" in ref.timing["stalls_s"]
+    ckpt = tmp_path / "run.ckpt.npz"
+    out = tmp_path / "out.tif"
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        run(out, checkpoint=ckpt, poison=_PoisonAfter(2))
+    res = run(out, checkpoint=ckpt)
+    assert (tmp_path / "ref.tif").read_bytes() == out.read_bytes()
+    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_mid_segment_saves_pair_the_governing_template(
+    drifting, tmp_path, monkeypatch
+):
+    """Zero-stall runs reach checkpoint saves while the CURRENT template
+    is already a segment ahead of the drained cursor; the save must pair
+    the cursor with the template that governs a resume there
+    (corrector._tmpl_at_cursor). W < E opens mid-segment save windows."""
+    stack, _ = drifting
+    u16 = np.clip(stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, u16)
+    orig_read = ChunkedStackLoader._read
+
+    def run(output, checkpoint=None, poison=None):
+        mc = MotionCorrector(
+            model="translation", backend="jax", batch_size=2,
+            template_update_every=E, template_window=4,
+        )
+        if poison is not None:
+            monkeypatch.setattr(
+                ChunkedStackLoader, "_read",
+                lambda self, lo, hi: poison(orig_read, self, lo, hi),
+            )
+        else:
+            monkeypatch.setattr(ChunkedStackLoader, "_read", orig_read)
+        return mc.correct_file(
+            str(src), output=str(output), chunk_size=4,
+            checkpoint=checkpoint and str(checkpoint), checkpoint_every=2,
+        )
+
+    ref = run(tmp_path / "ref.tif")
+    ckpt = tmp_path / "run.ckpt.npz"
+    out = tmp_path / "out.tif"
+    with pytest.raises(RuntimeError, match="simulated kill"):
+        run(out, checkpoint=ckpt, poison=_PoisonAfter(3))
+    res = run(out, checkpoint=ckpt)
+    assert (tmp_path / "ref.tif").read_bytes() == out.read_bytes()
+    np.testing.assert_allclose(res.transforms, ref.transforms, atol=1e-6)
+
+
+# -- telemetry surfacing -------------------------------------------------
+
+
+def test_cli_summary_reports_stalls(drifting, tmp_path, capsys):
+    stack, _ = drifting
+    src = tmp_path / "in.tif"
+    write_stack(src, np.clip(stack * 40000, 0, 65535).astype(np.uint16))
+    from kcmc_tpu.__main__ import main
+
+    rc = main([
+        "correct", str(src), "-o", str(tmp_path / "o.tif"),
+        "--batch-size", "4", "--template-update", str(E),
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "stalls_s" in summary
+    assert "writer_backpressure" in summary["stalls_s"]
+    assert summary["pipeline"]["device_templates"] is True
