@@ -229,14 +229,17 @@ def run_bench_streaming(
             stack, output=f"{td}/corrected.tif", output_dtype="input"
         )
         dt = time.perf_counter() - t0
+    stalls = res.timing.get("stalls_s", {})
     return {
         "fps": n_frames / dt,
         "seconds": dt,
         "rmse_px": _rmse(data, "translation", res.transforms, None),
         "n_frames": n_frames,
-        "stalls_s": {
-            k: round(v, 4)
-            for k, v in res.timing.get("stalls_s", {}).items()
+        "stalls_s": {k: round(v, 4) for k, v in stalls.items()},
+        # per-seam stall fractions of wall time: the unit-free number
+        # that stays comparable across PRs as absolute times shift
+        "stall_fractions": {
+            k: round(v / dt, 4) for k, v in stalls.items()
         },
         "pipeline": res.timing.get("pipeline"),
     }
@@ -406,7 +409,10 @@ def main() -> None:
         )
         configs = dict(configs or {})
         configs["streaming_rolling"] = dict(
-            _config_row(rs), stalls_s=rs["stalls_s"], pipeline=rs["pipeline"]
+            _config_row(rs),
+            stalls_s=rs["stalls_s"],
+            stall_fractions=rs["stall_fractions"],
+            pipeline=rs["pipeline"],
         )
         print(
             f"[bench] streaming_rolling {args.size}x{args.size}: "
@@ -420,8 +426,22 @@ def main() -> None:
         judged_json_line(
             args.model, args.size, r["fps"],
             sweeps_fps=r.get("sweeps_fps"), configs=configs,
+            manifest=_bench_manifest(),
         )
     )
+
+
+def _bench_manifest() -> dict | None:
+    """Compact environment stamp (versions + device identity) so the
+    BENCH artifact's perf trajectory is attributable across PRs —
+    a regression caused by a jax upgrade or a different device class
+    reads differently from a code regression. Never fails the bench."""
+    try:
+        from kcmc_tpu.obs.manifest import slim_manifest
+
+        return slim_manifest()
+    except Exception:
+        return None
 
 
 def _config_row(r: dict) -> dict:
@@ -440,12 +460,15 @@ def _config_row(r: dict) -> dict:
 def judged_json_line(
     model: str, size: int, fps: float,
     sweeps_fps: list | None = None, configs: dict | None = None,
+    manifest: dict | None = None,
 ) -> str:
     """The driver-contract output: ONE JSON line with metric/value/unit/
     vs_baseline (vs the 200 fps/chip north-star target). The optional
-    `sweeps_fps` (every timed sweep, not just the best) and `configs`
-    (the --all per-workload rows) ride along as extra keys so the
-    recorded artifact is variance-honest and self-contained."""
+    `sweeps_fps` (every timed sweep, not just the best), `configs`
+    (the --all per-workload rows, with the streaming row's per-seam
+    stall fractions), and `manifest` (versions + device identity) ride
+    along as extra keys so the recorded artifact is variance-honest,
+    self-contained, and attributable across PRs."""
     target = 200.0  # frames/sec/chip — BASELINE.json north-star target
     rec = {
         "metric": f"registration_throughput_{model}_{size}x{size}",
@@ -457,6 +480,8 @@ def judged_json_line(
         rec["sweeps_fps"] = list(sweeps_fps)  # already rounded at source
     if configs:
         rec["configs"] = configs
+    if manifest:
+        rec["manifest"] = manifest
     return json.dumps(rec)
 
 

@@ -62,6 +62,15 @@ def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only
             from kcmc_tpu.config import CorrectorConfig
 
             return CorrectorConfig
+        if name in (
+            "Tracer",
+            "FrameRecordStream",
+            "Heartbeat",
+            "build_manifest",
+        ):
+            import kcmc_tpu.obs as _obs
+
+            return getattr(_obs, name)
     except ImportError as e:  # PEP 562: attribute access must raise AttributeError
         raise AttributeError(f"kcmc_tpu.{name} is unavailable: {e}") from e
     raise AttributeError(f"module 'kcmc_tpu' has no attribute {name!r}")

@@ -15,6 +15,12 @@ transfers at all — the fast first pass of the `apply`/`stabilize`
 workflows). `apply` resamples any same-shape stack through a saved
 registration (multi-channel microscopy); `stabilize` removes motion
 faster than ~sigma frames and follows the rest.
+
+Observability (docs/OBSERVABILITY.md): `correct --trace t.json
+--frame-records f.jsonl --heartbeat 30` exports a Perfetto-loadable
+span trace and a per-frame quality JSONL while narrating progress to
+stderr; `report` renders either artifact into a human-readable run
+report. `-v`/`-q` tune stderr logging; stdout stays machine-readable.
 """
 
 from __future__ import annotations
@@ -74,6 +80,13 @@ def _parse_reference_and_overrides(args):
         overrides["fault_plan"] = args.inject_faults
     if getattr(args, "writer_depth", -1) >= 0:
         overrides["writer_depth"] = args.writer_depth
+    # observability (docs/OBSERVABILITY.md): all off by default
+    if getattr(args, "trace", ""):
+        overrides["trace_path"] = args.trace
+    if getattr(args, "frame_records", ""):
+        overrides["frame_records_path"] = args.frame_records
+    if getattr(args, "heartbeat", 0):
+        overrides["heartbeat_s"] = args.heartbeat
     return ref, overrides
 
 
@@ -114,6 +127,9 @@ def _cmd_correct(args) -> int:
         if res.robustness is not None:
             # 0-d unicode array: readable back without allow_pickle
             payload["robustness"] = np.array(json.dumps(res.robustness))
+        # stage/stall timing rides along so `kcmc_tpu report t.npz`
+        # can render the stage table without the sidecar records file
+        payload["timing"] = np.array(json.dumps(res.timing))
         np.savez(args.transforms, **payload)
 
     fps = res.frames_per_sec
@@ -149,6 +165,21 @@ def _cmd_correct(args) -> int:
         )
     if res.timing.get("warp_escalated"):
         summary["warp_escalated"] = True
+    # Per-stage totals/counts/means: the coarse where-did-the-time-go
+    # view (StageTimer.report); a stage dominated by many cheap entries
+    # vs few expensive ones is a different problem, so counts and means
+    # ride along with the totals.
+    if res.timing.get("stages_s"):
+        counts = res.timing.get("stage_counts", {})
+        means = res.timing.get("stage_mean_s", {})
+        summary["stages"] = {
+            k: {
+                "total_s": round(v, 3),
+                "count": int(counts.get(k, 0)),
+                "mean_s": round(means.get(k, 0.0), 4),
+            }
+            for k, v in res.timing["stages_s"].items()
+        }
     # Pipeline-stall accounting: seconds the streaming consumer spent
     # blocked on each seam that should overlap (prefetch, drain device
     # sync, writer backpressure/flush, template updates) — the
@@ -330,6 +361,14 @@ def _cmd_stabilize(args) -> int:
     return 0
 
 
+def _cmd_report(args) -> int:
+    """Render a human-readable run report from either run artifact:
+    a --frame-records JSONL or a `correct --transforms` npz."""
+    from kcmc_tpu.obs.report import main as report_main
+
+    return report_main(args.artifact, top=args.top, as_json=args.json)
+
+
 def _cmd_selftest(args) -> int:
     from kcmc_tpu.selftest import main as selftest_main
 
@@ -341,6 +380,15 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kcmc_tpu",
         description="TPU-native keypoint-consensus motion correction",
+    )
+    ap.add_argument(
+        "-v", "--verbose", action="count", default=0,
+        help="more logging on stderr (-v: INFO, -vv: DEBUG); "
+        "machine-readable summaries stay on stdout",
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="count", default=0,
+        help="less logging on stderr (-q: errors only, -qq: critical)",
     )
     sub = ap.add_subparsers(dest="cmd", required=True)
 
@@ -446,8 +494,43 @@ def main(argv=None) -> int:
         "checkpoint:corrupt_part=1'; grammar in docs/ROBUSTNESS.md). "
         "Also settable via the KCMC_FAULT_PLAN env var",
     )
+    p.add_argument(
+        "--trace", default="", metavar="PATH",
+        help="export a Chrome trace-event JSON of the run (stages, "
+        "pipeline stalls, per-batch dispatch, writer thread); load in "
+        "Perfetto / chrome://tracing (docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--frame-records", default="", metavar="PATH",
+        help="stream per-frame quality records (keypoints, matches, "
+        "inlier count/ratio, consensus residual px, robustness flags) "
+        "to a JSONL sidecar; render with `kcmc_tpu report PATH`",
+    )
+    p.add_argument(
+        "--heartbeat", type=float, default=0, metavar="SECS",
+        help="log a progress line (frames done, fps, stall fractions, "
+        "robustness counters) to stderr every SECS seconds — liveness "
+        "for unattended runs (0 = off)",
+    )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
+
+    p = sub.add_parser(
+        "report",
+        help="render a run report from a --frame-records JSONL or a "
+        "`correct --transforms` npz (stage/stall table, frame-quality "
+        "percentiles, worst frames, robustness summary)",
+    )
+    p.add_argument("artifact", help="frame-records .jsonl or transforms .npz")
+    p.add_argument(
+        "--top", type=int, default=10,
+        help="worst-N frames to list (default 10)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable JSON summary instead of the text report",
+    )
+    p.set_defaults(fn=_cmd_report)
 
     p = sub.add_parser(
         "apply",
@@ -502,6 +585,17 @@ def main(argv=None) -> int:
     p.set_defaults(fn=_cmd_stabilize)
 
     args = ap.parse_args(argv)
+    # CLI processes route library advisories through the kcmc_tpu
+    # logger on stderr; stdout carries only machine-readable output.
+    from kcmc_tpu.obs.log import setup_cli_logging
+
+    setup_cli_logging(verbose=args.verbose, quiet=args.quiet)
+    if getattr(args, "heartbeat", 0):
+        # explicit --heartbeat output must survive the default WARNING
+        # level without requiring -v
+        import logging
+
+        logging.getLogger("kcmc_tpu.heartbeat").setLevel(logging.INFO)
     return args.fn(args)
 
 

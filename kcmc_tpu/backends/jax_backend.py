@@ -162,6 +162,38 @@ class JaxBackend:
     def transient_error_types(self) -> tuple:
         return _runtime_error_types()
 
+    def runtime_info(self) -> dict:
+        """Execution-environment description for the run manifest
+        (obs/manifest.py): jax version + the device inventory this
+        backend will actually dispatch to (the mesh's devices when
+        sharded). Never raises — a wedged tunnel must not take down
+        the run that is trying to record it."""
+        info: dict = {"backend": self.name, "jax": jax.__version__}
+        try:
+            devs = (
+                list(self.mesh.devices.flat)
+                if self.mesh is not None
+                else jax.devices()
+            )
+            info["devices"] = [
+                {
+                    "id": int(d.id),
+                    "platform": str(d.platform),
+                    "kind": str(getattr(d, "device_kind", "")),
+                }
+                for d in devs
+            ]
+            if self.mesh is not None:
+                info["mesh_shape"] = {
+                    str(k): int(v)
+                    for k, v in zip(
+                        self.mesh.axis_names, self.mesh.devices.shape
+                    )
+                }
+        except Exception:
+            pass
+        return info
+
     def __init__(self, config: CorrectorConfig, mesh=None, **_options):
         self.config = config
         self.mesh = mesh  # jax.sharding.Mesh: shard frame batches over it

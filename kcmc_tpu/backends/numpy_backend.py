@@ -257,6 +257,17 @@ class NumpyBackend:
             )
         self.config = config
 
+    def runtime_info(self) -> dict:
+        """Execution-environment description for the run manifest
+        (obs/manifest.py) — the numpy oracle runs on the host CPU."""
+        import platform
+
+        return {
+            "backend": self.name,
+            "numpy": np.__version__,
+            "processor": platform.processor() or platform.machine(),
+        }
+
     def _detect_describe_2d(self, frame: np.ndarray, multi_scale=True):
         """Single-scale detect+describe, or the ORB scale pyramid when
         n_octaves > 1 — the same octave sizes, resize matrices, and

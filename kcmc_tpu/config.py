@@ -222,6 +222,36 @@ class CorrectorConfig:
     # frame overlap and a correlation estimated from few pixels).
     quality_metrics: bool = False
 
+    # -- observability (kcmc_tpu/obs; docs/OBSERVABILITY.md) ---------------
+    # Chrome trace-event JSON export path (None = off): every stage,
+    # pipeline stall, per-batch dispatch, and background-writer append
+    # becomes a span; load the file in Perfetto / chrome://tracing. The
+    # run manifest (resolved config + hash, versions, device inventory)
+    # rides in the trace metadata. CLI: --trace PATH.
+    trace_path: str | None = None
+    # Per-frame quality-record JSONL sidecar path (None = off): one
+    # JSON object per frame — keypoints, matches, inlier count/ratio,
+    # consensus residual px, template correlation, robustness flags —
+    # written through a bounded background writer so record IO overlaps
+    # device compute. Render with `kcmc_tpu report PATH`. CLI:
+    # --frame-records PATH.
+    frame_records_path: str | None = None
+    # Heartbeat period in seconds (0 = off): a background thread logs
+    # one progress line (frames done, fps, stall fractions, robustness
+    # counters) to stderr every period — liveness for unattended runs.
+    # CLI: --heartbeat SECS.
+    heartbeat_s: float = 0.0
+
+    @property
+    def observability_enabled(self) -> bool:
+        """True when any obs surface is armed — THE gate both the
+        orchestrator (skip telemetry setup entirely) and
+        `RunTelemetry.begin` (return None) consult, so a new obs knob
+        is added in exactly one place."""
+        return bool(
+            self.trace_path or self.frame_records_path or self.heartbeat_s > 0
+        )
+
     # -- input hygiene -----------------------------------------------------
     # Replace non-finite input pixels (dead/hot sensor pixels, NaN
     # padding) with the frame's finite mean, on device, before
@@ -468,6 +498,11 @@ class CorrectorConfig:
             from kcmc_tpu.utils.faults import FaultPlan
 
             FaultPlan.from_spec(self.fault_plan)
+        if self.heartbeat_s < 0:
+            raise ValueError(
+                f"heartbeat_s must be >= 0 seconds (0 = off), got "
+                f"{self.heartbeat_s}"
+            )
         if not 0.0 < self.rescue_warn_fraction <= 1.0:
             raise ValueError(
                 "rescue_warn_fraction must be in (0, 1], got "

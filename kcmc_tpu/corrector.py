@@ -12,32 +12,59 @@ timing, and resumable processing.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
+import sys
+import time
 from typing import Any
 
 import numpy as np
 
 from kcmc_tpu.backends import get_backend
 from kcmc_tpu.config import CorrectorConfig
+from kcmc_tpu.obs.log import advise
 from kcmc_tpu.utils.metrics import StageTimer
 
 
-# Config fields that shape failure recovery or IO scheduling but never
-# the happy-path results; pinned to their defaults inside the checkpoint
-# resume signature so changing them between runs doesn't invalidate a
-# resume. (`writer_depth` only reorders WHEN bytes hit disk, never which
-# bytes — checkpoints flush to the durable mark first. `device_templates`
-# is deliberately NOT neutral: the device blend's reduction order differs
-# from the host path at float32 precision, so flipping it mid-run must
-# restart, not resume.)
+# Config fields that shape failure recovery, IO scheduling, or pure
+# observability but never the happy-path results; pinned to their
+# defaults inside the checkpoint resume signature so changing them
+# between runs doesn't invalidate a resume. (`writer_depth` only
+# reorders WHEN bytes hit disk, never which bytes — checkpoints flush
+# to the durable mark first. The obs knobs only RECORD what ran —
+# re-running a killed job with --trace added must resume it, not
+# restart it. `device_templates` is deliberately NOT neutral: the
+# device blend's reduction order differs from the host path at float32
+# precision, so flipping it mid-run must restart, not resume.)
 _ROBUSTNESS_SIG_NEUTRAL = {
     f: CorrectorConfig.__dataclass_fields__[f].default
     for f in (
         "fault_plan", "retry_attempts", "retry_backoff_s",
         "retry_backoff_max_s", "retry_jitter", "failover_backend",
         "degrade_mark_failed", "writer_depth",
+        "trace_path", "frame_records_path", "heartbeat_s",
     )
 }
+
+
+def _telemetry_scope(fn):
+    """Guarantee RunTelemetry teardown for a public run method: on the
+    error path the partial trace/records flush with the failure
+    recorded (a post-mortem artifact is the point of observability);
+    on success `finish(timing)` has already run and close() is a
+    no-op."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        try:
+            return fn(self, *args, **kwargs)
+        finally:
+            t = getattr(self, "_telemetry", None)
+            if t is not None:
+                self._telemetry = None
+                t.close(sys.exc_info()[1])
+
+    return wrapper
 
 
 def _fingerprint(ref) -> str:
@@ -610,6 +637,32 @@ class MotionCorrector:
         self._out_template = None
         self._failover_backend = None
         self._failover_ref = None
+        # Per-run observability coordinator (obs/run.RunTelemetry),
+        # armed by _begin_telemetry; None = everything off.
+        self._telemetry = None
+
+    # -- observability ---------------------------------------------------
+
+    def _begin_telemetry(self, timer: StageTimer, total: int | None = None):
+        """Arm the run's telemetry (tracer + frame records + heartbeat)
+        when any obs knob is set; returns None — at the cost of three
+        attribute reads — otherwise. The @_telemetry_scope decorator on
+        the public run methods owns teardown."""
+        cfg = self.config
+        if not cfg.observability_enabled:
+            self._telemetry = None
+            return None
+        from kcmc_tpu.obs.run import RunTelemetry
+
+        self._telemetry = RunTelemetry.begin(
+            cfg,
+            backend=self.backend,
+            backend_name=self.backend_name,
+            timer=timer,
+            report=self._robustness,
+            total=total,
+        )
+        return self._telemetry
 
     # -- robustness: retry engine + degradation ladder ------------------
 
@@ -784,8 +837,6 @@ class MotionCorrector:
         drain-side warp rescue (it would re-flag them as successfully
         warped and blend unregistered pixels into rolling templates).
         """
-        import warnings
-
         from kcmc_tpu.utils import faults
 
         plan, policy = self._fault_plan, self._retry_policy
@@ -827,12 +878,14 @@ class MotionCorrector:
                 )
                 self._note_out_template(out)
                 report.backend_failovers += 1
-                warnings.warn(
+                report.failover_frame_indices.extend(
+                    int(i) for i in idx[:n]
+                )
+                advise(
                     f"kcmc: device batch at frames {int(idx[0])}.."
                     f"{int(idx[n - 1])} failed {attempts} attempt(s) "
                     f"({type(last).__name__}: {last}); recovered on the "
                     f"'{self.config.failover_backend}' failover backend",
-                    RuntimeWarning,
                     stacklevel=2,
                 )
                 return (
@@ -861,13 +914,12 @@ class MotionCorrector:
         ):
             raise last
         report.failed_frame_indices.extend(int(i) for i in idx[:n])
-        warnings.warn(
+        advise(
             f"kcmc: device batch at frames {int(idx[0])}..{int(idx[n - 1])} "
             f"failed on every ladder rung ({type(last).__name__}: {last}); "
             f"marking its {n} frame(s) failed — matrix-model transforms "
             "are rescued by trajectory interpolation, pixels stay "
             "uncorrected (diagnostics['frames_failed'])",
-            RuntimeWarning,
             stacklevel=2,
         )
         return (
@@ -1063,6 +1115,7 @@ class MotionCorrector:
                 break
         return list(reversed(tail_c)), list(reversed(tail_ok))
 
+    @_telemetry_scope
     def correct(
         self,
         stack: np.ndarray,
@@ -1118,6 +1171,9 @@ class MotionCorrector:
         timer = StageTimer()
         cfg = self.config
         T = len(stack) if end_frame is None else min(end_frame, len(stack))
+        telemetry = self._begin_telemetry(
+            timer, total=max(T - start_frame, 0)
+        )
 
         with timer.stage("prepare_reference"):
             # _select_reference works for device stacks too: its branches
@@ -1158,6 +1214,8 @@ class MotionCorrector:
             else None
         )
 
+        rec_pos = [start_frame]  # global index of the next drained frame
+
         def drain(entry):
             n, out, batch, eref = entry
             if device_outputs:
@@ -1168,6 +1226,11 @@ class MotionCorrector:
             if do_rescue:
                 self._rescue_flagged(host, batch, n, eref)
             outs.append(host)
+            if telemetry is not None:
+                telemetry.note_batch(
+                    rec_pos[0], n, host, escalated=self._escalated
+                )
+            rec_pos[0] += n
 
         def batches(slo, shi):
             for lo in range(slo, shi, B):
@@ -1250,6 +1313,8 @@ class MotionCorrector:
             merged, transforms, start_frame, T - start_frame, timing,
             host=not device_outputs,
         )
+        if telemetry is not None:
+            telemetry.finish(timing)
         return CorrectionResult(
             corrected=corrected,
             transforms=transforms,
@@ -1371,6 +1436,9 @@ class MotionCorrector:
             state = self._new_dispatch_state()
         if timer is not None:
             state["timer"] = timer
+        # obs seam: per-batch dispatch spans land on the consumer
+        # thread's trace track (None when tracing is off — free).
+        tracer = getattr(timer, "tracer", None) if timer is not None else None
         inflight: list[tuple] = state["inflight"]
         accepts_cast: dict = state["accepts"]
         native_ok: dict[int, bool] = state["native_ok"]
@@ -1429,6 +1497,7 @@ class MotionCorrector:
                     if accepts_cast[key]:
                         kw["emit_frames"] = False
             step = plan.op_index("device") if plan is not None else None
+            t_disp = time.perf_counter() if tracer is not None else 0.0
             try:
                 if plan is not None:
                     plan.maybe_fail("device", step)
@@ -1449,6 +1518,12 @@ class MotionCorrector:
                     on_dispatched(n, out, idx)
                 drain((n, out, self._failed_kept(out, kept, failed), ref))
                 continue
+            if tracer is not None:
+                tracer.complete(
+                    "dispatch_batch", t_disp, time.perf_counter() - t_disp,
+                    cat="dispatch",
+                    args={"first_frame": int(idx[0]), "frames": int(n)},
+                )
             if on_dispatched is not None:
                 # pre-drop hook: the device-template tail needs the
                 # still-async "corrected" arrays even on spans whose
@@ -1553,8 +1628,6 @@ class MotionCorrector:
             frac = max(frac, wr / wn)
         if frac <= cfg.rescue_warn_fraction:
             return
-        import warnings
-
         self._rescue_warned = True
         detail = (
             f"{self._rescue_count}/{self._rescue_seen} frames "
@@ -1569,20 +1642,18 @@ class MotionCorrector:
         )
         if can_escalate:
             self._escalated = True
-            warnings.warn(
+            advise(
                 f"kcmc: {detail}; switching the remaining batches to the "
                 "exact unbounded warp (one recompile, then full batch "
                 "speed). Raise max_shear_px / set max_rotation_deg to "
                 "keep such stacks on the fast bounded kernels.",
-                RuntimeWarning,
                 stacklevel=2,
             )
         else:
-            warnings.warn(
+            advise(
                 f"kcmc: {detail}. Use warp='jnp', or raise max_shear_px / "
                 "set max_rotation_deg, for stacks with persistently "
                 "large motion.",
-                RuntimeWarning,
                 stacklevel=2,
             )
 
@@ -1648,6 +1719,7 @@ class MotionCorrector:
             )
             host["template_corr"] = corr
 
+    @_telemetry_scope
     def correct_file(
         self,
         path,
@@ -1731,6 +1803,7 @@ class MotionCorrector:
         self._begin_robust_run()
         timer = StageTimer()
         cfg = self.config
+        telemetry = self._begin_telemetry(timer)
         B = cfg.batch_size
         chunk = chunk_size or max(B, 64)
         chunk = ((chunk + B - 1) // B) * B  # multiple of the batch size
@@ -1759,6 +1832,8 @@ class MotionCorrector:
         with open_stack(
             path, n_threads=n_threads, **(reader_options or {})
         ) as ts:
+            if telemetry is not None:
+                telemetry.set_total(len(ts))
             with timer.stage("prepare_reference"):
                 if isinstance(self.reference, (int, np.integer)):
                     idx = int(self.reference)
@@ -1885,8 +1960,13 @@ class MotionCorrector:
                 # the durable high-water mark first (io/async_writer.py)
                 from kcmc_tpu.io.async_writer import AsyncBatchWriter
 
-                writer = AsyncBatchWriter(writer, depth=cfg.writer_depth)
+                writer = AsyncBatchWriter(
+                    writer, depth=cfg.writer_depth,
+                    tracer=telemetry.tracer if telemetry is not None else None,
+                )
             restored = start
+            if telemetry is not None and start > 0:
+                telemetry.resumed(start)
 
             cursor = {
                 "done": start,
@@ -1940,6 +2020,8 @@ class MotionCorrector:
                     cursor["part"] += 1
                 cursor["seg_saved"] = len(outs)
                 cursor["saved"] = cursor["done"]
+                if telemetry is not None:
+                    telemetry.checkpoint_saved(cursor["done"])
 
             roll = self.template_update_every > 0
             tail: list[dict] = []  # last-window (corrected, warp_ok) pairs
@@ -2027,6 +2109,10 @@ class MotionCorrector:
                 # else: window-only frames (registration-only rolling
                 # runs) fed the tail buffer above and are dropped
                 outs.append(host)
+                if telemetry is not None:
+                    telemetry.note_batch(
+                        cursor["done"], n, host, escalated=self._escalated
+                    )
                 cursor["done"] += n
                 # Rolling runs may save mid-segment only OUTSIDE the
                 # next boundary's averaging window — a resume landing
@@ -2227,11 +2313,13 @@ class MotionCorrector:
         )
         if writer is not None and hasattr(writer, "stats"):
             wst = writer.stats()
+            # trace=False: the writer traced each backpressure/flush
+            # wait at source; these aggregates are totals-only
             timer.add_stall(
                 "writer_backpressure", wst["backpressure_s"],
-                count=int(wst["batches"]),
+                count=int(wst["batches"]), trace=False,
             )
-            timer.add_stall("writer_flush", wst["flush_s"])
+            timer.add_stall("writer_flush", wst["flush_s"], trace=False)
         # fps over frames THIS run actually registered (restored frames
         # took no wall time here and would overstate throughput).
         timing = timer.report(n_frames=cursor["done"] - restored)
@@ -2247,6 +2335,8 @@ class MotionCorrector:
         transforms = self._finalize_robustness(
             merged, transforms, 0, cursor["done"], timing
         )
+        if telemetry is not None:
+            telemetry.finish(timing)
         return CorrectionResult(
             corrected=corrected,
             transforms=transforms,

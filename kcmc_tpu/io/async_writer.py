@@ -29,12 +29,16 @@ import time
 
 class AsyncBatchWriter:
     """Wrap a streaming writer with a depth-bounded background append
-    queue. `depth` is the maximum number of batches in flight (>= 1)."""
+    queue. `depth` is the maximum number of batches in flight (>= 1).
+    `tracer` (an obs.trace.Tracer, optional) records each worker-side
+    append and consumer-side backpressure/flush wait as spans — the
+    writer thread shows up as its own track in the exported trace."""
 
-    def __init__(self, writer, depth: int = 2):
+    def __init__(self, writer, depth: int = 2, tracer=None):
         if depth < 1:
             raise ValueError(f"AsyncBatchWriter depth must be >= 1, got {depth}")
         self.writer = writer
+        self._tracer = tracer
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._exc: BaseException | None = None
         self._closed = False
@@ -60,8 +64,14 @@ class AsyncBatchWriter:
                     t0 = time.perf_counter()
                     try:
                         self.writer.append_batch(frames, n_threads=n_threads)
-                        self._stats["write_s"] += time.perf_counter() - t0
+                        dt = time.perf_counter() - t0
+                        self._stats["write_s"] += dt
                         self._stats["batches"] += 1
+                        if self._tracer is not None:
+                            self._tracer.complete(
+                                "writer.append_batch", t0, dt, cat="writer",
+                                args={"batch": self._stats["batches"]},
+                            )
                     except BaseException as e:  # surfaced on the consumer
                         self._exc = e
             finally:
@@ -82,7 +92,12 @@ class AsyncBatchWriter:
         except queue.Full:
             t0 = time.perf_counter()
             self._q.put(item)
-            self._stats["backpressure_s"] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._stats["backpressure_s"] += dt
+            if self._tracer is not None:
+                self._tracer.complete(
+                    "writer.backpressure", t0, dt, cat="stall"
+                )
         # re-check AFTER enqueuing so a worker failure surfaces at most
         # one append late, not only at close
         self._check()
@@ -93,7 +108,10 @@ class AsyncBatchWriter:
         self._check()
         t0 = time.perf_counter()
         self._q.join()
-        self._stats["flush_s"] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self._stats["flush_s"] += dt
+        if self._tracer is not None and dt > 0:
+            self._tracer.complete("writer.flush", t0, dt, cat="stall")
         self._check()
 
     def checkpoint_state(self) -> dict:

@@ -754,15 +754,14 @@ class TiffWriter:
                 # native encoder is available now.
                 w._pin_python_deflate = True
             elif recorded is not None and recorded != _deflate_encoder_id():
-                import warnings
+                from kcmc_tpu.obs.log import advise
 
-                warnings.warn(
+                advise(
                     f"kcmc: resume checkpoint was written by deflate "
                     f"encoder {recorded!r} but this run would use "
                     f"{_deflate_encoder_id()!r}; the resumed file will "
                     "be pixel-identical but may not be byte-identical "
                     "to an uninterrupted run",
-                    RuntimeWarning,
                     stacklevel=2,
                 )
         return w

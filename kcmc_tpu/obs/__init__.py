@@ -1,0 +1,57 @@
+"""kcmc_tpu.obs — the run-telemetry subsystem.
+
+Four pieces (docs/OBSERVABILITY.md):
+
+* `trace` — thread-aware span `Tracer`, Chrome trace-event export
+  (`--trace PATH`, Perfetto-loadable);
+* `records` — per-frame quality records streamed to a JSONL sidecar
+  through a bounded background writer (`--frame-records PATH`);
+* `manifest` + `heartbeat` — the run manifest embedded in both
+  artifacts, and the periodic stderr progress line (`--heartbeat S`);
+* `report` — the `kcmc_tpu report` renderer over either artifact.
+
+`run.RunTelemetry` coordinates them per run; `log` owns the
+`kcmc_tpu` logger and the `advise()` warning-routing seam. Everything
+is off by default and costs one None-check per batch when disabled.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "Tracer",
+    "FrameRecordStream",
+    "Heartbeat",
+    "RunTelemetry",
+    "build_manifest",
+    "get_logger",
+    "setup_cli_logging",
+    "advise",
+]
+
+
+def __getattr__(name):  # lazy: obs imports must not tax the hot path
+    if name == "Tracer":
+        from kcmc_tpu.obs.trace import Tracer
+
+        return Tracer
+    if name == "FrameRecordStream":
+        from kcmc_tpu.obs.records import FrameRecordStream
+
+        return FrameRecordStream
+    if name == "Heartbeat":
+        from kcmc_tpu.obs.heartbeat import Heartbeat
+
+        return Heartbeat
+    if name == "RunTelemetry":
+        from kcmc_tpu.obs.run import RunTelemetry
+
+        return RunTelemetry
+    if name == "build_manifest":
+        from kcmc_tpu.obs.manifest import build_manifest
+
+        return build_manifest
+    if name in ("get_logger", "setup_cli_logging", "advise"):
+        from kcmc_tpu.obs import log
+
+        return getattr(log, name)
+    raise AttributeError(f"module 'kcmc_tpu.obs' has no attribute {name!r}")

@@ -1,0 +1,97 @@
+"""Heartbeat: periodic liveness + progress lines for unattended runs.
+
+A multi-hour streaming run's only live signal used to be `--progress`
+frame counts (stdout, chatty) or nothing. The heartbeat thread samples
+the run every `interval_s` seconds and emits ONE line to stderr —
+frames done / total, fps, stall fractions, robustness counters — so a
+supervisor (or a human tailing the log) can distinguish "slow but
+alive" from "wedged" without attaching a debugger. Complements the
+`_StallWatchdog` (which hard-exits on zero progress): the watchdog
+acts, the heartbeat narrates.
+
+Lifecycle: `start()` spawns one daemon thread; `stop()` signals and
+JOINS it (bounded by one interval), so tests can assert no thread
+leaks. Sampling failures are swallowed after one diagnostic — a
+telemetry bug must never take down the run it observes.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+
+
+def _default_emit(message: str) -> None:
+    """Log through `kcmc_tpu.heartbeat` when a handler is attached AND
+    the record would actually pass level filtering; plain stderr
+    otherwise. Whoever set heartbeat_s>0 asked for the output — an
+    embedder who attached a handler to `kcmc_tpu` but left the default
+    WARNING level must still see the liveness line, not have INFO
+    records silently filtered away."""
+    logger = logging.getLogger("kcmc_tpu.heartbeat")
+    if logging.getLogger("kcmc_tpu").handlers and logger.isEnabledFor(
+        logging.INFO
+    ):
+        logger.info(message)
+    else:
+        print(f"[kcmc heartbeat] {message}", file=sys.stderr, flush=True)
+
+
+class Heartbeat:
+    """Emit `sample()`'s message every `interval_s` seconds on a
+    background thread. `sample` returns the line to emit (str) or None
+    to skip a beat."""
+
+    def __init__(self, interval_s: float, sample, emit=None):
+        if interval_s <= 0:
+            raise ValueError(
+                f"heartbeat interval must be positive seconds, got {interval_s}"
+            )
+        self.interval_s = float(interval_s)
+        self._sample = sample
+        self._emit = emit if emit is not None else _default_emit
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.beats = 0  # emitted lines (lifecycle tests)
+
+    def start(self) -> "Heartbeat":
+        if self._thread is not None:
+            return self  # already running (idempotent)
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="kcmc-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        warned = False
+        while not self._stop.wait(self.interval_s):
+            try:
+                msg = self._sample()
+            except Exception as e:
+                if not warned:
+                    warned = True
+                    self._emit(f"heartbeat sampler failed ({e!r}); muting")
+                continue
+            if msg:
+                self._emit(msg)
+                self.beats += 1
+
+    def stop(self) -> None:
+        """Signal and join the thread (idempotent; bounded wait)."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.interval_s + 5.0)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

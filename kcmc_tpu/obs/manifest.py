@@ -1,0 +1,136 @@
+"""Run manifest: what exactly ran, hashed for cross-run attribution.
+
+A throughput or quality trajectory across PRs/rounds is only
+attributable when each artifact records the resolved configuration and
+environment it came from. `build_manifest` captures the resolved
+`CorrectorConfig` (plus a sha256 of its canonical JSON — two runs with
+the same hash ran the same pipeline), package/python/jax versions, the
+execution backend's device inventory, and the armed fault plan. It is
+embedded in the Chrome-trace metadata, the frame-records JSONL header,
+and (in slim form) bench.py's judged output line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import platform
+import sys
+import time
+
+
+def _jsonable(v):
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    return repr(v)
+
+
+def config_digest(config) -> tuple[dict, str]:
+    """(resolved config as a JSON-safe dict, sha256 of its canonical
+    JSON). Key-sorted serialization so the digest is field-order
+    independent."""
+    cfg = {
+        k: _jsonable(v) for k, v in dataclasses.asdict(config).items()
+    }
+    canon = json.dumps(cfg, sort_keys=True, separators=(",", ":"))
+    return cfg, hashlib.sha256(canon.encode()).hexdigest()
+
+
+def runtime_versions() -> dict:
+    """Package/interpreter/accelerator-stack versions (jax optional —
+    report-only processes never force an accelerator import)."""
+    from kcmc_tpu import __version__
+
+    out = {
+        "kcmc_tpu": __version__,
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        out["jax"] = getattr(jax, "__version__", "unknown")
+        np_mod = sys.modules.get("numpy")
+        if np_mod is not None:
+            out["numpy"] = np_mod.__version__
+    else:
+        import numpy as np_mod
+
+        out["numpy"] = np_mod.__version__
+    return out
+
+
+def device_inventory() -> list[dict]:
+    """The visible accelerator devices, if jax is already imported and
+    initialized cleanly; never *initializes* a backend itself (that can
+    dial a wedged tunnel) and never raises."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    try:
+        return [
+            {
+                "id": int(d.id),
+                "platform": str(d.platform),
+                "kind": str(getattr(d, "device_kind", "")),
+            }
+            for d in jax.devices()
+        ]
+    except Exception:
+        return []
+
+
+def build_manifest(
+    config=None,
+    backend=None,
+    backend_name: str | None = None,
+    extra: dict | None = None,
+) -> dict:
+    """Assemble the run manifest.
+
+    `backend` may expose a `runtime_info()` seam (both in-tree backends
+    do) describing its execution environment; otherwise the generic
+    jax device inventory is recorded.
+    """
+    manifest: dict = {
+        "kind": "kcmc_run_manifest",
+        "version": 1,
+        "created_unix_s": round(time.time(), 3),
+        "versions": runtime_versions(),
+        "argv": list(sys.argv),
+    }
+    if backend_name:
+        manifest["backend"] = backend_name
+    info = getattr(backend, "runtime_info", None)
+    if info is not None:
+        try:
+            manifest["backend_runtime"] = _jsonable(info())
+        except Exception:
+            pass
+    if "backend_runtime" not in manifest:
+        devs = device_inventory()
+        if devs:
+            manifest["backend_runtime"] = {"devices": devs}
+    if config is not None:
+        cfg, digest = config_digest(config)
+        manifest["config"] = cfg
+        manifest["config_sha256"] = digest
+        manifest["fault_plan"] = cfg.get("fault_plan")
+    if extra:
+        manifest.update(_jsonable(extra))
+    return manifest
+
+
+def slim_manifest() -> dict:
+    """The compact environment stamp bench.py embeds in its judged
+    line: versions + first-device identity, no config."""
+    out = {"versions": runtime_versions()}
+    devs = device_inventory()
+    if devs:
+        out["device"] = devs[0]
+        out["n_devices"] = len(devs)
+    return out

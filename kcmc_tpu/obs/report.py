@@ -1,0 +1,354 @@
+"""`kcmc_tpu report`: render a human-readable run report.
+
+Consumes either artifact a run leaves behind:
+
+* a frame-records JSONL (`--frame-records PATH`) — header manifest,
+  per-frame quality records, optional run summary line;
+* a transforms `.npz` (`correct --transforms PATH`) — per-frame
+  diagnostic arrays plus the JSON-encoded `timing`/`robustness`
+  payloads the CLI embeds.
+
+and renders: the manifest line, the stage/stall table (totals, counts,
+per-stage means — the `StageTimer` payload), frame-quality percentiles,
+the worst-N frames by consensus support, and the robustness-ladder
+summary. Pure stdlib + numpy: auditing a run must not require an
+accelerator stack.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+# Per-frame metrics the percentile table covers, in display order.
+_METRICS = (
+    ("n_keypoints", "keypoints"),
+    ("n_matches", "matches"),
+    ("n_inliers", "inliers"),
+    ("inlier_ratio", "inlier_ratio"),
+    ("rms_residual_px", "residual_px"),
+    ("template_corr", "template_corr"),
+    ("coverage", "coverage"),
+)
+_PCTS = (5, 25, 50, 75, 95)
+
+
+def load_run(path: str) -> dict:
+    """Normalize either artifact into
+    {manifest, records: [dict], timing, robustness, source}."""
+    p = str(path)
+    if p.endswith(".npz"):
+        return _load_npz(p)
+    return _load_jsonl(p)
+
+
+def _load_jsonl(path: str) -> dict:
+    from kcmc_tpu.obs.records import read_jsonl
+
+    header, records, summary = read_jsonl(path)
+    out = {
+        "source": path,
+        "manifest": (header or {}).get("manifest"),
+        "records": records,
+        "timing": (summary or {}).get("timing"),
+        "robustness": (summary or {}).get("robustness"),
+    }
+    if summary is None:
+        out["incomplete"] = True  # killed run: no summary line
+    elif "error" in summary:
+        out["error"] = summary["error"]
+    return out
+
+
+def _load_npz(path: str) -> dict:
+    with np.load(path, allow_pickle=False) as data:
+        keys = set(data.files)
+
+        def _json_scalar(key):
+            if key not in keys:
+                return None
+            try:
+                return json.loads(str(data[key]))
+            except (json.JSONDecodeError, ValueError):
+                return None
+
+        n = 0
+        for k in ("n_inliers", "n_matches", "n_keypoints", "rms_residual"):
+            if k in keys:
+                n = len(data[k])
+                break
+        cols = {
+            k: np.asarray(data[k])
+            for k in (
+                "n_keypoints", "n_matches", "n_inliers", "rms_residual",
+                "template_corr", "coverage", "warp_ok", "warp_rescued",
+                "frames_failed",
+            )
+            if k in keys
+        }
+        timing = _json_scalar("timing")
+        robustness = _json_scalar("robustness")
+        manifest = _json_scalar("manifest")
+    records = []
+    for i in range(n):
+        nm = int(cols["n_matches"][i]) if "n_matches" in cols else 0
+        ni = int(cols["n_inliers"][i]) if "n_inliers" in cols else 0
+        rec = {
+            "frame": i,
+            "n_matches": nm,
+            "n_inliers": ni,
+            "inlier_ratio": ni / max(nm, 1),
+        }
+        if "n_keypoints" in cols:
+            rec["n_keypoints"] = int(cols["n_keypoints"][i])
+        if "rms_residual" in cols:
+            rec["rms_residual_px"] = float(cols["rms_residual"][i])
+        if "template_corr" in cols:
+            rec["template_corr"] = float(cols["template_corr"][i])
+        if "coverage" in cols:
+            rec["coverage"] = float(cols["coverage"][i])
+        if "warp_ok" in cols:
+            rec["warp_ok"] = bool(cols["warp_ok"][i])
+        if "warp_rescued" in cols:
+            rec["warp_rescued"] = bool(cols["warp_rescued"][i])
+        if "frames_failed" in cols:
+            rec["failed"] = bool(cols["frames_failed"][i])
+        records.append(rec)
+    return {
+        "source": path,
+        "manifest": manifest,
+        "records": records,
+        "timing": timing,
+        "robustness": robustness,
+    }
+
+
+def _metric_values(records: list[dict], key: str) -> np.ndarray:
+    vals = [
+        r[key]
+        for r in records
+        if r.get(key) is not None and np.isfinite(r[key])
+    ]
+    return np.asarray(vals, np.float64)
+
+
+def _fmt(v: float) -> str:
+    if abs(v) >= 1000 or v == int(v):
+        return f"{v:.0f}"
+    return f"{v:.3f}"
+
+
+def _stage_table(timing: dict) -> list[str]:
+    lines = []
+    stages = timing.get("stages_s", {})
+    counts = timing.get("stage_counts", {})
+    means = timing.get("stage_mean_s", {})
+    if stages:
+        lines.append("Stages:")
+        lines.append(
+            f"  {'stage':<20} {'total_s':>10} {'count':>7} {'mean_s':>10}"
+        )
+        for name, total in sorted(stages.items(), key=lambda kv: -kv[1]):
+            c = counts.get(name, 0)
+            m = means.get(name, total / c if c else 0.0)
+            lines.append(
+                f"  {name:<20} {total:>10.3f} {c:>7d} {m:>10.4f}"
+            )
+        lines.append(f"  {'TOTAL':<20} {timing.get('total_s', 0.0):>10.3f}")
+    stalls = timing.get("stalls_s", {})
+    if stalls:
+        sc = timing.get("stall_counts", {})
+        total = timing.get("total_s") or 0.0
+        lines.append("Pipeline stalls (consumer blocked inside stages):")
+        lines.append(
+            f"  {'seam':<20} {'total_s':>10} {'count':>7} {'of run':>7}"
+        )
+        for name, s in sorted(stalls.items(), key=lambda kv: -kv[1]):
+            frac = f"{100 * s / total:.1f}%" if total else "-"
+            lines.append(
+                f"  {name:<20} {s:>10.3f} {sc.get(name, 0):>7d} {frac:>7}"
+            )
+    fps = timing.get("frames_per_sec")
+    if fps:
+        lines.append(f"Throughput: {fps:.1f} frames/sec")
+    return lines
+
+
+def render_report(run: dict, top: int = 10) -> str:
+    """The human-readable report text."""
+    lines = [f"# kcmc run report — {run.get('source', '?')}"]
+    man = run.get("manifest")
+    if man:
+        v = man.get("versions", {})
+        cfg = man.get("config", {})
+        bits = []
+        if cfg.get("model"):
+            bits.append(f"model={cfg['model']}")
+        if man.get("backend"):
+            bits.append(f"backend={man['backend']}")
+        if man.get("config_sha256"):
+            bits.append(f"config={man['config_sha256'][:12]}")
+        if v.get("kcmc_tpu"):
+            bits.append(f"kcmc_tpu {v['kcmc_tpu']}")
+        if v.get("jax"):
+            bits.append(f"jax {v['jax']}")
+        rt = man.get("backend_runtime") or {}
+        devs = rt.get("devices") or []
+        if devs:
+            bits.append(
+                f"{len(devs)}x {devs[0].get('platform', '?')}"
+            )
+        if man.get("fault_plan"):
+            bits.append(f"fault_plan={man['fault_plan']!r}")
+        lines.append("Manifest: " + ", ".join(bits))
+    if run.get("incomplete"):
+        lines.append(
+            "NOTE: no run-summary line — the run did not close cleanly "
+            "(killed mid-run?); records below cover what was flushed."
+        )
+    if run.get("error"):
+        lines.append(f"RUN FAILED: {run['error']}")
+
+    records = run.get("records") or []
+    n_failed = sum(1 for r in records if r.get("failed"))
+    n_rescued = sum(1 for r in records if r.get("warp_rescued"))
+    n_failover = sum(1 for r in records if r.get("failover"))
+    escalated = any(r.get("escalated") for r in records)
+    frame_bits = [f"Frames: {len(records)}"]
+    if n_failed:
+        frame_bits.append(f"failed={n_failed}")
+    if n_rescued:
+        frame_bits.append(f"warp_rescued={n_rescued}")
+    if n_failover:
+        frame_bits.append(f"failover={n_failover}")
+    if escalated:
+        frame_bits.append("warp ESCALATED")
+    lines.append(" ".join(frame_bits))
+
+    timing = run.get("timing")
+    if timing:
+        lines.append("")
+        lines.extend(_stage_table(timing))
+
+    if records:
+        lines.append("")
+        lines.append("Frame quality percentiles:")
+        header = "  " + f"{'metric':<14}" + "".join(
+            f"{f'p{p}':>10}" for p in _PCTS
+        )
+        lines.append(header)
+        for key, label in _METRICS:
+            vals = _metric_values(records, key)
+            if len(vals) == 0:
+                continue
+            pcts = np.percentile(vals, _PCTS)
+            lines.append(
+                f"  {label:<14}" + "".join(f"{_fmt(p):>10}" for p in pcts)
+            )
+        worst = _worst_frames(records, top)
+        if worst:
+            lines.append("")
+            lines.append(
+                f"Worst {len(worst)} frames (by inlier support):"
+            )
+            lines.append(
+                f"  {'frame':>7} {'inliers':>8} {'ratio':>7} "
+                f"{'resid_px':>9}  flags"
+            )
+            for r in worst:
+                flags = ",".join(
+                    f
+                    for f in ("failed", "failover", "warp_rescued")
+                    if r.get(f)
+                ) or "-"
+                resid = r.get("rms_residual_px")
+                lines.append(
+                    f"  {r['frame']:>7} {r.get('n_inliers', 0):>8} "
+                    f"{(r.get('inlier_ratio') or 0):>7.3f} "
+                    f"{'-' if resid is None else f'{resid:9.3f}'}  {flags}"
+                )
+
+    rb = run.get("robustness")
+    if rb:
+        lines.append("")
+        lines.append(
+            "Robustness ladder: "
+            f"io_retries={rb.get('io_retries', 0)} "
+            f"device_retries={rb.get('device_retries', 0)} "
+            f"backend_failovers={rb.get('backend_failovers', 0)} "
+            f"failed_frames={rb.get('failed_frames', 0)} "
+            f"rescued_frames={rb.get('rescued_frames', 0)} "
+            f"faults_injected={rb.get('faults_injected', 0)}"
+        )
+        if rb.get("quarantined_parts"):
+            lines.append(
+                f"  quarantined checkpoint parts: {rb['quarantined_parts']}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def _worst_frames(records: list[dict], top: int) -> list[dict]:
+    """Failed frames first, then lowest inlier ratio, residual as the
+    tiebreak (descending badness)."""
+
+    def badness(r):
+        resid = r.get("rms_residual_px")
+        return (
+            0 if r.get("failed") else 1,
+            r.get("inlier_ratio") if r.get("inlier_ratio") is not None else 0,
+            -(resid if resid is not None else 0.0),
+        )
+
+    ranked = sorted(records, key=badness)
+    return ranked[: max(0, int(top))]
+
+
+def main(path: str, top: int = 10, as_json: bool = False) -> int:
+    import sys
+    import zipfile
+
+    try:
+        run = load_run(path)
+    except (
+        OSError,
+        ValueError,  # covers json.JSONDecodeError + np.load refusals
+        UnicodeDecodeError,
+        zipfile.BadZipFile,
+    ) as e:
+        print(
+            f"kcmc report: {path!r} is not a readable run artifact "
+            f"(expected a --frame-records JSONL or a `correct "
+            f"--transforms` .npz): {e}",
+            file=sys.stderr,
+        )
+        return 2
+    if as_json:
+        print(json.dumps(_json_summary(run, top)))
+    else:
+        print(render_report(run, top=top), end="")
+    return 0
+
+
+def _json_summary(run: dict, top: int) -> dict:
+    records = run.get("records") or []
+    metrics = {}
+    for key, label in _METRICS:
+        vals = _metric_values(records, key)
+        if len(vals):
+            metrics[label] = {
+                f"p{p}": float(v)
+                for p, v in zip(_PCTS, np.percentile(vals, _PCTS))
+            }
+    return {
+        "source": run.get("source"),
+        "n_frames": len(records),
+        "manifest": run.get("manifest"),
+        "timing": run.get("timing"),
+        "robustness": run.get("robustness"),
+        "metrics": metrics,
+        "worst_frames": [
+            r.get("frame") for r in _worst_frames(records, top)
+        ],
+        "incomplete": bool(run.get("incomplete")),
+    }

@@ -1,0 +1,219 @@
+"""Per-run observability coordinator.
+
+One object owns the run's tracer, frame-record stream, and heartbeat so
+the orchestrator (`corrector.py`) carries a single nullable handle:
+`RunTelemetry.begin(...)` returns None when every observability knob is
+off — the disabled cost is one `is not None` check per batch — and
+otherwise wires:
+
+* the run manifest (obs/manifest.py), embedded in both artifacts;
+* a `Tracer` attached to the run's `StageTimer` (stage/stall spans)
+  and handed to the dispatch and writer seams;
+* a `FrameRecordStream` fed from the drain path (`note_batch`);
+* a `Heartbeat` narrating progress/stalls/robustness to stderr.
+
+`finish(timing)` stamps the final timing into the trace metadata and
+the records summary line; `close()` (idempotent, called from the
+orchestrator's `finally`) guarantees the heartbeat thread is joined
+and partial artifacts are flushed even when the run dies — a
+post-mortem trace of a crashed run is the whole point.
+"""
+
+from __future__ import annotations
+
+import time
+
+from kcmc_tpu.obs.manifest import build_manifest
+
+
+class RunTelemetry:
+    @classmethod
+    def begin(
+        cls, config, backend=None, backend_name=None, timer=None,
+        report=None, total=None,
+    ):
+        """Construct only when some observability surface is enabled;
+        None otherwise (the hot paths test one attribute). The enabled
+        predicate lives in `CorrectorConfig.observability_enabled` —
+        one definition for this gate and the orchestrator's."""
+        if not getattr(config, "observability_enabled", False):
+            return None
+        return cls(
+            config, backend=backend, backend_name=backend_name,
+            timer=timer, report=report, total=total,
+        )
+
+    def __init__(
+        self, config, backend=None, backend_name=None, timer=None,
+        report=None, total=None,
+    ):
+        self.config = config
+        self.report = report  # RobustnessReport (may be None)
+        self.timer = timer
+        self.total = total
+        self.frames_done = 0
+        self._t0 = time.perf_counter()
+        self._finished = False
+        self.manifest = build_manifest(
+            config=config, backend=backend, backend_name=backend_name
+        )
+        self.tracer = None
+        if getattr(config, "trace_path", None):
+            from kcmc_tpu.obs.trace import Tracer
+
+            self.tracer = Tracer(metadata={"manifest": self.manifest})
+            if timer is not None:
+                timer.tracer = self.tracer
+        self.records = None
+        if getattr(config, "frame_records_path", None):
+            from kcmc_tpu.obs.records import FrameRecordStream
+
+            self.records = FrameRecordStream(
+                config.frame_records_path,
+                manifest=self.manifest,
+                tracer=self.tracer,
+            )
+        self.heartbeat = None
+        if getattr(config, "heartbeat_s", 0) > 0:
+            from kcmc_tpu.obs.heartbeat import Heartbeat
+
+            self.heartbeat = Heartbeat(config.heartbeat_s, self._sample)
+            self.heartbeat.start()
+
+    def set_total(self, total: int) -> None:
+        self.total = int(total)
+
+    def resumed(self, done: int) -> None:
+        """The run restored `done` frames from a checkpoint: switch the
+        frame-records sink to append mode (the killed run's records are
+        the post-mortem — truncating them would destroy the artifact)
+        and mark the resume point on the trace."""
+        if self.records is not None:
+            self.records.mark_resume(done)
+        if self.tracer is not None:
+            self.tracer.instant(
+                "checkpoint_resume", cat="checkpoint",
+                args={"done": int(done)},
+            )
+
+    # -- drain-path hook ---------------------------------------------------
+
+    def note_batch(
+        self, first_frame: int, n: int, host: dict, escalated: bool = False
+    ) -> None:
+        """Record one drained batch: progress for the heartbeat, a
+        frames_done counter sample for the trace, and per-frame quality
+        records. `host` is the drained output dict (post-rescue)."""
+        self.frames_done += int(n)
+        if self.tracer is not None:
+            self.tracer.counter("frames_done", {"frames": self.frames_done})
+        if self.records is not None:
+            from kcmc_tpu.obs.records import records_from_batch
+
+            rep = self.report
+            failed = (
+                frozenset(rep.failed_frame_indices)
+                if rep is not None and rep.failed_frame_indices
+                else frozenset()
+            )
+            failover = (
+                frozenset(rep.failover_frame_indices)
+                if rep is not None
+                and getattr(rep, "failover_frame_indices", None)
+                else frozenset()
+            )
+            self.records.append(
+                records_from_batch(
+                    int(first_frame),
+                    host,
+                    model=self.config.model,
+                    n=int(n),
+                    failed=failed,
+                    failover=failover,
+                    escalated=escalated,
+                )
+            )
+
+    def checkpoint_saved(self, done: int) -> None:
+        if self.tracer is not None:
+            self.tracer.instant(
+                "checkpoint_save", cat="checkpoint", args={"done": int(done)}
+            )
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def _sample(self) -> str:
+        elapsed = max(time.perf_counter() - self._t0, 1e-9)
+        done = self.frames_done
+        total = f"/{self.total}" if self.total else ""
+        parts = [
+            f"{done}{total} frames",
+            f"{done / elapsed:.1f} fps",
+            f"{elapsed:.0f}s elapsed",
+        ]
+        timer = self.timer
+        if timer is not None and timer.stalls:
+            # dict() snapshot: this runs on the heartbeat thread while
+            # the consumer inserts stall keys; PyDict_Copy is atomic
+            # under the GIL, Python-level .items() iteration is not
+            stalls = dict(timer.stalls)
+            frac = {k: v / elapsed for k, v in stalls.items() if v > 0}
+            if frac:
+                top = sorted(frac.items(), key=lambda kv: -kv[1])[:3]
+                parts.append(
+                    "stalls "
+                    + " ".join(f"{k}={100 * v:.0f}%" for k, v in top)
+                )
+        rep = self.report
+        if rep is not None and rep.any():
+            parts.append(
+                f"retries io={rep.io_retries} dev={rep.device_retries} "
+                f"failovers={rep.backend_failovers} "
+                f"failed={rep.failed_frames}"
+            )
+        return ", ".join(parts)
+
+    # -- teardown ----------------------------------------------------------
+
+    def finish(self, timing: dict | None = None, error: str | None = None):
+        """Stop the heartbeat and flush both artifacts. Idempotent —
+        the orchestrator calls it with the final timing on success and
+        again (a no-op) from its `finally`; on the error path the
+        `finally` call flushes whatever was collected."""
+        if self._finished:
+            return
+        self._finished = True
+        if self.heartbeat is not None:
+            self.heartbeat.stop()
+        summary: dict = {"frames": self.frames_done}
+        if timing is not None:
+            summary["timing"] = timing
+        if self.report is not None and self.report.any():
+            summary["robustness"] = self.report.as_dict()
+        if error is not None:
+            summary["error"] = error
+        if self.records is not None:
+            try:
+                self.records.close(summary=summary)
+            except Exception:
+                if error is None:  # don't mask the run's own failure
+                    raise
+        if self.tracer is not None:
+            if timing is not None:
+                self.tracer.metadata["timing"] = timing
+            if error is not None:
+                self.tracer.metadata["error"] = error
+            try:
+                self.tracer.write(self.config.trace_path)
+            except Exception:
+                if error is None:  # don't mask the run's own failure
+                    raise
+
+    def close(self, exc: BaseException | None = None) -> None:
+        """`finally`-path teardown: flush with the error recorded when
+        the run is unwinding, no-op when finish() already ran."""
+        if self._finished:
+            return
+        self.finish(
+            timing=None, error=repr(exc) if exc is not None else "unfinished"
+        )

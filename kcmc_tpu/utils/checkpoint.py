@@ -14,7 +14,7 @@ import hashlib
 import json
 import os
 import tempfile
-import warnings
+from kcmc_tpu.obs.log import advise
 
 import numpy as np
 
@@ -155,11 +155,10 @@ def load_stream_checkpoint(path: str, fault_plan=None, report=None):
             extra = {k: z[k] for k in z.files if k != "meta"}
     except Exception as e:
         q = _quarantine(path)
-        warnings.warn(
+        advise(
             f"kcmc: resume checkpoint {path} is corrupt "
             f"({type(e).__name__}: {e}); quarantined it"
             f"{f' to {q}' if q else ''} and restarting from scratch",
-            RuntimeWarning,
             stacklevel=2,
         )
         if report is not None and q:
@@ -194,33 +193,30 @@ def load_stream_checkpoint(path: str, fault_plan=None, report=None):
                 and history[p - 1].get("writer") is not None
             )
             if rewind and "template" in meta.get("arrays", {}):
-                warnings.warn(
+                advise(
                     f"kcmc: checkpoint part {pp} is corrupt "
                     f"({type(e).__name__}: {e}); quarantined it, but a "
                     "rolling-template run cannot rewind past it (the "
                     "stored template matches only the final cursor) — "
                     "restarting from scratch",
-                    RuntimeWarning,
                     stacklevel=2,
                 )
                 return None
             if not rewind:
-                warnings.warn(
+                advise(
                     f"kcmc: checkpoint part {pp} is corrupt "
                     f"({type(e).__name__}: {e}); quarantined it and "
                     "restarting from scratch (no good prefix to resume "
                     "from)",
-                    RuntimeWarning,
                     stacklevel=2,
                 )
                 return None
             prev = history[p - 1]
-            warnings.warn(
+            advise(
                 f"kcmc: checkpoint part {pp} is corrupt "
                 f"({type(e).__name__}: {e}); quarantined it and "
                 f"resuming from the last good chunk (frame "
                 f"{int(prev['done'])})",
-                RuntimeWarning,
                 stacklevel=2,
             )
             meta = dict(

@@ -124,6 +124,9 @@ class RobustnessReport:
     device_retries: int = 0  # device-batch attempts beyond the first
     backend_failovers: int = 0  # batches re-run on the failover backend
     failed_frame_indices: list = dataclasses.field(default_factory=list)
+    # frames recovered on the failover backend (per-frame attribution
+    # for the observability layer's FrameRecord `failover` flag)
+    failover_frame_indices: list = dataclasses.field(default_factory=list)
     rescued_frames: int = 0  # failed frames trajectory-interpolated
     quarantined_parts: list = dataclasses.field(default_factory=list)
     faults_injected: int = 0  # faults a FaultPlan actually fired
@@ -149,6 +152,7 @@ class RobustnessReport:
             "io_retries": int(self.io_retries),
             "device_retries": int(self.device_retries),
             "backend_failovers": int(self.backend_failovers),
+            "failover_frames": len(self.failover_frame_indices),
             "failed_frames": int(self.failed_frames),
             "rescued_frames": int(self.rescued_frames),
             "quarantined_parts": [str(p) for p in self.quarantined_parts],
@@ -180,6 +184,10 @@ class StageTimer:
     counts: dict = dataclasses.field(default_factory=dict)
     stalls: dict = dataclasses.field(default_factory=dict)
     stall_counts: dict = dataclasses.field(default_factory=dict)
+    # Optional obs.trace.Tracer: stage/stall intervals double as spans
+    # in the exported Chrome trace. None (the default) costs one
+    # attribute check per interval — observability off stays free.
+    tracer: object = None
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -190,6 +198,8 @@ class StageTimer:
             dt = time.perf_counter() - t0
             self.totals[name] = self.totals.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
+            if self.tracer is not None:
+                self.tracer.complete(name, t0, dt, cat="stage")
 
     @contextlib.contextmanager
     def stall(self, name: str):
@@ -198,13 +208,29 @@ class StageTimer:
         try:
             yield
         finally:
-            self.add_stall(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.stalls[name] = self.stalls.get(name, 0.0) + dt
+            self.stall_counts[name] = self.stall_counts.get(name, 0) + 1
+            if self.tracer is not None:
+                self.tracer.complete(name, t0, dt, cat="stall")
 
-    def add_stall(self, name: str, seconds: float, count: int = 1) -> None:
-        """Accumulate stall seconds measured elsewhere (e.g. the
-        background writer's own backpressure counter)."""
+    def add_stall(
+        self, name: str, seconds: float, count: int = 1, trace: bool = True
+    ) -> None:
+        """Accumulate stall seconds measured elsewhere. With `trace`
+        (the default, for callers reporting a wait that JUST ended) an
+        attached tracer gets a span back-dated to end now; pass
+        trace=False for end-of-run aggregates whose individual waits
+        were already traced at source (e.g. the background writer's
+        own backpressure/flush spans) — a back-dated total would
+        double-count them and park a bogus stall block at run end."""
         self.stalls[name] = self.stalls.get(name, 0.0) + float(seconds)
         self.stall_counts[name] = self.stall_counts.get(name, 0) + count
+        if trace and self.tracer is not None and seconds > 0:
+            self.tracer.complete(
+                name, time.perf_counter() - float(seconds), float(seconds),
+                cat="stall",
+            )
 
     @property
     def total_seconds(self) -> float:
@@ -213,6 +239,16 @@ class StageTimer:
     def report(self, n_frames: int | None = None) -> dict:
         out = {
             "stages_s": dict(self.totals),
+            # stage_counts/stage_mean_s: `counts` is accumulated per
+            # stage() entry but was never reported — a stage dominated
+            # by many cheap entries vs few expensive ones is a
+            # different problem, and only the pair disambiguates.
+            "stage_counts": dict(self.counts),
+            "stage_mean_s": {
+                k: v / self.counts[k]
+                for k, v in self.totals.items()
+                if self.counts.get(k)
+            },
             "total_s": self.total_seconds,
         }
         if self.stalls:

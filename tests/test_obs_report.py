@@ -1,0 +1,202 @@
+"""`kcmc_tpu report` + CLI observability flags: round-trip on a
+synthetic run, both artifact flavors (frame-records JSONL and
+transforms npz), verbosity flags, and post-mortem artifacts from a
+failed run."""
+
+import json
+
+import numpy as np
+import pytest
+
+from kcmc_tpu.__main__ import main as cli_main
+from kcmc_tpu.obs import log as obs_log
+from kcmc_tpu.obs.report import load_run, render_report
+
+
+@pytest.fixture(autouse=True)
+def _restore_logging():
+    # cli_main configures process-global advisory routing; undo so
+    # later pytest.warns-based suites keep their contracts
+    yield
+    obs_log.reset_cli_logging()
+
+
+@pytest.fixture
+def smoke_tif(tmp_path):
+    from kcmc_tpu.io.tiff import write_stack
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    data = make_drift_stack(
+        n_frames=16, shape=(64, 64), model="translation", max_drift=4.0,
+        seed=0,
+    )
+    path = tmp_path / "smoke.tif"
+    write_stack(
+        str(path), np.clip(data.stack * 40000, 0, 65535).astype(np.uint16)
+    )
+    return str(path)
+
+
+def _run_correct(tmp_path, smoke_tif, *extra):
+    args = [
+        "correct", smoke_tif, "--backend", "numpy", "--batch-size", "8",
+        "--transforms", str(tmp_path / "t.npz"),
+        "--trace", str(tmp_path / "t.json"),
+        "--frame-records", str(tmp_path / "f.jsonl"),
+        *extra,
+    ]
+    assert cli_main(args) == 0
+
+
+def test_cli_correct_produces_valid_artifacts(tmp_path, smoke_tif, capsys):
+    _run_correct(tmp_path, smoke_tif, "--quality")
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # satellite: the CLI summary now carries stage counts + means
+    assert summary["stages"]["register_batches"]["count"] >= 1
+    assert summary["stages"]["register_batches"]["mean_s"] > 0
+    # trace: Perfetto-loadable, schema-complete
+    trace = json.loads((tmp_path / "t.json").read_text())
+    for ev in trace["traceEvents"]:
+        assert {"ts", "dur", "ph", "tid"} <= set(ev)
+    assert trace["metadata"]["manifest"]["backend"] == "numpy"
+    # records: one per frame with ratio + residual (acceptance)
+    lines = [
+        json.loads(line)
+        for line in (tmp_path / "f.jsonl").read_text().splitlines()
+    ]
+    recs = [o for o in lines if "frame" in o and "kind" not in o]
+    assert len(recs) == 16
+    assert all(
+        r["inlier_ratio"] is not None and r["rms_residual_px"] is not None
+        for r in recs
+    )
+
+
+def test_report_roundtrip_jsonl_and_npz(tmp_path, smoke_tif, capsys):
+    _run_correct(tmp_path, smoke_tif, "--quality")
+    capsys.readouterr()
+
+    assert cli_main(["report", str(tmp_path / "f.jsonl"), "--top", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "kcmc run report" in out
+    assert "Stages:" in out and "register_batches" in out
+    assert "Frame quality percentiles:" in out
+    assert "inlier_ratio" in out and "residual_px" in out
+    assert "Worst 3 frames" in out
+
+    assert cli_main(["report", str(tmp_path / "t.npz")]) == 0
+    out_npz = capsys.readouterr().out
+    assert "Frame quality percentiles:" in out_npz
+    assert "Robustness ladder:" in out_npz
+
+    assert cli_main(["report", str(tmp_path / "f.jsonl"), "--json"]) == 0
+    js = json.loads(capsys.readouterr().out)
+    assert js["n_frames"] == 16
+    assert js["metrics"]["inlier_ratio"]["p50"] > 0
+    assert js["timing"]["stages_s"]
+
+
+def test_chaos_run_records_stay_complete(tmp_path, smoke_tif, capsys):
+    # chaos run: a transient device fault is retried away; the frame
+    # records still cover every frame and the summary line carries the
+    # robustness counters
+    _run_correct(
+        tmp_path, smoke_tif,
+        "--inject-faults", "device:step=1:transient",
+    )
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["robustness"]["device_retries"] >= 1
+    _, records, jsum = _read_jsonl(tmp_path / "f.jsonl")
+    assert len(records) == 16
+    assert jsum["robustness"]["device_retries"] >= 1
+
+
+def test_failover_frames_flagged_in_records(tmp_path):
+    # retries exhausted -> numpy failover: the recovered frames carry
+    # the per-frame `failover` flag in the record stream
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    data = make_drift_stack(
+        n_frames=12, shape=(64, 64), model="translation", seed=0
+    )
+    mc = MotionCorrector(
+        model="translation", backend="numpy", batch_size=4,
+        frame_records_path=str(tmp_path / "fo.jsonl"),
+        fault_plan="device:step=1:transient", retry_attempts=1,
+        failover_backend="jax",
+    )
+    with pytest.warns(RuntimeWarning, match="failover backend"):
+        res = mc.correct(data.stack)
+    assert res.robustness["backend_failovers"] == 1
+    assert res.robustness["failover_frames"] == 4
+    _, records, _ = _read_jsonl(tmp_path / "fo.jsonl")
+    flagged = [r["frame"] for r in records if r["failover"]]
+    assert flagged == [4, 5, 6, 7]  # the failed batch's frames
+
+
+def _read_jsonl(path):
+    from kcmc_tpu.obs.records import read_jsonl
+
+    return read_jsonl(str(path))
+
+
+def test_report_on_incomplete_records(tmp_path):
+    # a killed run leaves no summary line; report degrades gracefully
+    (tmp_path / "dead.jsonl").write_text(
+        json.dumps({"kind": "kcmc_frame_records", "version": 1})
+        + "\n"
+        + json.dumps(
+            {
+                "frame": 0, "model": "translation", "n_keypoints": 9,
+                "n_matches": 8, "n_inliers": 7, "inlier_ratio": 0.875,
+                "rms_residual_px": 0.2, "warp_ok": True, "failed": False,
+                "failover": False, "escalated": False,
+            }
+        )
+        + "\n"
+    )
+    run = load_run(str(tmp_path / "dead.jsonl"))
+    assert run["incomplete"]
+    text = render_report(run)
+    assert "did not close cleanly" in text
+    assert "Frame quality percentiles:" in text
+
+
+def test_failed_run_flushes_postmortem_artifacts(tmp_path):
+    """A run that dies mid-stream still leaves a readable trace and
+    records file with the error recorded (the post-mortem use case)."""
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    data = make_drift_stack(
+        n_frames=16, shape=(64, 64), model="translation", seed=0
+    )
+    mc = MotionCorrector(
+        model="translation", backend="numpy", batch_size=4,
+        trace_path=str(tmp_path / "post.json"),
+        frame_records_path=str(tmp_path / "post.jsonl"),
+        # fatal injected fault: no retries, no failover, no mark-failed
+        fault_plan="device:step=2:raise",
+        retry_attempts=1, failover_backend=None, degrade_mark_failed=False,
+    )
+    with pytest.raises(Exception, match="injected"):
+        mc.correct(data.stack)
+    trace = json.loads((tmp_path / "post.json").read_text())
+    assert "error" in trace["metadata"]
+    _, records, summary = _read_jsonl(tmp_path / "post.jsonl")
+    assert summary is not None and "error" in summary
+    assert len(records) >= 4  # batches drained before the fault
+
+
+def test_verbose_flag_routes_advisories(tmp_path, smoke_tif, capsys):
+    # -v runs INFO-level logging; CLI mode routes advise() to stderr
+    # logging instead of warnings (stdout stays pure JSON)
+    assert (
+        cli_main(["-v", "correct", smoke_tif, "--backend", "numpy",
+                  "--batch-size", "8"])
+        == 0
+    )
+    out = capsys.readouterr().out
+    json.loads(out.strip().splitlines()[-1])  # machine-readable stdout
+    assert obs_log.cli_logging_active()

@@ -1,0 +1,412 @@
+"""Observability subsystem: golden-schema tests for the Chrome-trace
+export and the per-frame records JSONL, the run manifest, heartbeat
+lifecycle, StageTimer's stage_counts/mean reporting, and the advisory
+warning-routing seam (kcmc_tpu/obs; ISSUE 4)."""
+
+import io
+import json
+import logging
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from kcmc_tpu.config import CorrectorConfig
+from kcmc_tpu.obs import log as obs_log
+from kcmc_tpu.obs.heartbeat import Heartbeat
+from kcmc_tpu.obs.manifest import build_manifest, config_digest
+from kcmc_tpu.obs.records import (
+    REQUIRED_RECORD_KEYS,
+    FrameRecordStream,
+    read_jsonl,
+    records_from_batch,
+)
+from kcmc_tpu.obs.trace import Tracer
+from kcmc_tpu.utils.metrics import StageTimer
+
+
+@pytest.fixture(autouse=True)
+def _restore_logging():
+    """Routing state is process-global; tests that configure CLI
+    logging must not leak it into later pytest.warns-based suites."""
+    yield
+    obs_log.reset_cli_logging()
+
+
+def _small_run(tmp_path, n_frames=12, **obs_kw):
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    data = make_drift_stack(
+        n_frames=n_frames, shape=(64, 64), model="translation",
+        max_drift=4.0, seed=0,
+    )
+    mc = MotionCorrector(
+        model="translation", backend="numpy", batch_size=4, **obs_kw
+    )
+    return mc.correct(data.stack)
+
+
+# -- Chrome trace export ----------------------------------------------------
+
+
+def test_trace_export_schema(tmp_path):
+    trace_path = str(tmp_path / "trace.json")
+    _small_run(tmp_path, trace_path=trace_path)
+    trace = json.loads((tmp_path / "trace.json").read_text())
+    evs = trace["traceEvents"]
+    assert len(evs) > 0
+    # the golden schema: every event carries ts/dur/ph/tid (and pid/name)
+    for ev in evs:
+        assert {"ts", "dur", "ph", "tid", "pid", "name"} <= set(ev), ev
+        assert ev["ph"] in ("X", "i", "C", "M")
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+    names = {e["name"] for e in evs}
+    # stage spans, dispatch-seam spans, progress counters, thread names
+    assert "prepare_reference" in names
+    assert "register_batches" in names
+    assert "dispatch_batch" in names
+    assert any(e["ph"] == "C" and e["name"] == "frames_done" for e in evs)
+    assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in evs)
+    # complete spans nest inside the run: durations are microseconds
+    reg = next(e for e in evs if e["name"] == "register_batches")
+    disp = [e for e in evs if e["name"] == "dispatch_batch"]
+    assert len(disp) == 3  # 12 frames / batch 4
+    assert sum(d["dur"] for d in disp) <= reg["dur"] * 1.05
+    # manifest + final timing ride in the metadata
+    assert trace["metadata"]["manifest"]["kind"] == "kcmc_run_manifest"
+    assert "stages_s" in trace["metadata"]["timing"]
+
+
+def test_tracer_threads_and_counters():
+    tr = Tracer()
+    with tr.span("main_work", cat="stage"):
+        pass
+
+    def worker():
+        with tr.span("worker_work", cat="writer"):
+            pass
+
+    t = threading.Thread(target=worker, name="bg-worker")
+    t.start()
+    t.join()
+    tr.counter("frames_done", {"frames": 7})
+    tr.instant("checkpoint_save", args={"done": 4})
+    evs = tr.events()
+    tids = {e["tid"] for e in evs if e["ph"] == "X"}
+    assert len(tids) == 2  # two threads, two tracks
+    meta = {
+        e["args"]["name"] for e in evs if e["ph"] == "M"
+    }
+    assert "bg-worker" in meta
+    # everything serializes to strict JSON
+    json.dumps(tr.to_json())
+
+
+# -- frame records JSONL ----------------------------------------------------
+
+
+def test_frame_records_schema(tmp_path):
+    rec_path = str(tmp_path / "frames.jsonl")
+    res = _small_run(
+        tmp_path, n_frames=12, frame_records_path=rec_path,
+        quality_metrics=True,
+    )
+    lines = (tmp_path / "frames.jsonl").read_text().splitlines()
+    objs = [json.loads(line) for line in lines]  # every line: valid JSON
+    header, records, summary = objs[0], objs[1:-1], objs[-1]
+    assert header["kind"] == "kcmc_frame_records"
+    assert header["manifest"]["config_sha256"]
+    assert summary["kind"] == "kcmc_run_summary"
+    assert summary["frames"] == 12
+    assert "stages_s" in summary["timing"]
+    assert len(records) == 12
+    for i, rec in enumerate(records):
+        assert set(REQUIRED_RECORD_KEYS) <= set(rec), rec
+        assert rec["frame"] == i  # frame order, one record per frame
+        assert rec["model"] == "translation"
+        assert rec["inlier_ratio"] is not None
+        assert rec["rms_residual_px"] is not None
+        assert "template_corr" in rec  # quality_metrics ran
+    # records agree with the in-memory diagnostics
+    assert [r["n_inliers"] for r in records] == [
+        int(v) for v in res.diagnostics["n_inliers"]
+    ]
+
+
+def test_records_nan_becomes_null():
+    recs = records_from_batch(
+        0,
+        {
+            "n_keypoints": np.array([5]),
+            "n_matches": np.array([0]),
+            "n_inliers": np.array([0]),
+            "rms_residual": np.array([np.nan]),
+            "template_corr": np.array([np.nan]),
+        },
+        model="affine",
+    )
+    assert recs[0]["rms_residual_px"] is None
+    assert recs[0]["template_corr"] is None
+    json.dumps(recs, allow_nan=False)  # strict-JSON clean
+
+
+def test_records_stream_backpressure_and_torn_tail(tmp_path):
+    path = str(tmp_path / "r.jsonl")
+    stream = FrameRecordStream(path, manifest={"m": 1}, depth=2)
+    for lo in range(0, 64, 4):
+        stream.append(
+            records_from_batch(
+                lo, {"n_inliers": np.arange(lo, lo + 4)}, model="t"
+            )
+        )
+    stream.close(summary={"frames": 64})
+    header, records, summary = read_jsonl(path)
+    assert header["manifest"] == {"m": 1}
+    assert [r["frame"] for r in records] == list(range(64))  # ordered
+    assert summary["frames"] == 64
+    # torn tail line (killed run) parses without the summary
+    txt = (tmp_path / "r.jsonl").read_text().splitlines()
+    (tmp_path / "torn.jsonl").write_text(
+        "\n".join(txt[:-1]) + '\n{"frame": 99, "n_in'
+    )
+    _, records2, summary2 = read_jsonl(str(tmp_path / "torn.jsonl"))
+    assert summary2 is None
+    assert len(records2) == 64
+
+
+def test_records_stream_resume_appends_not_truncates(tmp_path):
+    """A checkpoint-resumed run must keep the killed run's records up
+    to the resume cursor (they ARE the post-mortem), prune the tail the
+    replay re-emits (drains outrun checkpoint saves), and append; a
+    fresh run over the same path truncates as before."""
+    path = str(tmp_path / "r.jsonl")
+    first = FrameRecordStream(path, manifest={"m": 1})
+    first.append(
+        records_from_batch(0, {"n_inliers": np.arange(8)}, model="t")
+    )
+    first.close()  # killed run: no summary line
+    # simulate the kill tearing the last line mid-write
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"frame": 8, "n_in')
+    # checkpoint saved at frame 4 but frames 0..7 had drained: the
+    # resumed run replays 4..11, so stale records 4..7 must be pruned
+    resumed = FrameRecordStream(path, manifest={"m": 1})
+    resumed.mark_resume(4)
+    resumed.append(
+        records_from_batch(4, {"n_inliers": np.arange(8)}, model="t")
+    )
+    resumed.close(summary={"frames": 12})
+    header, records, summary = read_jsonl(path)
+    assert header["manifest"] == {"m": 1}
+    # one record per frame, no duplicates across the resume seam
+    assert [r["frame"] for r in records] == list(range(12))
+    assert summary["frames"] == 12
+    raw = [json.loads(line) for line in open(path)]  # torn line pruned
+    assert any(o.get("kind") == "kcmc_run_resume" for o in raw)
+    # without mark_resume the same path truncates (fresh run semantics)
+    fresh = FrameRecordStream(path, manifest={"m": 2})
+    fresh.append(
+        records_from_batch(0, {"n_inliers": np.arange(2)}, model="t")
+    )
+    fresh.close(summary={"frames": 2})
+    header3, records3, _ = read_jsonl(path)
+    assert header3["manifest"] == {"m": 2}
+    assert len(records3) == 2
+
+
+# -- manifest ---------------------------------------------------------------
+
+
+def test_manifest_contents_and_config_hash():
+    cfg = CorrectorConfig(model="affine")
+    man = build_manifest(config=cfg, backend_name="numpy")
+    assert man["kind"] == "kcmc_run_manifest"
+    assert man["backend"] == "numpy"
+    assert man["config"]["model"] == "affine"
+    assert man["versions"]["kcmc_tpu"]
+    assert man["versions"]["python"]
+    json.dumps(man)  # JSON-safe throughout
+    # the digest is deterministic and config-sensitive
+    _, d1 = config_digest(cfg)
+    _, d2 = config_digest(CorrectorConfig(model="affine"))
+    _, d3 = config_digest(CorrectorConfig(model="rigid"))
+    assert d1 == d2 != d3
+    assert man["config_sha256"] == d1
+
+
+def test_manifest_records_backend_runtime():
+    from kcmc_tpu.backends import get_backend
+
+    be = get_backend("numpy", CorrectorConfig())
+    man = build_manifest(config=be.config, backend=be, backend_name="numpy")
+    assert man["backend_runtime"]["backend"] == "numpy"
+    assert man["backend_runtime"]["numpy"] == np.__version__
+
+
+# -- heartbeat --------------------------------------------------------------
+
+
+def test_heartbeat_lifecycle_no_thread_leak():
+    before = threading.active_count()
+    got = []
+    hb = Heartbeat(0.02, lambda: "beat", emit=got.append)
+    hb.start()
+    hb.start()  # idempotent: no second thread
+    deadline = time.monotonic() + 5.0
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.01)
+    hb.stop()
+    assert got and got[0] == "beat"
+    assert hb.beats >= 1
+    assert not hb.running
+    hb.stop()  # idempotent
+    assert threading.active_count() == before
+
+
+def test_heartbeat_sampler_failure_mutes_not_raises():
+    got = []
+
+    def bad_sample():
+        raise RuntimeError("boom")
+
+    hb = Heartbeat(0.01, bad_sample, emit=got.append)
+    with hb:
+        time.sleep(0.1)
+    assert not hb.running
+    assert len(got) == 1  # one diagnostic, then muted
+    assert "boom" in got[0]
+
+
+def test_heartbeat_rejects_bad_interval():
+    with pytest.raises(ValueError, match="positive"):
+        Heartbeat(0.0, lambda: "x")
+    with pytest.raises(ValueError, match="heartbeat_s"):
+        CorrectorConfig(heartbeat_s=-1.0)
+
+
+def test_heartbeat_during_run_emits_progress(tmp_path, monkeypatch):
+    got = []
+    import kcmc_tpu.obs.heartbeat as hb_mod
+
+    monkeypatch.setattr(hb_mod, "_default_emit", got.append)
+    _small_run(tmp_path, n_frames=12, heartbeat_s=0.01)
+    assert got  # beat at least once during the run
+    assert any("frames" in m and "fps" in m for m in got)
+    # run teardown joined the heartbeat thread
+    assert not any(
+        t.name == "kcmc-heartbeat" for t in threading.enumerate()
+    )
+
+
+# -- StageTimer reporting (satellite: counts were collected, never
+#    reported) --------------------------------------------------------------
+
+
+def test_stage_timer_reports_counts_and_means():
+    t = StageTimer()
+    for _ in range(3):
+        with t.stage("detect"):
+            time.sleep(0.001)
+    with t.stage("warp"):
+        pass
+    rep = t.report(n_frames=4)
+    assert rep["stage_counts"] == {"detect": 3, "warp": 1}
+    assert set(rep["stage_mean_s"]) == {"detect", "warp"}
+    assert rep["stage_mean_s"]["detect"] == pytest.approx(
+        rep["stages_s"]["detect"] / 3
+    )
+
+
+def test_stage_timer_emits_spans_into_tracer():
+    t = StageTimer()
+    t.tracer = Tracer()
+    with t.stage("detect"):
+        pass
+    with t.stall("drain_sync"):
+        pass
+    t.add_stall("writer_backpressure", 0.25)
+    evs = t.tracer.events()
+    by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert by_name["detect"]["cat"] == "stage"
+    assert by_name["drain_sync"]["cat"] == "stall"
+    # back-dated add_stall span carries the reported duration
+    assert by_name["writer_backpressure"]["dur"] == pytest.approx(
+        0.25e6, rel=0.01
+    )
+
+
+# -- advisory routing (satellite: logger + --verbose/--quiet) ---------------
+
+
+def test_advise_defaults_to_warnings():
+    with pytest.warns(RuntimeWarning, match="hello"):
+        obs_log.advise("hello")
+
+
+def test_advise_routes_to_logger_when_cli_configured():
+    stream = io.StringIO()
+    obs_log.setup_cli_logging(stream=stream)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning would raise
+        obs_log.advise("routed message")
+    assert "routed message" in stream.getvalue()
+    assert "WARNING" in stream.getvalue()
+    obs_log.reset_cli_logging()
+    with pytest.warns(RuntimeWarning, match="back to warnings"):
+        obs_log.advise("back to warnings")
+
+
+def test_cli_logging_levels():
+    stream = io.StringIO()
+    logger = obs_log.setup_cli_logging(verbose=1, stream=stream)
+    assert logger.level == logging.INFO
+    logger = obs_log.setup_cli_logging(quiet=1, stream=stream)
+    assert logger.level == logging.ERROR
+    assert len(
+        [h for h in logger.handlers if getattr(h, "_kcmc_cli_handler", False)]
+    ) == 1  # replaced, not stacked
+
+
+def test_ladder_warnings_still_warn_in_library_mode():
+    """The chaos suite's pytest.warns contracts ride the advise()
+    default path; spot-check one end to end."""
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    data = make_drift_stack(
+        n_frames=8, shape=(64, 64), model="translation", seed=0
+    )
+    mc = MotionCorrector(
+        model="translation", backend="numpy", batch_size=4,
+        fault_plan="device:step=1:transient", retry_attempts=1,
+        failover_backend=None,
+    )
+    with pytest.warns(RuntimeWarning, match="marking its"):
+        res = mc.correct(data.stack)
+    assert res.robustness["failed_frames"] == 4
+
+
+# -- disabled-by-default cost: no obs objects are constructed ---------------
+
+
+def test_observability_off_constructs_nothing(tmp_path):
+    res = _small_run(tmp_path, n_frames=8)
+    assert res.transforms is not None
+    # telemetry handle is cleared after every run, enabled or not
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    mc = MotionCorrector(model="translation", backend="numpy", batch_size=4)
+    mc.correct(make_drift_stack(n_frames=4, shape=(64, 64), seed=0).stack)
+    assert mc._telemetry is None
+    rec_path = tmp_path / "r.jsonl"
+    mc2 = MotionCorrector(
+        model="translation", backend="numpy", batch_size=4,
+        frame_records_path=str(rec_path),
+    )
+    mc2.correct(make_drift_stack(n_frames=4, shape=(64, 64), seed=0).stack)
+    assert mc2._telemetry is None  # @_telemetry_scope cleared it
+    assert rec_path.exists()
