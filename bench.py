@@ -245,6 +245,97 @@ def run_bench_streaming(
     }
 
 
+def run_bench_multichip(
+    n_frames: int, size: int, batch: int, n_devices: int,
+    smoke: bool = False,
+) -> dict:
+    """Mesh scaling: each contract config timed single-chip, then
+    sharded over the n-device frame-axis mesh (`mesh_devices=` — the
+    production config surface), with per-config scaling efficiency
+    fps_mesh / (n * fps_1chip). Smoke mode trims to the flagship config
+    so the CI guard (forced host devices) stays minutes, not hours."""
+    rows = [("translation", "translation", {})]
+    if not smoke:
+        rows += [
+            ("affine@2k", "affine", {
+                "max_keypoints": 4096, "n_blobs": 12000,
+                "sigma_range": (0.7, 1.4), "nms_size": 3,
+                "harris_window_sigma": 1.2, "cand_tile": 4,
+                "batch": 32,
+            }),
+            ("piecewise", "piecewise", {}),
+            ("homography", "homography", {}),
+        ]
+    configs = {}
+    for label, model, kw in rows:
+        b = kw.pop("batch", batch)
+        r1 = _run_with_retry(run_bench_device, n_frames, size, model, b, **kw)
+        rn = _run_with_retry(
+            run_bench_device, n_frames, size, model, b,
+            mesh_devices=n_devices, **kw,
+        )
+        configs[label] = _scaling_row(r1, rn, n_devices)
+        print(
+            f"[bench] multichip {label}: {rn['fps']:.1f} fps on "
+            f"{n_devices} devices vs {r1['fps']:.1f} on 1 "
+            f"(efficiency {configs[label]['efficiency']:.2f})",
+            file=sys.stderr,
+        )
+    if not smoke:
+        r1 = _run_with_retry(
+            run_bench_device, max(64, n_frames // 8), size, "rigid3d",
+            min(batch, 8),
+        )
+        rn = _run_with_retry(
+            run_bench_device, max(64, n_frames // 8), size, "rigid3d",
+            min(batch, 8), mesh_devices=n_devices,
+        )
+        configs["rigid3d"] = _scaling_row(r1, rn, n_devices)
+        print(
+            f"[bench] multichip rigid3d: {rn['fps']:.1f} vol/s on "
+            f"{n_devices} devices (efficiency "
+            f"{configs['rigid3d']['efficiency']:.2f})",
+            file=sys.stderr,
+        )
+    return configs
+
+
+def _scaling_row(r1: dict, rn: dict, n_devices: int) -> dict:
+    """One judged scaling entry: mesh fps, 1-chip fps, and the scaling
+    efficiency fps_mesh / (n * fps_1chip) — 1.0 = perfect linear."""
+    rmse = float(rn["rmse_px"])
+    return {
+        "fps_1chip": round(r1["fps"], 2),
+        "fps_mesh": round(rn["fps"], 2),
+        "efficiency": round(rn["fps"] / (n_devices * max(r1["fps"], 1e-9)), 3),
+        "rmse_px": round(rmse, 4) if np.isfinite(rmse) else None,
+        "sweeps_fps": rn.get("sweeps_fps"),
+    }
+
+
+def multichip_judged_json_line(
+    size: int, n_devices: int, configs: dict, manifest: dict | None = None,
+) -> str:
+    """The --multichip judged line: value = flagship mesh throughput,
+    vs_baseline vs the 200 fps/chip target TIMES the device count (so
+    1.0 still means 'the hardware target, per chip'), per-config rows
+    with fps + scaling efficiency riding along."""
+    target = 200.0 * n_devices
+    flag = configs["translation"]
+    rec = {
+        "metric": f"multichip_scaling_translation_{size}x{size}",
+        "value": flag["fps_mesh"],
+        "unit": "frames/sec/mesh",
+        "n_devices": n_devices,
+        "vs_baseline": round(flag["fps_mesh"] / target, 3),
+        "efficiency": flag["efficiency"],
+        "configs": configs,
+    }
+    if manifest:
+        rec["manifest"] = manifest
+    return json.dumps(rec)
+
+
 def _run_with_retry(run, *args, **kw):
     """This image's tunneled TPU occasionally drops a remote_compile
     mid-flight; that is infrastructure, not a benchmark failure — one
@@ -299,7 +390,32 @@ def main() -> None:
         "streaming rows only) — the CI guard for the throughput path; "
         "NOT a performance measurement",
     )
+    ap.add_argument(
+        "--multichip", action="store_true",
+        help="mesh-scaling mode: time the contract configs single-chip "
+        "AND sharded over the device mesh (mesh_devices config "
+        "surface), and emit a judged scaling line with per-config fps "
+        "+ efficiency vs 1 chip. With --smoke, runs the flagship "
+        "config only and self-provisions 8 virtual CPU devices (the "
+        "CI guard)",
+    )
+    ap.add_argument(
+        "--devices", type=int, default=0,
+        help="device count for --multichip (0 or -1 = all visible)",
+    )
     args = ap.parse_args()
+    if args.multichip and args.smoke:
+        # Self-sufficient CI/dev invocation on machines without a real
+        # mesh: force the 8-device virtual CPU platform BEFORE the
+        # first jax import (mirrors __graft_entry__.dryrun_multichip).
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags_env = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags_env:
+            os.environ["XLA_FLAGS"] = (
+                flags_env + " --xla_force_host_platform_device_count=8"
+            ).strip()
     if args.smoke:
         args.frames = min(args.frames, 64)
         args.size = min(args.size, 64)
@@ -309,8 +425,32 @@ def main() -> None:
 
     import jax
 
+    if args.multichip and args.smoke:
+        # this image's TPU-tunnel plugin force-resets jax_platforms via
+        # jax.config on import — pin the forced-CPU smoke back
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     print(f"[bench] device: {dev}", file=sys.stderr)
+
+    if args.multichip:
+        n_visible = len(jax.devices())
+        n = n_visible if args.devices in (0, -1) else args.devices
+        # fail BEFORE the minutes-long 1-chip pass, not at mesh build
+        if n < 1 or n > n_visible:
+            ap.error(
+                f"--devices {args.devices}: need 1..{n_visible} "
+                f"(or 0/-1 = all), have {n_visible} visible device(s)"
+            )
+        print(f"[bench] multichip mode: {n} device(s)", file=sys.stderr)
+        configs = run_bench_multichip(
+            args.frames, args.size, args.batch, n, smoke=args.smoke
+        )
+        print(
+            multichip_judged_json_line(
+                args.size, n, configs, manifest=_bench_manifest()
+            )
+        )
+        return
 
     if args.stages:
         from kcmc_tpu.utils.profiling import stage_breakdown

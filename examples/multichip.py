@@ -30,7 +30,10 @@ mc = MotionCorrector(
     model="affine",
     backend="jax",
     mesh=mesh,               # frames shard over the mesh's frame axis
-    batch_size=4 * n,        # must divide by the device count
+    # (equivalently: mesh_devices=-1, --devices -1, or KCMC_DEVICES=all
+    # — the config surface; batch_size/max_keypoints need not divide
+    # the device count, uneven remainders are mesh-padded)
+    batch_size=4 * n,
 )
 result = mc.correct(data.stack)
 rmse = transform_rmse(

@@ -80,6 +80,17 @@ def _parse_reference_and_overrides(args):
         overrides["fault_plan"] = args.inject_faults
     if getattr(args, "writer_depth", -1) >= 0:
         overrides["writer_depth"] = args.writer_depth
+    devices = getattr(args, "devices", None)
+    if devices is not None:
+        if devices == 0:
+            # An EXPLICIT --devices 0 forces single-chip: clear the
+            # ambient KCMC_DEVICES opt-in for this process so the
+            # documented "explicit wins over environment" contract
+            # holds for 0 too (mesh_devices=0 alone means "auto").
+            import os
+
+            os.environ.pop("KCMC_DEVICES", None)
+        overrides["mesh_devices"] = devices
     # observability (docs/OBSERVABILITY.md): all off by default
     if getattr(args, "trace", ""):
         overrides["trace_path"] = args.trace
@@ -420,6 +431,15 @@ def main(argv=None) -> int:
         help="rigid3d: pages per volume (page t*D+z = volume t, plane z)",
     )
     p.add_argument("--backend", default="jax")
+    p.add_argument(
+        "--devices", type=int, default=None, metavar="N",
+        help="shard frame batches over the first N accelerator chips "
+        "(1-D frame-axis mesh, reference all-gathered on chip; -1 = "
+        "all visible devices; an explicit 0 forces single-chip even "
+        "when KCMC_DEVICES is set; default: single-chip unless "
+        "KCMC_DEVICES says otherwise). batch size / keypoint count "
+        "need not divide N; ignored by --backend numpy",
+    )
     p.add_argument("--reference", default="0",
                    help="frame index, 'first', or 'mean'")
     p.add_argument("--transforms", help=".npz for transforms + diagnostics")
@@ -564,6 +584,11 @@ def main(argv=None) -> int:
                  "homography", "piecewise"],
     )
     p.add_argument("--backend", default="jax")
+    p.add_argument(
+        "--devices", type=int, default=None, metavar="N",
+        help="shard the registration pass over N chips "
+        "(see `correct --devices`)",
+    )
     p.add_argument("--reference", default="0")
     p.add_argument("--transforms",
                    help=".npz for the stabilizing transforms + diagnostics")

@@ -196,37 +196,32 @@ class JaxBackend:
 
     def __init__(self, config: CorrectorConfig, mesh=None, **_options):
         self.config = config
+        if mesh is None:
+            # Config/CLI/env mesh surface: resolve the 1-D frame-axis
+            # mesh here so `MotionCorrector(mesh_devices=N)`, --devices,
+            # and KCMC_DEVICES all reach the same sharded path as an
+            # explicit `mesh=` (which always wins when passed).
+            from kcmc_tpu.parallel.mesh import resolve_mesh
+
+            mesh = resolve_mesh(config.mesh_devices)
         self.mesh = mesh  # jax.sharding.Mesh: shard frame batches over it
         self._batch_fns: dict[Any, Any] = {}
-        if mesh is not None:
-            # ADVICE r4: the reference keypoint arrays enter shard_map
-            # sharded over K, so K must divide the mesh — and with
-            # n_octaves > 1 the MERGED K is n_octaves * ceil(max_kp /
-            # (n_octaves * 8)) * 8 (e.g. 4104 for 4096 over 3 octaves),
-            # only guaranteed a multiple of 8. Validate here with the
-            # real number instead of failing at shard_map trace time.
-            n = int(np.prod(mesh.devices.shape))
-            if config.n_octaves > 1:
-                from kcmc_tpu.ops.pyramid import per_octave_k
-
-                K = sum(per_octave_k(config.max_keypoints, config.n_octaves))
-                hint = (
-                    f" (n_octaves={config.n_octaves} merges "
-                    f"{K // config.n_octaves} keypoints per octave)"
-                )
-            else:
-                K = config.max_keypoints
-                hint = ""
-            if K % n:
-                raise ValueError(
-                    f"reference keypoint count K={K}{hint} must divide "
-                    f"the mesh's {n} devices for the sharded reference "
-                    "all-gather; pick max_keypoints so the "
-                    "(octave-merged) total is a multiple of the device "
-                    "count"
-                )
+        # K need not divide the mesh: prepare_reference pads the
+        # keypoint arrays with masked rows (the pre-round-6 hard
+        # divisibility error is gone — see parallel/sharded.py's
+        # pad_reference_to_mesh).
 
     # -- reference preparation --------------------------------------------
+
+    def _mesh_ref(self, ref: dict) -> dict:
+        """Mesh-pad a prepared reference's keypoint arrays (masked rows)
+        so K divides the device count — a no-op single-chip and when K
+        already divides (see parallel/sharded.pad_reference_to_mesh)."""
+        if self.mesh is None:
+            return ref
+        from kcmc_tpu.parallel.sharded import mesh_size, pad_reference_to_mesh
+
+        return pad_reference_to_mesh(ref, mesh_size(self.mesh))
 
     def prepare_reference(self, ref_frame: np.ndarray) -> dict:
         cfg = self.config
@@ -241,10 +236,10 @@ class JaxBackend:
                 kps, desc = self._detect_describe_2d(
                     frame[None], self._on_accelerator()
                 )
-                return {
+                return self._mesh_ref({
                     "xy": kps.xy[0], "desc": desc[0],
                     "valid": kps.valid[0], "frame": frame,
-                }
+                })
             kps = detect_keypoints(
                 frame,
                 max_keypoints=cfg.max_keypoints,
@@ -258,7 +253,9 @@ class JaxBackend:
             desc = describe_keypoints(
                 frame, kps, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
             )
-            return {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
+            return self._mesh_ref(
+                {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
+            )
         from kcmc_tpu.ops.detect3d import detect_keypoints_3d
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d
 
@@ -269,7 +266,9 @@ class JaxBackend:
             border=min(cfg.border, min(frame.shape) // 4),
         )
         desc = describe_keypoints_3d(frame, kps, blur_sigma=cfg.blur_sigma)
-        return {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
+        return self._mesh_ref(
+            {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
+        )
 
     def update_reference(
         self, ref: dict, tail_corrected, tail_ok, window: int, alpha: float
@@ -301,6 +300,19 @@ class JaxBackend:
         ok = jnp.concatenate(
             [jnp.asarray(k).astype(bool) for k in tail_ok]
         )[-window:]
+        if self.mesh is not None:
+            # Mesh runs: the tail arrived frame-SHARDED straight from
+            # the in-flight sharded batch outputs; one all-gather per
+            # array replicates the averaging window (it is small —
+            # `window` frames) so the blend and the reference
+            # re-extraction run replicated on every chip, mirroring the
+            # host path's semantics exactly. Still no host round trip
+            # and no pipeline flush.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(self.mesh, PartitionSpec())
+            frames = jax.device_put(frames, rep)
+            ok = jax.device_put(ok, rep)
         new_frame = _blend_template(
             jnp.asarray(ref["frame"], jnp.float32),
             frames,
@@ -351,15 +363,31 @@ class JaxBackend:
         fn = self._get_batch_fn(shape)
         frames_j = jnp.asarray(frames)
         idx_j = jnp.asarray(frame_indices, jnp.uint32)
+        B_caller = None
         if self.mesh is not None:
-            from kcmc_tpu.parallel.sharded import shard_frames
+            from kcmc_tpu.parallel.sharded import (
+                mesh_size,
+                pad_batch_to_mesh,
+                shard_frames,
+            )
 
+            # Uneven batches (batch_size % n_devices != 0) pad to the
+            # mesh by repeating the last frame — same trick the
+            # orchestrator uses for short tails — and outputs slice
+            # back below, so any batch size shards.
+            frames_j, idx_j, B_in = pad_batch_to_mesh(
+                frames_j, idx_j, mesh_size(self.mesh)
+            )
+            if int(frames_j.shape[0]) != B_in:
+                B_caller = B_in
             frames_j = shard_frames(frames_j, self.mesh)
             idx_j = shard_frames(idx_j, self.mesh)
         out = fn(
             frames_j, ref["xy"], ref["desc"], ref["valid"], ref["frame"],
             idx_j,
         )
+        if B_caller is not None:
+            out = {k: v[:B_caller] for k, v in out.items()}
         if (
             self.config.quality_metrics
             and "corrected" in out

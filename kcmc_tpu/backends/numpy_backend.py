@@ -256,17 +256,27 @@ class NumpyBackend:
                 "oracle."
             )
         self.config = config
+        # Mesh no-op mirror: the numpy oracle is single-host by nature.
+        # `mesh_devices` (and an explicit mesh= option) are accepted and
+        # ignored so one config runs on either backend — in particular
+        # the degradation ladder's failover from a SHARDED jax run
+        # lands here without a config scrub.
+        self.mesh = None
 
     def runtime_info(self) -> dict:
         """Execution-environment description for the run manifest
         (obs/manifest.py) — the numpy oracle runs on the host CPU."""
         import platform
 
-        return {
+        info = {
             "backend": self.name,
             "numpy": np.__version__,
             "processor": platform.processor() or platform.machine(),
         }
+        if self.config.mesh_devices:
+            # recorded so a manifest shows the knob was set but unused
+            info["mesh_devices_ignored"] = int(self.config.mesh_devices)
+        return info
 
     def _detect_describe_2d(self, frame: np.ndarray, multi_scale=True):
         """Single-scale detect+describe, or the ORB scale pyramid when

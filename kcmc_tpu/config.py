@@ -299,6 +299,24 @@ class CorrectorConfig:
 
     # -- execution ---------------------------------------------------------
     batch_size: int = 32  # frames per jitted device step
+    # Multi-chip execution: device count of the 1-D frame-axis mesh
+    # frame batches shard over (data parallelism; reference descriptors
+    # all-gather on chip — docs/PERFORMANCE.md "Multi-chip scaling").
+    # 0 = auto (default): single-chip unless the KCMC_DEVICES env var
+    # says otherwise ("all" or a count; "0" keeps single-chip). N >= 1
+    # = the first N visible devices; -1 = every visible device. A
+    # non-zero config value wins over the environment, and the CLI's
+    # explicit `--devices 0` clears KCMC_DEVICES for the process so it
+    # wins too. Resolved to a jax.sharding.Mesh at backend
+    # construction; the numpy backend ignores it (no-op mirror), so
+    # configs stay portable across backends. Neither batch_size nor
+    # max_keypoints needs to divide the device count — uneven frame
+    # batches and the reference keypoint set are mesh-padded (masked)
+    # automatically. Checkpoint resume is mesh-shape neutral: a run
+    # checkpointed on 4 chips resumes on 8 (outputs agree to float32
+    # registration tolerance across mesh shapes, byte-identical only on
+    # the same shape).
+    mesh_devices: int = 0
     # Bounded background writeback queue depth for file-streaming runs
     # (correct_file with output=): TIFF/Zarr/HDF5 encode+write runs on a
     # writer thread up to this many batches behind the consumer, so
@@ -507,6 +525,11 @@ class CorrectorConfig:
             raise ValueError(
                 "rescue_warn_fraction must be in (0, 1], got "
                 f"{self.rescue_warn_fraction}"
+            )
+        if self.mesh_devices < -1:
+            raise ValueError(
+                "mesh_devices must be -1 (all devices), 0 (single-chip),"
+                f" or a positive device count, got {self.mesh_devices}"
             )
         if self.writer_depth < 0:
             raise ValueError(

@@ -26,14 +26,19 @@ from kcmc_tpu.obs.log import advise
 from kcmc_tpu.utils.metrics import StageTimer
 
 
-# Config fields that shape failure recovery, IO scheduling, or pure
-# observability but never the happy-path results; pinned to their
-# defaults inside the checkpoint resume signature so changing them
-# between runs doesn't invalidate a resume. (`writer_depth` only
-# reorders WHEN bytes hit disk, never which bytes — checkpoints flush
-# to the durable mark first. The obs knobs only RECORD what ran —
-# re-running a killed job with --trace added must resume it, not
-# restart it. `device_templates` is deliberately NOT neutral: the
+# Config fields that shape failure recovery, IO scheduling, execution
+# topology, or pure observability but never the happy-path results;
+# pinned to their defaults inside the checkpoint resume signature so
+# changing them between runs doesn't invalidate a resume.
+# (`writer_depth` only reorders WHEN bytes hit disk, never which bytes
+# — checkpoints flush to the durable mark first. The obs knobs only
+# RECORD what ran — re-running a killed job with --trace added must
+# resume it, not restart it. `mesh_devices` is the mesh-shape
+# neutrality contract: a run checkpointed on 4 chips resumes on 8 —
+# the sharded program is the same algorithm with the same global-index
+# RANSAC keys, so cross-shape outputs agree to float32 registration
+# tolerance; byte-identity of a resumed output file holds on the SAME
+# mesh shape. `device_templates` is deliberately NOT neutral: the
 # device blend's reduction order differs from the host path at float32
 # precision, so flipping it mid-run must restart, not resume.)
 _ROBUSTNESS_SIG_NEUTRAL = {
@@ -41,7 +46,7 @@ _ROBUSTNESS_SIG_NEUTRAL = {
     for f in (
         "fault_plan", "retry_attempts", "retry_backoff_s",
         "retry_backoff_max_s", "retry_jitter", "failover_backend",
-        "degrade_mark_failed", "writer_depth",
+        "degrade_mark_failed", "writer_depth", "mesh_devices",
         "trace_path", "frame_records_path", "heartbeat_s",
     )
 }
@@ -572,6 +577,17 @@ class MotionCorrector:
     template_update_alpha:
         Blend weight of the new window mean in each rolling update
         (default 0.5; 1.0 replaces the template outright).
+    mesh:
+        Explicit `jax.sharding.Mesh` to shard frame batches over
+        (multi-chip data parallelism; reference descriptors all-gather
+        on chip). Prefer the config surface — `mesh_devices=N` (also
+        `--devices` on the CLI or the KCMC_DEVICES env var) resolves
+        the 1-D frame-axis mesh at backend construction; an explicit
+        `mesh=` wins when both are given. Neither `batch_size` nor
+        `max_keypoints` needs to divide the device count (uneven
+        batches and the reference keypoint set are mesh-padded), and
+        checkpointed streaming runs resume across mesh shapes. See
+        docs/PERFORMANCE.md "Multi-chip scaling".
     config / **overrides:
         A full CorrectorConfig, or keyword overrides applied on top of
         the defaults (e.g. `MotionCorrector(model="affine", n_hypotheses=256)`).
@@ -1439,6 +1455,19 @@ class MotionCorrector:
         # obs seam: per-batch dispatch spans land on the consumer
         # thread's trace track (None when tracing is off — free).
         tracer = getattr(timer, "tracer", None) if timer is not None else None
+        # Per-shard attribution for mesh runs: every dispatch span
+        # carries the shard count, the device ids the batch fanned out
+        # to, and the per-shard frame slice, so a Perfetto view of a
+        # sharded run shows WHERE each batch's frames executed.
+        shard_args = None
+        if tracer is not None:
+            mesh = getattr(self.backend, "mesh", None)
+            if mesh is not None:
+                devs = [int(d.id) for d in mesh.devices.flat]
+                shard_args = {
+                    "shards": len(devs),
+                    "shard_devices": devs[:16],
+                }
         inflight: list[tuple] = state["inflight"]
         accepts_cast: dict = state["accepts"]
         native_ok: dict[int, bool] = state["native_ok"]
@@ -1519,10 +1548,15 @@ class MotionCorrector:
                 drain((n, out, self._failed_kept(out, kept, failed), ref))
                 continue
             if tracer is not None:
+                span_args = {"first_frame": int(idx[0]), "frames": int(n)}
+                if shard_args is not None:
+                    span_args.update(shard_args)
+                    span_args["frames_per_shard"] = -(
+                        -len(idx) // shard_args["shards"]
+                    )
                 tracer.complete(
                     "dispatch_batch", t_disp, time.perf_counter() - t_disp,
-                    cat="dispatch",
-                    args={"first_frame": int(idx[0]), "frames": int(n)},
+                    cat="dispatch", args=span_args,
                 )
             if on_dispatched is not None:
                 # pre-drop hook: the device-template tail needs the
@@ -1796,7 +1830,12 @@ class MotionCorrector:
         zlib build warns and downgrades to pixel-identical). Requires
         `output` (the corrected pixels live in the output file, not the
         checkpoint). Reference selection is deterministic, so it is
-        re-derived on resume rather than stored.
+        re-derived on resume rather than stored. Mesh-shape neutral:
+        `mesh_devices` is pinned out of the resume signature, so a run
+        checkpointed on one device count resumes on another
+        (byte-identity of the resumed output holds on the SAME mesh
+        shape; across shapes the agreement is float32-registration
+        tight).
         """
         from kcmc_tpu.io import ChunkedStackLoader, open_stack
 

@@ -155,7 +155,9 @@ def _frame_fits_2copy(Hp: int, Wp: int, P: int, itemsize: int = 4) -> bool:
     window) resident layout: the block is (2, Hpp, Wpp2), still
     double-buffered. Wpp2 uses a 128-lane margin instead of _WIN.
     The 128-lane window holds residual(<64) + patch, so the layout is
-    only CORRECT for P <= 65 — larger P must take the wide window."""
+    only CORRECT for P <= 65 — larger P must take the wide window
+    (worst case rx = 63 and 63 + P <= 128 exactly at P = 65; the
+    kernel re-asserts this statically — see _blended_kernel)."""
     if P > 65:
         return False
     S = _slab_rows(P, itemsize)
@@ -252,6 +254,21 @@ def _blended_kernel(
     align = 16 if itemsize == 2 else 8
     S = _slab_rows(P, itemsize)
     W = 128 if ncopies == 2 else _WIN
+    if ncopies == 2:
+        # Static wrap-safety (ADVICE r5): in the narrow-slab layout the
+        # post-copy lane residual rx = xp - x0a is < 64 by construction
+        # (the second copy is pre-shifted 64 lanes), so the 128-lane
+        # window covers residual + patch iff 63 + P <= 128 — exactly
+        # the P <= 65 gate in _frame_fits_2copy. If the gate and this
+        # kernel ever drift apart, the roll below would WRAP patch
+        # lanes silently; fail the trace instead. (A real raise, not
+        # `assert`, so `python -O` can't strip the guard.)
+        if 63 + P > 128:
+            raise ValueError(
+                f"narrow-slab layout: worst-case rx (63) + P ({P}) "
+                "exceeds the 128-lane window — _frame_fits_2copy must "
+                "gate P <= 65"
+            )
     # Scalar stores to VMEM are unsupported: accumulate the per-keypoint
     # moment scalars into (KB, 1) vectors (iota row-select) and store once.
     row = jax.lax.broadcasted_iota(jnp.int32, (KB, 1), 0)

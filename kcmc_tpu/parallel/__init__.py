@@ -10,14 +10,22 @@ from kcmc_tpu.parallel.mesh import (
     FRAME_AXIS,
     initialize_multihost,
     make_mesh,
+    resolve_mesh,
     shard_host_local_frames,
 )
-from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
+from kcmc_tpu.parallel.sharded import (
+    make_sharded_batch_fn,
+    pad_batch_to_mesh,
+    pad_reference_to_mesh,
+)
 
 __all__ = [
     "FRAME_AXIS",
     "initialize_multihost",
     "make_mesh",
     "make_sharded_batch_fn",
+    "pad_batch_to_mesh",
+    "pad_reference_to_mesh",
+    "resolve_mesh",
     "shard_host_local_frames",
 ]

@@ -30,6 +30,61 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), (FRAME_AXIS,))
 
 
+def resolve_mesh(mesh_devices: int = 0) -> Mesh | None:
+    """The config/CLI -> Mesh seam (CorrectorConfig.mesh_devices,
+    `--devices`, KCMC_DEVICES): returns the 1-D frame-axis mesh a
+    backend should shard over, or None for single-chip execution.
+
+    `mesh_devices`: 0 = auto (consult the KCMC_DEVICES env var; absent
+    or "0" keeps single-chip — so `KCMC_DEVICES=0` is the ambient
+    escape hatch back to single-chip), N >= 1 = the first N visible
+    devices, -1 = every visible device ("all" in the env var). A
+    non-zero config value always wins over the environment (the CLI's
+    explicit `--devices 0` clears the env var for the process, so it
+    wins too). Requesting more devices than exist raises rather than
+    silently running on fewer; every env-sourced failure names
+    KCMC_DEVICES so a stale shell export is findable from the
+    traceback alone.
+    """
+    import os
+
+    n = int(mesh_devices)
+    env_src = None
+    if n == 0:
+        env = os.environ.get("KCMC_DEVICES", "").strip()
+        if not env:
+            return None
+        env_src = env
+        if env.lower() == "all":
+            n = -1
+        else:
+            try:
+                n = int(env)
+            except ValueError:
+                raise ValueError(
+                    f"KCMC_DEVICES must be 'all', '0' (single-chip), or "
+                    f"a device count, got {env!r} — unset it or pass an "
+                    "explicit --devices / mesh_devices"
+                ) from None
+        if n == 0:
+            return None
+    if n < -1:
+        raise ValueError(
+            f"mesh_devices must be -1 (all), 0 (single-chip), or a "
+            f"positive device count, got {n}"
+            + (f" (from KCMC_DEVICES={env_src!r})" if env_src else "")
+        )
+    try:
+        return make_mesh(None if n == -1 else n)
+    except ValueError as e:
+        if env_src is not None:
+            raise ValueError(
+                f"{e} (from the KCMC_DEVICES={env_src!r} env var — "
+                "unset it or pass an explicit --devices / mesh_devices)"
+            ) from None
+        raise
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,

@@ -20,12 +20,39 @@ mesh lets XLA route the gather over ICI within hosts and DCN across.
 
 from __future__ import annotations
 
+import inspect
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+# shard_map compat shim: newer jax exports it top-level; older releases
+# (e.g. the 0.4.x on this image) only ship jax.experimental.shard_map.
+# The replication-check kwarg was also renamed (check_rep -> check_vma),
+# so resolve the disable-flag name from the actual signature once.
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - exercised on older jax images
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_NO_REP_CHECK = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else {"check_rep": False}
+)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, **kw):
+    """`jax.shard_map` across jax versions (top-level or experimental),
+    with the replication check disabled under whichever kwarg this
+    jax spells it (`check_vma` / `check_rep`)."""
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        **_NO_REP_CHECK, **kw,
+    )
+
 
 from kcmc_tpu.parallel.mesh import FRAME_AXIS
 
@@ -62,7 +89,6 @@ def make_sharded_batch_fn(local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS):
         # arrays, whose K is mesh-padded by construction).
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(axis)),
         out_specs=P(axis),
-        check_vma=False,
     )
     return jax.jit(sharded)
 
@@ -81,3 +107,51 @@ def shard_reference(ref: dict, mesh: Mesh, axis: str = FRAME_AXIS) -> dict:
 def shard_frames(frames, mesh: Mesh, axis: str = FRAME_AXIS):
     """Lay out a (B, ...) frame batch sharded over the frame axis."""
     return jax.device_put(frames, NamedSharding(mesh, P(axis)))
+
+
+def mesh_size(mesh: Mesh) -> int:
+    """Total device count of a mesh (the frame axis spans all of it)."""
+    return int(np.prod(mesh.devices.shape))
+
+
+def pad_reference_to_mesh(ref: dict, n: int) -> dict:
+    """Pad a prepared reference's keypoint arrays so K divides the mesh.
+
+    The reference keypoint set enters shard_map partitioned over K
+    (in_specs P(axis)), which requires K % n_devices == 0. Instead of
+    constraining `max_keypoints` to the device count (the pre-round-6
+    hard error), append masked rows: `valid` False (so the padded slots
+    can never match — the matcher gates every candidate on ref_valid,
+    identical to how short detections are masked on a single chip),
+    zeros for coordinates and descriptors. The padded rows are dead
+    weight in the all-gather only; results are unchanged.
+    """
+    K = int(ref["xy"].shape[0])
+    pad = (-K) % n
+    if pad == 0:
+        return ref
+    out = dict(ref)
+    for key in ("xy", "desc", "valid"):
+        v = jnp.asarray(ref[key])
+        out[key] = jnp.concatenate(
+            [v, jnp.zeros((pad,) + tuple(v.shape[1:]), v.dtype)]
+        )
+    return out
+
+
+def pad_batch_to_mesh(frames, indices, n: int):
+    """Pad a (B, ...) batch (and its frame indices) so B divides the
+    mesh, by repeating the last row. Replaces the pre-round-6
+    requirement that `batch_size % n_devices == 0`: the duplicate rows
+    register like any other padded tail frame (the orchestrator already
+    pads short tails to the compiled batch size the same way) and the
+    caller slices outputs back to B. Returns (frames, indices, B)."""
+    B = int(frames.shape[0])
+    pad = (-B) % n
+    if pad == 0:
+        return frames, indices, B
+    frames = jnp.concatenate(
+        [frames, jnp.repeat(frames[-1:], pad, axis=0)]
+    )
+    indices = jnp.concatenate([indices, jnp.repeat(indices[-1:], pad)])
+    return frames, indices, B

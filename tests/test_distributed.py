@@ -108,37 +108,37 @@ def test_sharded_rigid3d_matches_single_device():
     np.testing.assert_allclose(r8.corrected, r1.corrected, atol=1e-4)
 
 
-def test_mesh_keypoint_divisibility_validated():
-    """ADVICE r4: a pyramid config whose octave-merged K does not
-    divide the mesh must fail at construction with a clear message,
-    not at shard_map trace time (merged K = n_octaves * ceil(max_kp /
-    (n_octaves * 8)) * 8 — e.g. 4104 for 4096 over 3 octaves — is only
-    guaranteed a multiple of 8)."""
-    import pytest
-
+def test_mesh_keypoint_padding(data):
+    """Round 6 replaces the old hard K % n_devices == 0 constructor
+    error with mesh padding: the prepared reference's keypoint arrays
+    gain masked (valid=False) rows up to the next device-count
+    multiple, so ANY max_keypoints (including octave-merged totals
+    like 1032 on a 7-device mesh) shards — and results still match the
+    single-device path."""
     from kcmc_tpu import MotionCorrector
     from kcmc_tpu.parallel import make_mesh
 
-    # merged K is n_octaves * (a multiple of 8), so any power-of-two
-    # mesh up to 8 divides it — the trap needs a mesh size with another
-    # prime factor (the ADVICE example was 4104 on 16 devices; with 8
-    # virtual devices, 7 plays that role: 1032 % 7 = 3)
-    with pytest.raises(ValueError, match="must divide"):
-        MotionCorrector(
-            model="similarity", backend="jax", mesh=make_mesh(7),
-            n_octaves=3, max_keypoints=1024,
-        )
-    # single-scale trap too: K = max_keypoints directly
-    with pytest.raises(ValueError, match="must divide"):
-        MotionCorrector(
-            model="translation", backend="jax", mesh=make_mesh(8),
-            max_keypoints=100,
-        )
-    # a compatible choice constructs fine
+    # the old traps now construct fine (1032 % 7 = 3; 100 % 8 = 4)
     MotionCorrector(
-        model="similarity", backend="jax", mesh=make_mesh(8),
-        n_octaves=3, max_keypoints=1024,  # merged 1032 = 8 * 129
+        model="similarity", backend="jax", mesh=make_mesh(7),
+        n_octaves=3, max_keypoints=1024,
     )
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        mesh=make_mesh(8), max_keypoints=100,
+    )
+    # the padded reference: K rounded up to the mesh, pad rows masked
+    ref = mc.backend.prepare_reference(
+        np.asarray(data.stack[0], np.float32)
+    )
+    assert ref["xy"].shape[0] == 104  # 100 -> next multiple of 8
+    assert not np.asarray(ref["valid"])[100:].any()
+    r8 = mc.correct(data.stack)
+    r1 = MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        max_keypoints=100,
+    ).correct(data.stack)
+    np.testing.assert_allclose(r8.transforms, r1.transforms, atol=1e-4)
 
 
 def test_numpy_backend_rejects_banded_config():

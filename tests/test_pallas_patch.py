@@ -193,3 +193,56 @@ def test_banded_extraction_matches_oracle_any_density():
         want = np.asarray(extract_blended(padded, jnp.asarray(xy), P,
                                           interpret=True))
         np.testing.assert_array_equal(got, want)
+
+
+def test_narrow_slab_wrap_boundary_p65():
+    """ADVICE r5 wrap-safety: P=65 is the narrow-slab layout's exact
+    lane-window boundary — worst-case residual rx = 63 plus the 65-lane
+    patch fills the 128-lane window with zero slack (63 + 65 = 128).
+    Exercise origins that land rx on 63 through BOTH pre-shifted copies
+    (ox % 128 == 63 -> copy 0, ox % 128 == 127 -> copy 1) and check
+    the blended patches against a plain NumPy bilinear oracle; one more
+    lane (P=66) must be refused by the _frame_fits_2copy gate."""
+    from kcmc_tpu.ops import pallas_patch as pp
+
+    P = 65
+    r1 = (P - 2) // 2 + 1  # 32: the describe padding convention
+    H, W = 96, 176
+    Hp, Wp = H + 2 * r1, W + 2 * r1
+    # the test must actually exercise the narrow-slab (2-copy) path
+    assert pp._frame_fits_2copy(Hp, Wp, P)
+    assert not pp._frame_fits_2copy(Hp, Wp, P + 1)
+
+    rng = np.random.default_rng(7)
+    padded = jnp.asarray(rng.random((2, Hp, Wp), dtype=np.float32))
+    # rx = 63 via copy 0 (ox=63) and copy 1 (ox=127), plus aligned and
+    # interior controls; oy exercises the row roll alongside
+    ox = jnp.asarray([[63, 127, 0, 40], [127, 63, 95, 7]], jnp.int32)
+    oy = jnp.asarray([[0, 31, 63, 95], [95, 8, 17, 2]], jnp.int32)
+    fx = jnp.asarray(
+        rng.random((2, 4, 1), dtype=np.float32), jnp.float32
+    )
+    fy = jnp.asarray(
+        rng.random((2, 4, 1), dtype=np.float32), jnp.float32
+    )
+
+    got = np.asarray(
+        pp.extract_blended_planes(padded, oy, ox, fx, fy, P, interpret=True)
+    )
+
+    pn = np.asarray(padded)
+    fxn, fyn = np.asarray(fx), np.asarray(fy)
+    for b in range(2):
+        for k in range(4):
+            y0, x0 = int(oy[b, k]), int(ox[b, k])
+            p = pn[b, y0 : y0 + P, x0 : x0 + P]
+            # same separable grouping (and f32 arithmetic) as the kernel
+            yb = (1.0 - fyn[b, k, 0]) * p[:-1] + fyn[b, k, 0] * p[1:]
+            want = (
+                (1.0 - fxn[b, k, 0]) * yb[:, :-1]
+                + fxn[b, k, 0] * yb[:, 1:]
+            )
+            np.testing.assert_allclose(
+                got[b, k], want.astype(np.float32), atol=1e-6,
+                err_msg=f"b={b} k={k} origin=({y0},{x0})",
+            )

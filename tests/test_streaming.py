@@ -273,6 +273,10 @@ def test_streaming_sharded_mesh_resume_byte_identical(tmp_path, monkeypatch):
     TILE differently between the unsharded and per-shard programs, so
     cross-device-count agreement is ~1e-6-px-tight rather than bitwise
     (pinned at 1e-4 here)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
     from kcmc_tpu.io import ChunkedStackLoader
     from kcmc_tpu.io.tiff import write_stack
     from kcmc_tpu.parallel import make_mesh
