@@ -245,6 +245,83 @@ def run_bench_streaming(
     }
 
 
+def run_bench_serve(
+    n_frames: int, size: int, batch: int, n_streams: int = 2,
+    **mc_overrides,
+) -> dict:
+    """The serving path: N concurrent client streams multiplexed
+    through one resident backend by the StreamScheduler (in-process —
+    this measures the scheduler/cross-stream-batching overhead, not
+    socket serialization). Reports total + per-stream fps, batch
+    occupancy, and admission counters from `stats()` so a scheduler
+    regression (occupancy collapse, spurious degradation) is visible
+    round over round."""
+    import threading
+
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.serve.scheduler import OverloadedError, StreamScheduler
+
+    data = _build_stack(n_frames, size, "translation")
+    base = len(data.stack)
+    reps = (n_frames + base - 1) // base
+    stack = np.tile(data.stack, (reps, 1, 1))[:n_frames].astype(np.float32)
+
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=batch, **mc_overrides
+    )
+    mc.correct(stack[: batch * 2])  # warmup/compile outside the timing
+    sched = StreamScheduler(mc).start()
+    results: dict = {}
+    try:
+        sessions = [
+            sched.open_session(tenant=f"bench-{i}") for i in range(n_streams)
+        ]
+        chunk = max(batch, 16)
+        t0 = time.perf_counter()
+
+        def feed(sess):
+            for lo in range(0, n_frames, chunk):
+                part = stack[lo : lo + chunk]
+                while True:
+                    try:
+                        sched.submit(sess.sid, part)
+                        break
+                    except OverloadedError:
+                        # Backpressure, the well-behaved-client idiom:
+                        # enqueue outruns registration at full --frames,
+                        # so wait for the queue to drain (the rejection
+                        # still lands in the reported admission stats).
+                        time.sleep(0.05)
+            results[sess.sid] = sched.close_session(sess.sid, timeout=600)
+
+        feeders = [
+            threading.Thread(target=feed, args=(s,)) for s in sessions
+        ]
+        for t in feeders:
+            t.start()
+        for t in feeders:
+            t.join()
+        dt = time.perf_counter() - t0
+        stats = sched.stats()
+    finally:
+        sched.stop()
+    total = n_frames * n_streams
+    rmse = max(
+        _rmse(data, "translation", r.transforms, None)
+        for r in results.values()
+    )
+    return {
+        "fps": total / dt,
+        "per_stream_fps": round(total / dt / n_streams, 2),
+        "n_streams": n_streams,
+        "seconds": dt,
+        "rmse_px": rmse,
+        "n_frames": total,
+        "batch_occupancy": stats["batch_occupancy"],
+        "admission": stats["admission"],
+    }
+
+
 def run_bench_multichip(
     n_frames: int, size: int, batch: int, n_devices: int,
     smoke: bool = False,
@@ -383,6 +460,17 @@ def main() -> None:
         help="also time the zero-stall streaming config (correct_file, "
         "rolling template updates, background writeback) and report its "
         "per-seam stall accounting",
+    )
+    ap.add_argument(
+        "--serve", action="store_true",
+        help="also time the multi-tenant serving path (N concurrent "
+        "streams through one resident backend via the StreamScheduler) "
+        "and report per-stream fps + batch occupancy + admission "
+        "counters",
+    )
+    ap.add_argument(
+        "--streams", type=int, default=2,
+        help="concurrent client streams for --serve (default 2)",
     )
     ap.add_argument(
         "--smoke", action="store_true",
@@ -559,6 +647,27 @@ def main() -> None:
             f"{rs['fps']:.1f} fps, rmse {rs['rmse_px']:.3f} px, "
             f"stalls {json.dumps(rs['stalls_s'])}, "
             f"pipeline {json.dumps(rs['pipeline'])}",
+            file=sys.stderr,
+        )
+
+    if args.serve:
+        rv = _run_with_retry(
+            run_bench_serve, args.frames, args.size, args.batch,
+            n_streams=args.streams,
+        )
+        configs = dict(configs or {})
+        configs[f"serve_{args.streams}streams"] = dict(
+            _config_row(rv),
+            per_stream_fps=rv["per_stream_fps"],
+            n_streams=rv["n_streams"],
+            batch_occupancy=rv["batch_occupancy"],
+            admission=rv["admission"],
+        )
+        print(
+            f"[bench] serve x{args.streams} {args.size}x{args.size}: "
+            f"{rv['fps']:.1f} fps total ({rv['per_stream_fps']:.1f} "
+            f"per stream), occupancy {rv['batch_occupancy']:.2f}, "
+            f"rmse {rv['rmse_px']:.3f} px",
             file=sys.stderr,
         )
 

@@ -71,6 +71,15 @@ def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only
             import kcmc_tpu.obs as _obs
 
             return getattr(_obs, name)
+        if name in (
+            "Session",
+            "StreamScheduler",
+            "ServeServer",
+            "ServeClient",
+        ):
+            import kcmc_tpu.serve as _serve
+
+            return getattr(_serve, name)
     except ImportError as e:  # PEP 562: attribute access must raise AttributeError
         raise AttributeError(f"kcmc_tpu.{name} is unavailable: {e}") from e
     raise AttributeError(f"module 'kcmc_tpu' has no attribute {name!r}")

@@ -372,6 +372,33 @@ def _cmd_stabilize(args) -> int:
     return 0
 
 
+def _cmd_serve(args) -> int:
+    """Resident multi-tenant serving: one warm backend + mesh, many
+    concurrent client streams multiplexed through it (docs/SERVING.md).
+    The first stdout line is a machine-readable ready record with the
+    bound port; drive it with kcmc_tpu.serve.client.ServeClient."""
+    # --reference is parser-restricted to 'first': "mean"/index
+    # references need the whole stack up front, which a stream never
+    # has — clients send an explicit reference array at open_session.
+    ref, overrides = _parse_reference_and_overrides(args)
+    # serve_main passes template_update_every explicitly, and the serve
+    # plane owns the AGGREGATE heartbeat (args.heartbeat goes to
+    # ServeServer) — per-run heartbeats stay off.
+    overrides.pop("template_update_every", None)
+    overrides.pop("heartbeat_s", None)
+    if args.queue_depth:
+        overrides["serve_queue_depth"] = args.queue_depth
+    if args.inflight:
+        overrides["serve_inflight"] = args.inflight
+    if args.degrade_watermark is not None:
+        overrides["serve_degrade_watermark"] = args.degrade_watermark
+    args.reference = ref
+    args.overrides = overrides
+    from kcmc_tpu.serve.server import serve_main
+
+    return serve_main(args)
+
+
 def _cmd_report(args) -> int:
     """Render a human-readable run report from either run artifact:
     a --frame-records JSONL or a `correct --transforms` npz."""
@@ -534,6 +561,82 @@ def main(argv=None) -> int:
     )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_correct)
+
+    p = sub.add_parser(
+        "serve",
+        help="resident multi-tenant serving: keep one warm backend + "
+        "mesh alive and multiplex concurrent client streams through it "
+        "(line-delimited JSON over TCP; docs/SERVING.md)",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port", type=int, default=7733,
+        help="TCP port (0 = ephemeral; the ready line reports the "
+        "bound port)",
+    )
+    p.add_argument(
+        "--model", default="translation",
+        choices=["translation", "rigid", "similarity", "affine",
+                 "homography", "piecewise"],
+    )
+    p.add_argument("--backend", default="jax")
+    p.add_argument(
+        "--devices", type=int, default=None, metavar="N",
+        help="shard the resident mesh over N chips (see `correct "
+        "--devices`)",
+    )
+    p.add_argument("--reference", default="first", choices=["first"],
+                   help="reference for sessions that send no explicit "
+                   "reference frame at open_session: 'first' (each "
+                   "stream's first submitted frame) is the only "
+                   "stream-compatible policy")
+    p.add_argument("--batch-size", type=int, default=0)
+    p.add_argument("--max-keypoints", type=int, default=0)
+    p.add_argument("--hypotheses", type=int, default=0)
+    p.add_argument("--warp", default="",
+                   choices=["", "auto", "jnp", "pallas", "separable"])
+    p.add_argument("--quality", action="store_true")
+    p.add_argument(
+        "--template-update", type=int, default=0,
+        help="default rolling-template cadence for sessions (frames; "
+        "0 = off; sessions may override per-stream)",
+    )
+    p.add_argument(
+        "--queue-depth", type=int, default=0,
+        help="per-session admission bound in frames "
+        "(serve_queue_depth; default 256)",
+    )
+    p.add_argument(
+        "--inflight", type=int, default=0,
+        help="cross-session in-flight dispatch window, batches "
+        "(serve_inflight; default 3)",
+    )
+    p.add_argument(
+        "--degrade-watermark", type=float, default=None,
+        help="queue fraction where QoS degradation engages before any "
+        "429 rejection (serve_degrade_watermark; default 0.5)",
+    )
+    p.add_argument(
+        "--writer-depth", type=int, default=-1,
+        help="background-writeback queue depth for sessions writing "
+        "server-side output files (see `correct --writer-depth`)",
+    )
+    p.add_argument(
+        "--heartbeat", type=float, default=0, metavar="SECS",
+        help="aggregate serve heartbeat: per-session frames/fps, queue "
+        "depths, admission decisions, batch occupancy (0 = off)",
+    )
+    p.add_argument(
+        "--trace", default="", metavar="PATH",
+        help="per-session Chrome traces (every session derives its "
+        "own session-id filename from PATH)",
+    )
+    p.add_argument(
+        "--frame-records", default="", metavar="PATH",
+        help="per-session frame-quality JSONLs (session-id derived "
+        "filenames)",
+    )
+    p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser(
         "report",

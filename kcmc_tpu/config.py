@@ -242,6 +242,26 @@ class CorrectorConfig:
     # CLI: --heartbeat SECS.
     heartbeat_s: float = 0.0
 
+    # -- serving (kcmc_tpu/serve; docs/SERVING.md) -------------------------
+    # Per-session admission bound, in frames: a `submit_frames` that
+    # would push a session's pending queue past this is REJECTED with a
+    # 429-style error. Rejection is the last resort — the scheduler
+    # first degrades quality (see serve_degrade_watermark) to drain the
+    # backlog faster.
+    serve_queue_depth: int = 256
+    # Cross-session dispatch-window depth: how many device batches the
+    # serving scheduler keeps in flight across ALL sessions (the serve
+    # analogue of `_dispatch_batches`' depth=3 pipelining).
+    serve_inflight: int = 3
+    # Queue fraction (of serve_queue_depth) past which QoS degradation
+    # engages for a session: its batches dispatch through a reduced-
+    # budget backend (smaller RANSAC hypothesis budget, fewer refine/
+    # polish passes — the consensus-stage rungs of the PR-2 robustness
+    # ladder, which never change reference preparation) until the queue
+    # drains below half the watermark. 1.0 = never degrade (reject
+    # only).
+    serve_degrade_watermark: float = 0.5
+
     @property
     def observability_enabled(self) -> bool:
         """True when any obs surface is armed — THE gate both the
@@ -516,6 +536,21 @@ class CorrectorConfig:
             from kcmc_tpu.utils.faults import FaultPlan
 
             FaultPlan.from_spec(self.fault_plan)
+        if self.serve_queue_depth < 1:
+            raise ValueError(
+                f"serve_queue_depth must be >= 1 frame, got "
+                f"{self.serve_queue_depth}"
+            )
+        if self.serve_inflight < 1:
+            raise ValueError(
+                f"serve_inflight must be >= 1 batch, got "
+                f"{self.serve_inflight}"
+            )
+        if not 0.0 < self.serve_degrade_watermark <= 1.0:
+            raise ValueError(
+                "serve_degrade_watermark must be in (0, 1], got "
+                f"{self.serve_degrade_watermark}"
+            )
         if self.heartbeat_s < 0:
             raise ValueError(
                 f"heartbeat_s must be >= 0 seconds (0 = off), got "

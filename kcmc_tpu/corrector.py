@@ -48,6 +48,9 @@ _ROBUSTNESS_SIG_NEUTRAL = {
         "retry_backoff_max_s", "retry_jitter", "failover_backend",
         "degrade_mark_failed", "writer_depth", "mesh_devices",
         "trace_path", "frame_records_path", "heartbeat_s",
+        # serving QoS knobs schedule WHEN work dispatches, never what a
+        # one-shot file run computes
+        "serve_queue_depth", "serve_inflight", "serve_degrade_watermark",
     )
 }
 
@@ -165,6 +168,14 @@ def _cast_output(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
         lo, hi = int_clip_bounds(dtype, fdt)
         return np.clip(np.rint(arr), lo, hi).astype(dtype)
     return np.asarray(arr, dtype)
+
+
+def merge_outputs(outs: list[dict], cat=np.concatenate) -> dict:
+    """Merge per-batch output dicts into one dict of concatenated
+    arrays. The key set comes from the first batch — batches of one run
+    are key-uniform by the dispatch contract. Shared by `correct`,
+    `correct_file`, and serve sessions (`kcmc_tpu/serve/session.py`)."""
+    return {k: cat([o[k] for o in outs]) for k in outs[0]} if outs else {}
 
 
 @dataclasses.dataclass
@@ -609,9 +620,34 @@ class MotionCorrector:
     ):
         base = config if config is not None else CorrectorConfig()
         self.config = base.replace(model=model, **overrides)
-        self.backend_name = backend
-        options = {"mesh": mesh} if mesh is not None else {}
-        self.backend = get_backend(backend, self.config, **options)
+        if isinstance(backend, str):
+            self.backend_name = backend
+            options = {"mesh": mesh} if mesh is not None else {}
+            self.backend = get_backend(backend, self.config, **options)
+        else:
+            # A constructed backend INSTANCE: the serving layer's seam —
+            # many per-stream correctors share one warm backend (and its
+            # compiled batch programs / mesh) instead of each paying
+            # construction + JIT. The caller owns config compatibility;
+            # a mismatched config would silently register with the
+            # wrong compiled knobs, so it is checked here.
+            if mesh is not None:
+                raise ValueError(
+                    "mesh= cannot be combined with a backend instance "
+                    "(the instance already owns its mesh)"
+                )
+            shared_cfg = getattr(backend, "config", None)
+            if shared_cfg is not None and shared_cfg != self.config:
+                raise ValueError(
+                    "shared backend instance was built for a different "
+                    "CorrectorConfig than this corrector's — construct "
+                    "the corrector with the backend's config (serve "
+                    "sessions must not change compiled-program knobs)"
+                )
+            self.backend_name = getattr(
+                backend, "name", type(backend).__name__
+            )
+            self.backend = backend
         self.reference = reference
         self.reference_window = reference_window
         self.template_iters = template_iters
@@ -656,6 +692,45 @@ class MotionCorrector:
         # Per-run observability coordinator (obs/run.RunTelemetry),
         # armed by _begin_telemetry; None = everything off.
         self._telemetry = None
+
+    def stream_view(
+        self,
+        reference=None,
+        template_update_every: int | None = None,
+        template_update_alpha: float | None = None,
+    ) -> "MotionCorrector":
+        """A per-stream corrector sharing THIS corrector's warm backend.
+
+        The serving layer (`kcmc_tpu/serve`) multiplexes many client
+        streams through one resident backend; each stream needs its own
+        run-scoped state — reference, rolling-template history, rescue/
+        escalation counters, robustness report — which lives on the
+        corrector, not the backend. A view is that state container:
+        construction is cheap (no backend build, no JIT — the compiled
+        batch programs are the backend's), and the view accepts only
+        the knobs that are per-stream by nature (reference selection,
+        rolling-template cadence). Everything compiled-program-shaping
+        stays pinned to the shared config.
+        """
+        return MotionCorrector(
+            model=self.config.model,
+            backend=self.backend,
+            reference=self.reference if reference is None else reference,
+            config=self.config,
+            reference_window=self.reference_window,
+            template_iters=self.template_iters,
+            template_window=self.template_window,
+            template_update_every=(
+                self.template_update_every
+                if template_update_every is None
+                else template_update_every
+            ),
+            template_update_alpha=(
+                self.template_update_alpha
+                if template_update_alpha is None
+                else template_update_alpha
+            ),
+        )
 
     # -- observability ---------------------------------------------------
 
@@ -1310,9 +1385,7 @@ class MotionCorrector:
         else:
             cat = np.concatenate
             empty = np.empty((0,) + tuple(stack.shape[1:]), np.float32)
-        merged = {
-            k: cat([o[k] for o in outs]) for k in outs[0]
-        } if outs else {}
+        merged = merge_outputs(outs, cat=cat)
         corrected = merged.pop("corrected", empty)
         if not device_outputs:
             corrected = _cast_output(corrected, out_dt)  # no-op if device-cast
@@ -2344,9 +2417,7 @@ class MotionCorrector:
                 if writer is not None:
                     writer.close()
 
-        merged = {
-            k: np.concatenate([o[k] for o in outs]) for k in outs[0]
-        } if outs else {}
+        merged = merge_outputs(outs)
         corrected = merged.pop(
             "corrected", np.empty((0,) + ts.frame_shape, np.float32)
         )

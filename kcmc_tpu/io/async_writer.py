@@ -41,6 +41,8 @@ class AsyncBatchWriter:
         self._tracer = tracer
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._exc: BaseException | None = None
+        self._exc_lock = threading.Lock()
+        self._close_lock = threading.Lock()
         self._closed = False
         self._stats = {
             "backpressure_s": 0.0,  # consumer blocked on a full queue
@@ -78,26 +80,40 @@ class AsyncBatchWriter:
                 self._q.task_done()
 
     def _check(self) -> None:
-        if self._exc is not None:
+        # The read-and-clear is atomic across threads: a pending worker
+        # failure surfaces on exactly ONE caller (serving tears writers
+        # down from the scheduler thread while the opener may also be
+        # closing — both racing into here must not both re-raise).
+        with self._exc_lock:
             exc, self._exc = self._exc, None
+        if exc is not None:
             raise exc
 
     # -- consumer-side protocol -------------------------------------------
 
     def append_batch(self, frames, n_threads: int = 0) -> None:
-        self._check()
-        item = (frames, n_threads)
-        try:
-            self._q.put_nowait(item)
-        except queue.Full:
-            t0 = time.perf_counter()
-            self._q.put(item)
-            dt = time.perf_counter() - t0
-            self._stats["backpressure_s"] += dt
-            if self._tracer is not None:
-                self._tracer.complete(
-                    "writer.backpressure", t0, dt, cat="stall"
-                )
+        # The closed-check and enqueue happen under the close lock:
+        # otherwise a concurrent close() could slip between them, retire
+        # the worker, and leave this batch silently parked behind the
+        # shutdown sentinel — written to nobody. A close() racing a
+        # backpressure-blocked append waits for it (the worker is still
+        # draining, so the put always completes).
+        with self._close_lock:
+            if self._closed:
+                raise ValueError("append_batch on a closed AsyncBatchWriter")
+            self._check()
+            item = (frames, n_threads)
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:
+                t0 = time.perf_counter()
+                self._q.put(item)
+                dt = time.perf_counter() - t0
+                self._stats["backpressure_s"] += dt
+                if self._tracer is not None:
+                    self._tracer.complete(
+                        "writer.backpressure", t0, dt, cat="stall"
+                    )
         # re-check AFTER enqueuing so a worker failure surfaces at most
         # one append late, not only at close
         self._check()
@@ -129,11 +145,20 @@ class AsyncBatchWriter:
 
     def close(self) -> None:
         """Flush, stop the worker, close the inner writer; re-raises a
-        pending worker failure (idempotent)."""
-        if self._thread.is_alive():
-            self._q.put(None)
-            self._thread.join()
-        if not self._closed:
-            self._closed = True
-            self.writer.close()
+        pending worker failure.
+
+        Idempotent AND thread-safe: the serving scheduler tears down a
+        session's writer from ITS thread while the session opener (or a
+        `finally` on the submitting thread) may close concurrently —
+        exactly one caller performs the teardown, any concurrent caller
+        blocks until it is done, and a pending worker error surfaces
+        exactly once across all of them (`_check`'s atomic
+        read-and-clear)."""
+        with self._close_lock:
+            if not self._closed:
+                self._closed = True
+                if self._thread.is_alive():
+                    self._q.put(None)
+                    self._thread.join()
+                self.writer.close()
         self._check()

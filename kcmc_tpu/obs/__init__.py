@@ -21,6 +21,7 @@ __all__ = [
     "Tracer",
     "FrameRecordStream",
     "Heartbeat",
+    "aggregate_sampler",
     "RunTelemetry",
     "build_manifest",
     "get_logger",
@@ -38,10 +39,10 @@ def __getattr__(name):  # lazy: obs imports must not tax the hot path
         from kcmc_tpu.obs.records import FrameRecordStream
 
         return FrameRecordStream
-    if name == "Heartbeat":
-        from kcmc_tpu.obs.heartbeat import Heartbeat
+    if name in ("Heartbeat", "aggregate_sampler"):
+        from kcmc_tpu.obs import heartbeat
 
-        return Heartbeat
+        return getattr(heartbeat, name)
     if name == "RunTelemetry":
         from kcmc_tpu.obs.run import RunTelemetry
 

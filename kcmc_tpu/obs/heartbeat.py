@@ -95,3 +95,58 @@ class Heartbeat:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+def aggregate_sampler(snapshot):
+    """Build a `Heartbeat` sample() over MANY live sessions.
+
+    A single run's heartbeat narrates one progress counter; the serving
+    layer has N concurrent streams plus a scheduler, so its liveness
+    line aggregates: per-session frames/fps, totals, scheduler queue
+    depths, and admission decisions. `snapshot()` returns a dict:
+
+    * ``sessions`` — list of ``{"name", "frames", "fps"}`` (required;
+      an empty list emits an idle line);
+    * ``queues`` — optional ``{session name: queued frames}``;
+    * ``admission`` — optional counters dict (e.g. ``accepted``,
+      ``degraded``, ``rejected``) — rendered only when any is nonzero;
+    * ``extra`` — optional pre-formatted string appended verbatim.
+
+    Returns the sample callable to hand to ``Heartbeat``.
+    """
+
+    def sample() -> str:
+        snap = snapshot()
+        sessions = snap.get("sessions") or []
+        if not sessions:
+            parts = ["0 sessions (idle)"]
+        else:
+            total = sum(int(s.get("frames", 0)) for s in sessions)
+            fps = sum(float(s.get("fps", 0.0)) for s in sessions)
+            parts = [
+                f"{len(sessions)} session(s), {total} frames total, "
+                f"{fps:.1f} fps",
+                " ".join(
+                    f"{s.get('name', '?')}={int(s.get('frames', 0))}"
+                    f"@{float(s.get('fps', 0.0)):.1f}fps"
+                    for s in sessions
+                ),
+            ]
+        queues = snap.get("queues")
+        if queues:
+            parts.append(
+                "queued "
+                + " ".join(f"{k}={int(v)}" for k, v in sorted(queues.items()))
+            )
+        admission = snap.get("admission")
+        if admission and any(admission.values()):
+            parts.append(
+                "admission "
+                + " ".join(f"{k}={v}" for k, v in sorted(admission.items()))
+            )
+        extra = snap.get("extra")
+        if extra:
+            parts.append(str(extra))
+        return ", ".join(parts)
+
+    return sample

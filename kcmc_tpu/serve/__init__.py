@@ -1,0 +1,53 @@
+"""kcmc_tpu.serve — the multi-tenant resident serving layer.
+
+One-shot CLI runs pay JIT warm-up, own the whole mesh, and die with
+their input file. This package keeps ONE warm backend (and mesh)
+resident and multiplexes many concurrent client streams through the
+existing registration pipeline (docs/SERVING.md):
+
+* `session.Session` — stream-scoped state (reference keypoints,
+  rolling-template history, cursor, writer, per-session telemetry)
+  decoupled from process lifetime; built on
+  `MotionCorrector.stream_view` so every session shares the resident
+  backend's compiled batch programs;
+* `scheduler.StreamScheduler` — batches ready frames across sessions
+  into one bounded in-flight dispatch window (per-entry reference, the
+  PR-3 seam), weighted round-robin fairness, admission control that
+  DEGRADES consensus budgets under load before it ever rejects;
+* `server.ServeServer` / `client.ServeClient` — a line-delimited
+  JSON-over-TCP transport (`open_session` / `submit_frames` /
+  `results` / `close_session` / `stats`) behind the `kcmc_tpu serve`
+  CLI entrypoint.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "Session",
+    "SessionClosed",
+    "StreamScheduler",
+    "OverloadedError",
+    "ServeServer",
+    "ServeClient",
+    "ServeError",
+]
+
+
+def __getattr__(name):  # lazy: importing kcmc_tpu.serve must stay cheap
+    if name in ("Session", "SessionClosed"):
+        from kcmc_tpu.serve import session
+
+        return getattr(session, name)
+    if name in ("StreamScheduler", "OverloadedError"):
+        from kcmc_tpu.serve import scheduler
+
+        return getattr(scheduler, name)
+    if name == "ServeServer":
+        from kcmc_tpu.serve.server import ServeServer
+
+        return ServeServer
+    if name in ("ServeClient", "ServeError"):
+        from kcmc_tpu.serve import client
+
+        return getattr(client, name)
+    raise AttributeError(f"module 'kcmc_tpu.serve' has no attribute {name!r}")

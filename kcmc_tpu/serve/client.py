@@ -1,0 +1,164 @@
+"""ServeClient: the bundled Python client for `kcmc_tpu serve`.
+
+A thin, stdlib-only wrapper over the line-delimited JSON protocol
+(serve/proto.py) used by the tests, the CI serve job, and
+examples/serving.py:
+
+    from kcmc_tpu.serve.client import ServeClient
+
+    with ServeClient(port=7733) as c:
+        sid = c.open_session(tenant="scope-A")
+        c.submit(sid, frames)           # any number of times
+        final = c.close_session(sid)    # {"transforms": (T,3,3), ...}
+
+One socket per client; calls are serialized with a lock (the protocol
+is strict request/response). Open several clients for concurrent
+streams — the server multiplexes them onto its one warm backend.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from kcmc_tpu.serve import proto
+
+
+class ServeError(RuntimeError):
+    """Server-reported failure; `.code` carries the protocol code
+    (429 = admission rejection, 400 = bad request, 500 = stream
+    failure)."""
+
+    def __init__(self, message: str, code: int = 500, **info):
+        super().__init__(message)
+        self.code = int(code)
+        self.info = info
+
+
+class ServeClient:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 7733,
+        timeout: float = 600.0,
+    ):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._wfile = self._sock.makefile("wb")
+        self._lock = threading.Lock()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _call(self, op: str, **fields) -> dict:
+        with self._lock:
+            proto.send_msg(self._wfile, {"op": op, **fields})
+            resp = proto.recv_msg(self._rfile, max_line=None)
+        if resp is None:
+            raise ServeError("server closed the connection", code=500)
+        if not resp.get("ok"):
+            raise ServeError(
+                resp.get("error", "unknown server error"),
+                code=int(resp.get("code", 500)),
+                **{
+                    k: v
+                    for k, v in resp.items()
+                    if k not in ("ok", "error", "code")
+                },
+            )
+        return resp
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+            self._wfile.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- ops ----------------------------------------------------------------
+
+    def ping(self) -> bool:
+        return bool(self._call("ping").get("ok"))
+
+    def open_session(
+        self,
+        tenant: str = "default",
+        weight: int = 1,
+        reference: np.ndarray | None = None,
+        template_update: int | None = None,
+        emit: bool = False,
+        output: str | None = None,
+        expected_frames: int | None = None,
+        output_dtype: str = "float32",
+        compression: str = "none",
+    ) -> str:
+        fields: dict = {
+            "tenant": tenant,
+            "weight": weight,
+            "emit": emit,
+            "output_dtype": output_dtype,
+            "compression": compression,
+        }
+        if reference is not None:
+            fields["reference"] = proto.encode_array(
+                np.asarray(reference, np.float32)
+            )
+        if template_update is not None:
+            fields["template_update"] = int(template_update)
+        if output is not None:
+            fields["output"] = output
+            fields["expected_frames"] = int(expected_frames)
+        return self._call("open_session", **fields)["session"]
+
+    def submit(self, session: str, frames: np.ndarray) -> dict:
+        """Submit frames; returns the admission decision
+        ``{"accepted", "queued", "degraded"}``. Raises ServeError with
+        ``code == 429`` when the session queue is full."""
+        return {
+            k: v
+            for k, v in self._call(
+                "submit_frames",
+                session=session,
+                frames=proto.encode_array(np.asarray(frames)),
+            ).items()
+            if k != "ok"
+        }
+
+    def results(self, session: str, timeout: float = 60.0) -> dict | None:
+        """Fetch the next undelivered span of per-frame outputs (blocks
+        server-side until some are ready). None once the stream is
+        closed and exhausted."""
+        resp = self._call("results", session=session, timeout=timeout)
+        if resp.get("exhausted"):
+            return None
+        return proto.decode_arrays(
+            {k: v for k, v in resp.items() if k != "ok"}
+        )
+
+    def close_session(self, session: str, timeout: float = 300.0) -> dict:
+        """Finish the stream; returns the final merged outputs —
+        ``transforms``/``fields``, ``diagnostics`` (decoded arrays),
+        ``timing``, ``frames``, and ``corrected`` when the session was
+        opened with ``emit=True``."""
+        resp = self._call("close_session", session=session, timeout=timeout)
+        out = {k: v for k, v in resp.items() if k != "ok"}
+        for key in ("transforms", "fields", "corrected"):
+            if key in out:
+                out[key] = proto.decode_array(out[key])
+        if "diagnostics" in out:
+            out["diagnostics"] = proto.decode_arrays(out["diagnostics"])
+        return out
+
+    def stats(self) -> dict:
+        return self._call("stats")["stats"]
+
+    def shutdown(self) -> dict:
+        """Ask the server process to exit cleanly; returns final stats."""
+        return self._call("shutdown").get("stats", {})

@@ -1,0 +1,103 @@
+"""Wire protocol for `kcmc_tpu serve`: line-delimited JSON over TCP.
+
+One JSON object per line in each direction (stdlib-only, debuggable
+with `nc`). Requests carry ``{"op": ..., ...}``; responses carry
+``{"ok": true, ...}`` or ``{"ok": false, "error": str, "code": int}``.
+Arrays travel as ``{"__nd__": <base64 raw little-endian bytes>,
+"dtype": str, "shape": [...]}`` — base64 of the raw buffer, not JSON
+numbers, so a frame batch costs ~1.33x its byte size instead of ~5x.
+
+Ops (docs/SERVING.md has the full field tables):
+
+* ``open_session`` — tenant/weight/reference/template_update/emit/
+  output(+expected_frames)/output_dtype -> ``{"session": id}``
+* ``submit_frames`` — session + frames -> admission decision (or a
+  429-coded error when rejected)
+* ``results`` — session [+ timeout] -> next undelivered span of
+  per-frame outputs (blocks until available)
+* ``close_session`` — session [+ timeout] -> final merged outputs
+* ``stats`` — scheduler gauges (sessions, queues, occupancy, admission)
+* ``ping`` / ``shutdown``
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import numpy as np
+
+ARRAY_KEY = "__nd__"
+
+
+def encode_array(arr) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {
+        ARRAY_KEY: base64.b64encode(arr.tobytes()).decode("ascii"),
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+    }
+
+
+def decode_array(obj: dict) -> np.ndarray:
+    raw = base64.b64decode(obj[ARRAY_KEY])
+    return np.frombuffer(raw, dtype=np.dtype(obj["dtype"])).reshape(
+        obj["shape"]
+    ).copy()
+
+
+def is_array(obj) -> bool:
+    return isinstance(obj, dict) and ARRAY_KEY in obj
+
+
+def encode_arrays(d: dict) -> dict:
+    """Encode every ndarray value of a flat dict (non-arrays pass
+    through; numpy scalars become Python numbers)."""
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, np.ndarray):
+            out[k] = encode_array(v)
+        elif isinstance(v, np.generic):
+            out[k] = v.item()
+        else:
+            out[k] = v
+    return out
+
+
+def decode_arrays(d: dict) -> dict:
+    return {k: decode_array(v) if is_array(v) else v for k, v in d.items()}
+
+
+def send_msg(wfile, obj: dict) -> None:
+    wfile.write((json.dumps(obj) + "\n").encode("utf-8"))
+    wfile.flush()
+
+
+# Hard cap on one message line. A newline-free byte stream must not
+# buffer unboundedly in a handler thread (one rogue connection taking
+# down every tenant of a server whose headline feature is admission
+# control); 512 MiB comfortably fits the largest legitimate submit
+# (a full default-queue-depth batch of large frames, base64-encoded).
+MAX_LINE = 512 * 1024 * 1024
+
+
+def recv_msg(rfile, max_line: int | None = MAX_LINE) -> dict | None:
+    """Read one message; None on a cleanly closed connection. Raises
+    ValueError on an over-long or newline-less (truncated) line.
+    `max_line=None` lifts the cap (the CLIENT reads responses it asked
+    for — a merged emit=True close_session can legitimately be huge;
+    the server NEVER lifts it for untrusted request bytes)."""
+    if max_line is None:
+        line = rfile.readline()
+        if not line:
+            return None
+    else:
+        line = rfile.readline(max_line + 1)
+        if not line:
+            return None
+        if len(line) > max_line or not line.endswith(b"\n"):
+            raise ValueError(
+                f"message line exceeds {max_line} bytes or was "
+                "truncated mid-line"
+            )
+    return json.loads(line.decode("utf-8"))

@@ -1,0 +1,737 @@
+"""StreamScheduler: cross-session batching, fairness, and QoS admission.
+
+The resident serving plane's core loop. One warm backend (owned by the
+corrector the scheduler is built around) serves every session:
+
+* **Cross-stream batching** — ready frames are taken per session (each
+  device batch carries ONE session's reference, the per-entry-ref
+  dispatch seam from the zero-stall pipeline) and interleaved through a
+  single bounded in-flight window (`serve_inflight` batches), so the
+  upload of one tenant's batch overlaps the compute of another's and
+  the accelerator never idles while ANY stream has work.
+* **Fairness** — weighted round-robin across sessions with ready
+  frames: a session opened with weight w gets w interleaved slots per
+  cycle, so a bulk-backfill tenant cannot starve a live interactive
+  stream.
+* **Admission control + QoS** — a submit that would push a session's
+  pending queue past `serve_queue_depth` is rejected 429-style, but
+  rejection is the LAST resort: past `serve_degrade_watermark` of the
+  bound, the session's batches dispatch through a degraded backend
+  (reduced RANSAC hypothesis budget and refine/polish passes — the
+  consensus-stage rungs of the robustness ladder, which never change
+  reference preparation) so the backlog drains faster at reduced
+  accuracy instead of being refused. Decisions are counted in
+  `stats()` and narrated by the aggregate heartbeat.
+
+Device errors walk the SAME degradation ladder as one-shot runs
+(retry -> numpy failover -> mark-failed + trajectory rescue), per
+session, via each session's corrector view; a fatal error fails that
+ONE stream, never the serving process.
+
+Threading model: ONE scheduler thread owns dispatch, drains, template
+updates, and finalization; client threads only enqueue (submit/open/
+close) under the scheduler lock and wait on per-session conditions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from kcmc_tpu.obs.log import advise
+
+
+class OverloadedError(RuntimeError):
+    """429-style admission rejection: the session's queue is full even
+    after QoS degradation engaged. Carries `.code` for transports."""
+
+    code = 429
+
+    def __init__(self, message: str, queued: int, limit: int):
+        super().__init__(message)
+        self.queued = int(queued)
+        self.limit = int(limit)
+
+
+class StreamScheduler:
+    """Multiplex concurrent `Session` streams through one warm backend.
+
+    `corrector` is the resident MotionCorrector whose backend (and
+    compiled batch programs) every session shares; its config supplies
+    `batch_size` and the serve_* QoS knobs.
+    """
+
+    def __init__(self, corrector, heartbeat_s: float = 0.0):
+        self.mc = corrector
+        cfg = corrector.config
+        self.B = cfg.batch_size
+        self.inflight_depth = cfg.serve_inflight
+        self.queue_depth = cfg.serve_queue_depth
+        self.watermark = cfg.serve_degrade_watermark
+        # RLock: paths like a take_batch failure call session methods
+        # (fail -> _cond, built on this same lock) while already
+        # holding it — reentrancy beats a deadlock class.
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._sessions: dict[str, object] = {}
+        self._reserved: set = set()  # sids mid-construction (open_session)
+        self._order: list[str] = []  # weighted round-robin schedule
+        self._rr = 0
+        self._window: deque = deque()  # in-flight entries (scheduler thread)
+        self._degraded_backend = None
+        self._degraded_build = threading.Lock()
+        # Frame shapes whose degraded-budget programs have been warmed
+        # (and those with a warm-up in flight or permanently failed —
+        # never re-attempted). See _warm_degraded_shape.
+        self._degraded_warm_started: set = set()
+        # Recently closed session ids: a `results` poll racing a
+        # concurrent close must read "exhausted", not "no such session"
+        # (bounded — ids only, never session state).
+        self._closed_ids: set = set()
+        self._closed_order: deque = deque(maxlen=4096)
+        # The most recently closed Session OBJECTS, so a close_session
+        # that timed out client-side can be retried without losing the
+        # stream's final result, and a late results poll can still
+        # deliver undelivered spans. Small and bounded — these retain
+        # result arrays (pixels included for emit sessions).
+        self._recent: dict[str, object] = {}
+        self._recent_depth = 16
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._heartbeat = None
+        self._heartbeat_s = float(heartbeat_s)
+        self._seq = 0
+        self._stats = {
+            "accepted_frames": 0,
+            "rejected_submits": 0,
+            "rejected_frames": 0,
+            "degrade_events": 0,
+            "degraded_batches": 0,
+            "batches": 0,
+            "occupied_frames": 0,  # valid frames across dispatched batches
+            "frames_done": 0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StreamScheduler":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="kcmc-serve-scheduler", daemon=True
+        )
+        self._thread.start()
+        if self.watermark < 1.0:
+            # Prewarm the QoS escape hatch's CONSTRUCTION (backend +
+            # mesh setup). Its compiled batch programs are shape-
+            # dependent, so those warm later, per shape, as sessions'
+            # references are prepared (_warm_degraded_shape) — well
+            # before overload can engage on that shape.
+            threading.Thread(
+                target=self._warm_degraded,
+                name="kcmc-serve-degraded-warm",
+                daemon=True,
+            ).start()
+        if self._heartbeat_s > 0:
+            from kcmc_tpu.obs.heartbeat import Heartbeat, aggregate_sampler
+
+            self._heartbeat = Heartbeat(
+                self._heartbeat_s, aggregate_sampler(self.snapshot)
+            )
+            self._heartbeat.start()
+        return self
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Stop the scheduler thread. In-flight batches drain; sessions
+        still open are finalized (complete streams) or failed (streams
+        with frames left) — a clean shutdown closes sessions first."""
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+        with self._wake:
+            self._running = False
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def __enter__(self) -> "StreamScheduler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- session management (client threads) -------------------------------
+
+    def open_session(
+        self,
+        tenant: str = "default",
+        weight: int = 1,
+        reference=None,
+        template_update_every: int | None = None,
+        emit_frames: bool = False,
+        output: str | None = None,
+        expected_frames: int | None = None,
+        output_dtype="float32",
+        compression: str = "none",
+        session_id: str | None = None,
+        telemetry: bool = True,
+    ):
+        """Open a stream: builds a per-session corrector view sharing
+        the warm backend, registers it with the fairness schedule, and
+        returns the `Session`."""
+        from kcmc_tpu.serve.session import Session
+
+        view = self.mc.stream_view(
+            reference=reference,
+            template_update_every=template_update_every,
+        )
+        ref_arr = None
+        if isinstance(reference, np.ndarray):
+            # Validate BEFORE any session state exists: a bad reference
+            # must fail without arming (and leaking) telemetry
+            # artifact-path claims.
+            ref_arr = np.asarray(reference, np.float32)
+            if ref_arr.ndim != 2:
+                raise ValueError(
+                    f"reference frame must be 2-D, got shape "
+                    f"{ref_arr.shape}"
+                )
+        with self._wake:
+            if not self._running:
+                raise RuntimeError("scheduler is not running")
+            self._seq += 1
+            sid = session_id if session_id else f"s{self._seq:04d}"
+            if sid in self._sessions or sid in self._reserved:
+                raise ValueError(f"session id {sid!r} already open")
+            self._reserved.add(sid)
+        # Construct OUTSIDE the plane lock: telemetry arming builds a
+        # manifest (version probes, config digest) — other tenants'
+        # submits and the scheduler loop must not stall behind it. The
+        # reservation above keeps the sid unique meanwhile.
+        sess = None
+        try:
+            sess = Session(
+                view, self._lock, sid, tenant=tenant, weight=weight,
+                emit_frames=emit_frames, output=output,
+                expected_frames=expected_frames, output_dtype=output_dtype,
+                compression=compression, telemetry=telemetry,
+            )
+            if ref_arr is not None:
+                sess.set_reference(ref_arr)
+            with self._wake:
+                self._sessions[sid] = sess
+                self._rebuild_order()
+                self._wake.notify_all()
+            return sess
+        except BaseException as e:
+            # A constructed-but-never-registered session still owns
+            # telemetry (artifact-path claims): release it, or the
+            # registry treats those paths as live forever.
+            if sess is not None and sess.telemetry is not None:
+                try:
+                    sess.telemetry.close(e)
+                except Exception:
+                    pass
+            raise
+        finally:
+            with self._wake:
+                self._reserved.discard(sid)
+
+    def _rebuild_order(self) -> None:
+        # Weighted round-robin schedule: a session with weight w appears
+        # w times per cycle, interleaved (not clustered) so a heavy
+        # tenant's extra slots spread across the cycle.
+        sids = sorted(self._sessions)
+        if not sids:
+            self._order = []
+            self._rr = 0
+            return
+        maxw = max(self._sessions[s].weight for s in sids)
+        self._order = [
+            s
+            for round_i in range(maxw)
+            for s in sids
+            if round_i < self._sessions[s].weight
+        ]
+        self._rr %= len(self._order)
+
+    def submit(self, session_id: str, frames) -> dict:
+        """Admission-controlled submit. Returns a decision dict
+        ``{"accepted", "queued", "degraded"}``; raises OverloadedError
+        when the queue bound is exceeded (the last resort — QoS
+        degradation engages first, at the watermark)."""
+        frames = np.asarray(frames)
+        n = 1 if frames.ndim == 2 else len(frames)
+        with self._wake:
+            sess = self._get(session_id)
+            queued = sess.backlog()
+            if queued + n > self.queue_depth:
+                self._stats["rejected_submits"] += 1
+                self._stats["rejected_frames"] += n
+                raise OverloadedError(
+                    f"session {session_id}: queue {queued}+{n} frames "
+                    f"exceeds serve_queue_depth={self.queue_depth} "
+                    "(submit less per call, or wait for results)",
+                    queued=queued, limit=self.queue_depth,
+                )
+            engage = (
+                not sess.degraded
+                and self.watermark < 1.0
+                and queued + n > self.watermark * self.queue_depth
+            )
+            # Validate/admit BEFORE flipping QoS state: a mis-shaped
+            # submit raises here and must not leave the session
+            # permanently degraded by load it never added.
+            sess.add_frames(frames)
+            self._stats["accepted_frames"] += n
+            if engage:
+                sess.degraded = True
+                self._stats["degrade_events"] += 1
+                advise(
+                    f"kcmc serve: session {session_id} backlog "
+                    f"{queued + n}/{self.queue_depth} frames passed the "
+                    f"{self.watermark:.0%} watermark; dispatching its "
+                    "batches at degraded consensus budgets until it drains",
+                    stacklevel=2,
+                )
+            self._wake.notify_all()
+            return {
+                "accepted": n,
+                "queued": sess.backlog(),
+                "degraded": sess.degraded,
+            }
+
+    def close_session(self, session_id: str, timeout: float | None = None):
+        """Mark a stream complete; block until its remaining frames
+        drain and it finalizes. Returns the final CorrectionResult.
+        Retryable: a close that timed out client-side can be reissued —
+        a recently reaped session still returns its final result
+        (transforms/diagnostics; retained results drop emit pixels)."""
+        with self._wake:
+            sess = self._sessions.get(session_id)
+            if sess is not None:
+                sess.begin_close()
+                self._wake.notify_all()
+        if sess is None:
+            # Already finalized and reaped (e.g. a retry after a
+            # timed-out close): result() returns immediately.
+            sess = self.lookup_session(session_id)
+        return sess.result(timeout=timeout)
+
+    def _get(self, session_id: str):
+        sess = self._sessions.get(session_id)
+        if sess is None:
+            raise KeyError(f"no open session {session_id!r}")
+        return sess
+
+    def session_closed(self, session_id: str) -> bool:
+        """Whether `session_id` was a real session that has since
+        closed (vs never existing) — lets a `results` poll racing a
+        concurrent close report "exhausted" instead of erroring."""
+        with self._lock:
+            return session_id in self._closed_ids
+
+    def lookup_session(self, session_id: str):
+        """A live session, or a recently closed one retained for late
+        result()/fetch() reads (e.g. a close_session retry after a
+        client-side timeout); KeyError otherwise."""
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is None:
+                sess = self._recent.get(session_id)
+        if sess is None:
+            raise KeyError(f"no open session {session_id!r}")
+        return sess
+
+    def _record_closed_locked(self, sess) -> None:
+        if len(self._closed_order) == self._closed_order.maxlen:
+            self._closed_ids.discard(self._closed_order[0])
+        self._closed_order.append(sess.sid)
+        self._closed_ids.add(sess.sid)
+        # Retention must not pin pixels: an emit session's final result
+        # holds the whole corrected stack, so once a client has RECEIVED
+        # it (delivered flag — an undelivered result stays whole for the
+        # still-blocked/retrying waiter), a later retried close gets
+        # transforms/diagnostics only. Undelivered `results` spans in
+        # _outs keep their pixels — a racing poll still gets them, and
+        # fetch releases each span as it delivers.
+        res = sess._result
+        if sess._result_delivered and res is not None and (
+            res.corrected is not None and len(res.corrected)
+        ):
+            sess._result = dataclasses.replace(
+                res, corrected=np.empty((0,), np.float32)
+            )
+        self._recent[sess.sid] = sess
+        while len(self._recent) > self._recent_depth:
+            self._recent.pop(next(iter(self._recent)))
+
+    # -- stats / heartbeat --------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            st = dict(self._stats)
+            inflight = len(self._window)
+        batches = max(st["batches"], 1)
+        return {
+            "sessions_open": len(sessions),
+            "queues": {s.sid: s.backlog() for s in sessions},
+            "inflight_batches": inflight,
+            "batch_size": self.B,
+            "batch_occupancy": round(
+                st["occupied_frames"] / (batches * self.B), 4
+            ),
+            "frames_done": st["frames_done"],
+            "admission": {
+                "accepted_frames": st["accepted_frames"],
+                "rejected_submits": st["rejected_submits"],
+                "rejected_frames": st["rejected_frames"],
+                "degrade_events": st["degrade_events"],
+                "degraded_batches": st["degraded_batches"],
+                "degraded_active": sorted(
+                    s.sid for s in sessions if s.degraded
+                ),
+            },
+        }
+
+    def snapshot(self) -> dict:
+        """Aggregate-heartbeat snapshot (obs.heartbeat.aggregate_sampler)."""
+        with self._lock:
+            sessions = list(self._sessions.values())
+            st = dict(self._stats)
+            inflight = len(self._window)
+        batches = max(st["batches"], 1)
+        return {
+            "sessions": [s.snapshot() for s in sessions],
+            "queues": {s.sid: s.backlog() for s in sessions},
+            "admission": {
+                "rejected": st["rejected_frames"],
+                "degraded": st["degraded_batches"],
+            },
+            "extra": (
+                f"occupancy={st['occupied_frames'] / (batches * self.B):.2f}"
+                f" inflight={inflight}"
+            ),
+        }
+
+    # -- QoS ----------------------------------------------------------------
+
+    def _get_degraded_backend(self):
+        """The reduced-budget backend overload dispatches through: the
+        consensus-stage knobs shrink (hypothesis budgets, refine/polish
+        passes) while every reference-preparation knob stays identical,
+        so a session's prepared reference is valid on both backends.
+        Built once (prewarmed from `start`; the build lock keeps the
+        warm thread and the scheduler thread from racing)."""
+        with self._degraded_build:
+            if self._degraded_backend is None:
+                from kcmc_tpu.backends import get_backend
+
+                cfg = self.mc.config
+                dcfg = cfg.replace(
+                    n_hypotheses=max(16, cfg.n_hypotheses // 4),
+                    refine_iters=min(cfg.refine_iters, 1),
+                    patch_hypotheses=max(8, cfg.patch_hypotheses // 4),
+                    field_passes=1,
+                    field_polish=min(int(cfg.field_polish), 1),
+                    transform_polish=0,
+                )
+                self._degraded_backend = get_backend(
+                    self.mc.backend_name, dcfg
+                )
+            return self._degraded_backend
+
+    def _warm_degraded(self) -> None:
+        try:
+            self._get_degraded_backend()
+        except Exception as e:
+            advise(
+                f"kcmc serve: degraded-backend prewarm failed ({e}); "
+                "overloaded batches will dispatch at full budgets",
+                stacklevel=2,
+            )
+
+    def _maybe_warm_degraded_shape(self, sess) -> None:
+        """Kick a background compile of the degraded backend's batch
+        program for `sess`'s frame shape, once per shape. Called right
+        after the session's reference is prepared — the queue cannot
+        reach the watermark before at least one reference exists, so
+        the warm-up races only the RAMP to overload, not overload
+        itself; without it, the first degraded dispatch would pay the
+        reduced-budget JIT inline on the scheduler thread at peak
+        backlog."""
+        if self.watermark >= 1.0 or sess.ref_frame is None:
+            return
+        shape = tuple(sess.frame_shape)
+        with self._lock:
+            if shape in self._degraded_warm_started:
+                return
+            self._degraded_warm_started.add(shape)
+        ref, ref_frame = sess.ref, sess.ref_frame
+        threading.Thread(
+            target=self._warm_degraded_shape,
+            args=(shape, ref, ref_frame),
+            name="kcmc-serve-degraded-warm-shape",
+            daemon=True,
+        ).start()
+
+    def _warm_degraded_shape(self, shape, ref, ref_frame) -> None:
+        try:
+            backend = self._get_degraded_backend()
+            # The session's own reference content: realistic keypoints,
+            # and a reference prepared by the FULL backend is valid on
+            # the degraded one (reference-prep knobs are identical).
+            dummy = np.broadcast_to(
+                ref_frame, (self.B,) + shape
+            ).astype(np.float32)
+            out = backend.process_batch(dummy, ref, np.arange(self.B))
+            for v in out.values():
+                np.asarray(v)  # block until the compile+run finished
+        except Exception as e:
+            advise(
+                f"kcmc serve: degraded-program warm-up for frame shape "
+                f"{shape} failed ({e}); the first overloaded batch of "
+                "that shape compiles inline",
+                stacklevel=2,
+            )
+
+    def _maybe_restore_locked(self, sess) -> None:
+        # Hysteresis: quality restores once the backlog drains below
+        # half the watermark (not the instant it dips under it).
+        if sess.degraded and sess.backlog() <= (
+            0.5 * self.watermark * self.queue_depth
+        ):
+            sess.degraded = False
+
+    # -- the scheduler loop --------------------------------------------------
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self._loop_once()
+            except Exception as e:
+                # The scheduler thread is the whole serving plane: an
+                # unexpected error must degrade to a warning, never
+                # wedge every tenant behind a dead loop. (Session-
+                # attributable failures are already routed to fail();
+                # this is the backstop for scheduler-side bugs.)
+                advise(
+                    f"kcmc serve: scheduler error "
+                    f"({type(e).__name__}: {e}); continuing",
+                    stacklevel=2,
+                )
+                time.sleep(0.05)
+        # Shutdown: drain in-flight work, then finalize complete streams
+        # and fail incomplete ones (waiters must not hang).
+        while self._window:
+            self._drain_one()
+        with self._lock:
+            leftovers = list(self._sessions.values())
+            self._sessions.clear()
+            for sess in leftovers:
+                self._record_closed_locked(sess)
+            self._rebuild_order()
+        for sess in leftovers:
+            if sess.closed:
+                continue
+            if not sess.drained_out():
+                sess.fail(RuntimeError("serve scheduler stopped mid-stream"))
+            sess.begin_close()
+            sess.finalize()
+
+    def _loop_once(self) -> None:
+        """One scheduler-loop iteration: dispatch a ready batch, else
+        drain, else idle-wait for work."""
+        self._prepare_references()
+        with self._wake:
+            picked = self._pick_locked() if self._running else None
+        if picked is not None:
+            sess, (n, batch, idx, ref), degraded = picked
+            backend = self.mc.backend
+            if degraded:
+                try:
+                    backend = self._get_degraded_backend()
+                except Exception:
+                    pass  # prewarm already advised; full budgets
+            entry = self._dispatch(
+                sess, backend, n, batch, idx, ref, degraded
+            )
+            if entry is not None:
+                self._window.append(entry)
+                while len(self._window) >= self.inflight_depth:
+                    self._drain_one()
+            self._finalize_ready()
+            return
+        if self._window:
+            self._drain_one()
+            self._finalize_ready()
+            return
+        self._finalize_ready()
+        with self._wake:
+            if self._running and self._pick_preview_locked() is None:
+                self._wake.wait(timeout=0.1)
+
+    def _prepare_references(self) -> None:
+        """Prepare staged references OUTSIDE the lock (device compute,
+        possibly a JIT compile — client submits must keep flowing on
+        every other session meanwhile). Scheduler thread only."""
+        with self._lock:
+            needing = [
+                s
+                for s in self._sessions.values()
+                if s.error is None and not s.closed and s.needs_reference()
+            ]
+        for sess in needing:
+            try:
+                sess.prepare_reference_now()
+            except BaseException as e:
+                sess.fail(e)
+            else:
+                self._maybe_warm_degraded_shape(sess)
+
+    def _pick_preview_locked(self):
+        """Whether ANY session has dispatchable or finalizable work
+        (idle-wait predicate; does not consume anything)."""
+        for sess in self._sessions.values():
+            if sess.error is None and not sess.closed and (
+                sess.ready_count() or sess.needs_reference()
+            ):
+                return sess
+            if sess.closing and not sess.closed and sess.drained_out():
+                return sess
+        return None
+
+    def _pick_locked(self):
+        """Weighted round-robin pick: returns (session, padded batch,
+        degraded flag) for the next session with ready frames, else
+        None."""
+        order = self._order
+        for i in range(len(order)):
+            sid = order[(self._rr + i) % len(order)]
+            sess = self._sessions.get(sid)
+            if sess is None or sess.closed or sess.error is not None:
+                continue
+            if sess.ready_count() > 0:
+                try:
+                    taken = sess.take_batch(self.B)
+                except Exception as e:
+                    # Batch-forming failure is that ONE stream's
+                    # problem (fail drops its pending frames, so this
+                    # cannot respin) — the plane keeps serving.
+                    sess.fail(e)
+                    continue
+                if taken is not None:
+                    self._rr = (self._rr + i + 1) % len(order)
+                    return sess, taken, sess.degraded
+        return None
+
+    def _finalize_ready(self) -> None:
+        """Finalize sessions whose streams fully drained after
+        begin_close, OUTSIDE the scheduler lock (writer close blocks),
+        then drop closed sessions from the schedule."""
+        with self._lock:
+            ready = [
+                s for s in self._sessions.values()
+                if s.closing and not s.closed and s.drained_out()
+            ]
+        for s in ready:
+            s.finalize()
+        with self._lock:
+            done = [(sid, s) for sid, s in self._sessions.items() if s.closed]
+            for sid, s in done:
+                del self._sessions[sid]
+                self._record_closed_locked(s)
+            if done:
+                self._rebuild_order()
+
+    def _dispatch(self, sess, backend, n, batch, idx, ref, degraded):
+        """Dispatch one session batch; on a dispatch-time error, flush
+        the window first (ordering + the ladder's synthesis template),
+        then walk the session's degradation ladder. Returns a window
+        entry, or None when the error path already accounted the
+        batch."""
+        if (
+            not getattr(backend, "accepts_native_dtype", False)
+            and batch.dtype != np.float32
+        ):
+            batch = batch.astype(np.float32)
+        dispatch = getattr(backend, "process_batch_async", None)
+        self._stats["batches"] += 1
+        self._stats["occupied_frames"] += int(n)
+        if degraded:
+            self._stats["degraded_batches"] += 1
+        kept = batch if sess.wants_pixels() else None
+        try:
+            if dispatch is not None:
+                out = dispatch(batch, ref, idx)
+            else:
+                out = backend.process_batch(batch, ref, idx)
+        except Exception as e:
+            while self._window:
+                self._drain_one()
+            self._ladder(sess, e, backend, batch, ref, idx, n, kept)
+            return None
+        return (sess, n, out, kept, batch, idx, ref, backend)
+
+    def _drain_one(self) -> None:
+        """Drain the oldest in-flight entry: materialize to host (where
+        a deferred async device error surfaces — it walks the ladder),
+        then hand the batch to its session."""
+        if not self._window:
+            return
+        sess, n, out, kept, batch, idx, ref, backend = self._window.popleft()
+        try:
+            # Registration-only sessions (no emit, no server-side file,
+            # no rolling template) never touch pixels: leave `corrected`
+            # on device instead of paying a (B, H, W) host transfer per
+            # batch — the same drop the one-shot registration-only path
+            # makes before materializing.
+            host = {
+                k: np.asarray(v)[:n]
+                for k, v in out.items()
+                if sess.wants_pixels() or k != "corrected"
+            }
+            sess.mc._note_out_template(host)
+        except Exception as e:
+            self._ladder(sess, e, backend, batch, ref, idx, n, kept)
+            return
+        self._account_done(sess, n, host, kept, ref)
+
+    def _ladder(self, sess, exc, backend, batch, ref, idx, n, kept) -> None:
+        """Walk the session's degradation ladder for a failed batch
+        (retry -> failover backend -> mark-failed); a fatal error fails
+        that ONE stream, never the serving process."""
+        try:
+            out, failed = sess.mc._ladder_batch(
+                exc, backend, batch, ref, idx, {}, None, n, True, None
+            )
+        except BaseException as e:
+            sess.fail(e)
+            sess.entry_done()
+            return
+        host = {
+            k: np.asarray(v)[:n]
+            for k, v in out.items()
+            if sess.wants_pixels() or k != "corrected"
+        }
+        kept = sess.mc._failed_kept(host, kept, failed)
+        self._account_done(sess, n, host, kept, ref)
+
+    def _account_done(self, sess, n, host, kept, ref) -> None:
+        try:
+            sess.on_drained(n, host, kept, ref)
+        except BaseException as e:
+            sess.fail(e)
+        finally:
+            sess.entry_done()
+        self._stats["frames_done"] += int(n)
+        with self._lock:
+            self._maybe_restore_locked(sess)

@@ -1,0 +1,263 @@
+"""ServeServer: the resident `kcmc_tpu serve` process.
+
+Wraps a `StreamScheduler` (one warm backend + mesh, many sessions) in a
+threading TCP server speaking the line-delimited JSON protocol
+(serve/proto.py). Each client connection gets a handler thread that
+translates ops into scheduler calls; all device work stays on the
+scheduler thread.
+
+The `kcmc_tpu serve` CLI entrypoint lives in `__main__.py` and calls
+`serve_main` here; the first stdout line is a machine-readable ready
+record (`{"serving": ..., "port": N}`) so supervisors and the CI job
+can wait for it, then the process serves until SIGINT/SIGTERM or a
+client `shutdown` op.
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import sys
+import threading
+
+import numpy as np
+
+from kcmc_tpu.serve import proto
+from kcmc_tpu.serve.scheduler import OverloadedError, StreamScheduler
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        server: "ServeServer" = self.server.kcmc_server  # type: ignore[attr-defined]
+        while True:
+            try:
+                msg = proto.recv_msg(self.rfile)
+            except (ValueError, OSError) as e:
+                try:
+                    proto.send_msg(
+                        self.wfile,
+                        {"ok": False, "error": f"bad message: {e}", "code": 400},
+                    )
+                except OSError:
+                    pass
+                return
+            if msg is None:
+                return  # client closed the connection
+            try:
+                resp = server.handle_op(msg)
+            except OverloadedError as e:
+                resp = {
+                    "ok": False, "error": str(e), "code": e.code,
+                    "queued": e.queued, "limit": e.limit,
+                }
+            except (KeyError, ValueError, TypeError, TimeoutError) as e:
+                resp = {"ok": False, "error": str(e), "code": 400}
+            except Exception as e:  # a stream failure must not kill the server
+                resp = {
+                    "ok": False,
+                    "error": f"{type(e).__name__}: {e}",
+                    "code": 500,
+                }
+            try:
+                proto.send_msg(self.wfile, resp)
+            except OSError:
+                return
+            if msg.get("op") == "shutdown":
+                server.request_shutdown()
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class ServeServer:
+    """Resident serving process: scheduler + TCP transport."""
+
+    def __init__(
+        self,
+        corrector,
+        host: str = "127.0.0.1",
+        port: int = 7733,
+        heartbeat_s: float = 0.0,
+    ):
+        self.scheduler = StreamScheduler(corrector, heartbeat_s=heartbeat_s)
+        self._tcp = _TCPServer((host, port), _Handler)
+        self._tcp.kcmc_server = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+        self._shutdown = threading.Event()
+
+    @property
+    def host(self) -> str:
+        return self._tcp.server_address[0]
+
+    @property
+    def port(self) -> int:
+        """The BOUND port (pass port=0 for an ephemeral one — tests)."""
+        return self._tcp.server_address[1]
+
+    # -- op dispatch (handler threads) ------------------------------------
+
+    def handle_op(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "ping":
+            return {"ok": True}
+        if op == "stats":
+            return {"ok": True, "stats": self.scheduler.stats()}
+        if op == "open_session":
+            ref = msg.get("reference")
+            sess = self.scheduler.open_session(
+                tenant=msg.get("tenant", "default"),
+                weight=int(msg.get("weight", 1)),
+                reference=(
+                    proto.decode_array(ref) if proto.is_array(ref) else None
+                ),
+                template_update_every=msg.get("template_update"),
+                emit_frames=bool(msg.get("emit", False)),
+                output=msg.get("output"),
+                expected_frames=msg.get("expected_frames"),
+                output_dtype=msg.get("output_dtype", "float32"),
+                compression=msg.get("compression", "none"),
+            )
+            return {"ok": True, "session": sess.sid}
+        if op == "submit_frames":
+            frames = proto.decode_array(msg["frames"])
+            decision = self.scheduler.submit(msg["session"], frames)
+            return {"ok": True, **decision}
+        if op == "results":
+            try:
+                # lookup_session also finds recently reaped sessions, so
+                # a poll racing a concurrent close still delivers any
+                # undelivered spans before reporting exhausted.
+                sess = self.scheduler.lookup_session(msg["session"])
+            except KeyError:
+                # Reaped long enough ago that only the id is remembered:
+                # everything was deliverable once — report exhausted,
+                # not an unknown session.
+                if self.scheduler.session_closed(msg["session"]):
+                    return {"ok": True, "exhausted": True}
+                raise
+            got = sess.fetch(timeout=float(msg.get("timeout", 60.0)))
+            if got is None:
+                return {"ok": True, "exhausted": True}
+            return {"ok": True, **proto.encode_arrays(got)}
+        if op == "close_session":
+            res = self.scheduler.close_session(
+                msg["session"], timeout=float(msg.get("timeout", 300.0))
+            )
+            payload: dict = {
+                "ok": True,
+                "frames": int(res.timing.get("n_frames", 0)),
+                "timing": _json_safe(res.timing),
+                "diagnostics": proto.encode_arrays(res.diagnostics),
+            }
+            if res.transforms is not None:
+                payload["transforms"] = proto.encode_array(res.transforms)
+            if res.fields is not None:
+                payload["fields"] = proto.encode_array(res.fields)
+            if res.corrected is not None and len(res.corrected):
+                payload["corrected"] = proto.encode_array(res.corrected)
+            return payload
+        if op == "shutdown":
+            return {"ok": True, "stats": self.scheduler.stats()}
+        raise ValueError(f"unknown op {op!r}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ServeServer":
+        self.scheduler.start()
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever,
+            name="kcmc-serve-tcp",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def request_shutdown(self) -> None:
+        self._shutdown.set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until a client `shutdown` op (or timeout)."""
+        return self._shutdown.wait(timeout)
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.scheduler.stop()
+
+    def __enter__(self) -> "ServeServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def _json_safe(obj):
+    """Timing dicts may carry numpy scalars; make them JSON-clean."""
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, float) and not np.isfinite(obj):
+        return None
+    return obj
+
+
+def serve_main(args) -> int:
+    """`python -m kcmc_tpu serve` body (argparse args from __main__)."""
+    from kcmc_tpu import MotionCorrector
+
+    overrides = dict(args.overrides)
+    mc = MotionCorrector(
+        model=args.model,
+        backend=args.backend,
+        reference=args.reference,
+        template_update_every=args.template_update,
+        **overrides,
+    )
+    server = ServeServer(
+        mc, host=args.host, port=args.port, heartbeat_s=args.heartbeat
+    )
+    server.start()
+    try:
+        # The standard production stop (docker stop / systemd / k8s) is
+        # SIGTERM; without this, Python's default handler kills the
+        # process mid-work — no clean-shutdown record, session writers
+        # never flushed. Main thread only; harmless to skip elsewhere.
+        import signal
+
+        signal.signal(
+            signal.SIGTERM, lambda *_: server.request_shutdown()
+        )
+    except ValueError:
+        pass
+    print(
+        json.dumps({
+            "serving": True,
+            "host": server.host,
+            "port": server.port,
+            "model": mc.config.model,
+            "backend": mc.backend_name,
+            "batch_size": mc.config.batch_size,
+            "queue_depth": mc.config.serve_queue_depth,
+            "inflight": mc.config.serve_inflight,
+        }),
+        flush=True,
+    )
+    try:
+        while not server.wait(timeout=0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stats = server.scheduler.stats()
+        server.stop()
+        print(json.dumps({"served": True, "stats": stats}), flush=True)
+    return 0

@@ -1,0 +1,516 @@
+"""Session: stream-scoped correction state, decoupled from process
+lifetime.
+
+`MotionCorrector.correct_file` owns its run-scoped state (prepared
+reference, rolling-template history, cursor, writer, telemetry) for
+exactly the lifetime of one file. Serving decouples the two: a
+`Session` IS that state, extracted into an object whose lifetime is the
+client stream's — frames arrive in arbitrary-size submits, results
+leave incrementally, and the device work interleaves with other
+sessions through the `StreamScheduler`'s shared dispatch window.
+
+Each session wraps a per-stream `MotionCorrector` view
+(`MotionCorrector.stream_view`) sharing the resident backend, which
+gives it the one-shot path's exact per-batch machinery — `_pad_batch`,
+`_rescue_flagged`, the degradation ladder, `_rolled_template` — so a
+stream's outputs match a one-shot `correct()` of the same frames (the
+parity contract `tests/test_serve_parity.py` pins).
+
+Threading: all mutable state is guarded by the scheduler's lock (one
+lock for the whole serving plane — sessions are touched from client
+threads and the scheduler thread). Result waiters block on a
+per-session Condition built on that lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from kcmc_tpu.corrector import (
+    CorrectionResult,
+    _cast_output,
+    merge_outputs,
+)
+
+
+class SessionClosed(RuntimeError):
+    """Raised by submit-side calls on a session that is closing/closed."""
+
+
+class Session:
+    """One client stream through the resident serving backend.
+
+    Built by `StreamScheduler.open_session` — not directly. `corrector`
+    is a per-stream `MotionCorrector` view sharing the warm backend;
+    `lock` is the scheduler's lock (see module docstring).
+    """
+
+    def __init__(
+        self,
+        corrector,
+        lock: threading.Lock,
+        session_id: str,
+        tenant: str = "default",
+        weight: int = 1,
+        emit_frames: bool = False,
+        output: str | None = None,
+        expected_frames: int | None = None,
+        output_dtype="float32",
+        compression: str = "none",
+        telemetry: bool = True,
+    ):
+        if output is not None and expected_frames is None:
+            raise ValueError(
+                "output= (server-side corrected file) requires "
+                "expected_frames= — streaming writers size their "
+                "containers up front"
+            )
+        if weight < 1:
+            raise ValueError(f"session weight must be >= 1, got {weight}")
+        self.mc = corrector
+        self.sid = str(session_id)
+        self.tenant = str(tenant)
+        self.weight = int(weight)
+        self.emit_frames = bool(emit_frames)
+        self.output = output
+        self.expected_frames = expected_frames
+        self.compression = compression
+        self._output_dtype = output_dtype
+        self._cond = threading.Condition(lock)
+
+        # Arm per-stream run state on the view: robustness report +
+        # retry policy (the scheduler's ladder calls reuse them), rescue
+        # counters. Mid-stream warp escalation is disabled — it would
+        # recompile the SHARED backend's program choice per stream; the
+        # per-frame exact-warp rescue still covers out-of-bound frames.
+        self.mc._begin_robust_run()
+        self.mc._escalation_allowed = False
+
+        cfg = self.mc.config
+        # Rolling template state (host blend path — the numpy backend's
+        # update_reference is its bit-identical mirror, so parity with
+        # one-shot runs holds on both backends).
+        self.E = self.mc.template_update_every
+        self.W_roll = min(self.mc.template_window, self.E) if self.E else 0
+        self._tail: list[dict] = []
+        self._next_boundary = self.E if self.E else None
+
+        self.ref_frame: np.ndarray | None = None
+        self.ref: dict | None = None
+        # Reference SOURCE frame staged for the scheduler thread to
+        # prepare (device compute stays off the client/lock path).
+        self._ref_src: np.ndarray | None = None
+        # The stream's frame shape, pinned by the first reference/
+        # submit: a later mismatched submit is a CLIENT error rejected
+        # at admission — np.stack-ing mixed shapes in take_batch would
+        # blow up on the scheduler thread instead.
+        self.frame_shape: tuple | None = None
+
+        # Stream cursors: submitted >= dispatched >= done >= delivered.
+        self.pending: list[np.ndarray] = []  # frames awaiting dispatch
+        self.submitted = 0
+        self.dispatched = 0
+        self.done = 0
+        self.inflight = 0  # batches of this session in the window
+        self.degraded = False  # QoS: dispatching on the degraded backend
+        self.closing = False
+        self.closed = False
+        self.error: BaseException | None = None
+        self._finalizing = False
+        self._result: CorrectionResult | None = None
+        # Whether result() has delivered at least once — the scheduler's
+        # closed-session retention only strips emit pixels from results
+        # a client has already received.
+        self._result_delivered = False
+
+        self._outs: list[dict] = []  # drained per-batch host dicts
+        self._outs_delivered = 0  # fetch() high-water mark (batches)
+        self._frames_delivered = 0
+        self._t0: float | None = None
+
+        self.writer = None
+        self.out_dt: np.dtype | None = (
+            None
+            if isinstance(output_dtype, str) and output_dtype == "input"
+            else np.dtype(output_dtype)
+        )
+
+        # Per-session telemetry (trace + frame records) through the
+        # run-id machinery: concurrent sessions configured with the same
+        # artifact paths get per-session derived filenames. The serve
+        # plane owns the heartbeat (aggregated across sessions), so the
+        # per-session one is pinned off.
+        self.telemetry = None
+        if telemetry and cfg.observability_enabled:
+            from kcmc_tpu.obs.run import RunTelemetry
+
+            self.telemetry = RunTelemetry.begin(
+                cfg.replace(heartbeat_s=0.0),
+                backend=self.mc.backend,
+                backend_name=self.mc.backend_name,
+                report=self.mc._robustness,
+                total=expected_frames,
+                run_id=self.sid,
+                # Every session gets its OWN derived artifact file —
+                # without this, sequential sessions of a long-lived
+                # server would each overwrite the last one's trace.
+                derive_paths=True,
+            )
+
+    # -- submit side (client threads, scheduler lock held) ----------------
+
+    def set_reference(self, ref_frame: np.ndarray) -> None:
+        """Explicit reference frame (before the first submit). Stages
+        the source; the scheduler thread runs the device preparation."""
+        if self.ref is not None or self._ref_src is not None:
+            raise ValueError(
+                "reference is already set (set it before submitting)"
+            )
+        self._ref_src = np.asarray(ref_frame, np.float32)
+        if self._ref_src.ndim != 2:
+            raise ValueError(
+                f"reference frame must be 2-D, got shape "
+                f"{self._ref_src.shape}"
+            )
+        self.frame_shape = self._ref_src.shape
+
+    def backlog(self) -> int:
+        """Frames admitted but not yet dispatched (the admission gauge)."""
+        return len(self.pending)
+
+    def add_frames(self, frames) -> int:
+        """Append admitted frames to the pending queue (admission checks
+        happen in the scheduler BEFORE this). Runs on a CLIENT thread
+        under the serving plane's one lock, so it only stages work:
+        reference preparation (device compute, possibly a JIT) and
+        writer construction (file I/O) happen on the scheduler thread
+        (`prepare_reference_now` / first drain)."""
+        if self.closing or self.closed:
+            raise SessionClosed(f"session {self.sid} is closed")
+        frames = np.asarray(frames)
+        if frames.ndim == 2:
+            frames = frames[None]
+        if frames.ndim != 3:
+            raise ValueError(
+                f"frames must be (H, W) or (T, H, W), got shape "
+                f"{frames.shape}"
+            )
+        if self.frame_shape is None:
+            self.frame_shape = tuple(frames.shape[1:])
+        elif tuple(frames.shape[1:]) != tuple(self.frame_shape):
+            raise ValueError(
+                f"session {self.sid} frames are "
+                f"{tuple(self.frame_shape)}; got {tuple(frames.shape[1:])}"
+            )
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        if self.out_dt is None:
+            self.out_dt = np.dtype(frames.dtype)
+        if self.ref is None and self._ref_src is None:
+            self._ref_src = np.asarray(frames[0], np.float32)
+        self.pending.extend(np.asarray(f) for f in frames)
+        self.submitted += len(frames)
+        return len(frames)
+
+    def needs_reference(self) -> bool:
+        """Whether the scheduler thread must prepare this session's
+        reference before its frames become dispatchable (lock held)."""
+        return self.ref is None and self._ref_src is not None
+
+    def prepare_reference_now(self) -> None:
+        """Prepare the staged reference. SCHEDULER thread only, lock
+        NOT held — this is device compute (and a possible JIT compile)
+        that must never stall other tenants' submits."""
+        src = self._ref_src
+        ref = self.mc.backend.prepare_reference(src)
+        with self._cond:
+            self.ref_frame = src
+            self.ref = ref
+            self._cond.notify_all()
+
+    def begin_close(self) -> None:
+        """Mark the stream complete: remaining pending frames still
+        process; the scheduler finalizes once everything drains."""
+        self.closing = True
+
+    # -- dispatch side (scheduler thread, scheduler lock held) ------------
+
+    def ready_count(self) -> int:
+        """Frames eligible for dispatch NOW: pending, minus the rolling-
+        template gate (frames past the next boundary wait until the
+        boundary's drained update has run)."""
+        n = len(self.pending)
+        if n == 0 or self.ref is None:
+            return 0
+        if self._next_boundary is not None:
+            n = min(n, self._next_boundary - self.dispatched)
+        return max(n, 0)
+
+    def take_batch(self, B: int):
+        """Pop up to min(ready, B) frames as a padded dispatch batch:
+        (n_valid, frames (B, ...), global indices (B,), ref). Indices
+        are the session's own frame numbers — the RANSAC keys fold them
+        in, so stream results match a one-shot run of the same frames
+        regardless of how submits were sliced into batches."""
+        n = min(self.ready_count(), B)
+        if n <= 0:
+            return None
+        frames = np.stack(self.pending[:n])
+        del self.pending[:n]
+        idx = np.arange(self.dispatched, self.dispatched + n)
+        self.dispatched += n
+        self.inflight += 1
+        return self.mc._pad_batch(frames, idx, B) + (self.ref,)
+
+    def wants_pixels(self) -> bool:
+        """Whether drains need the corrected frames materialized: the
+        client asked for them, a server-side writer consumes them, or
+        the rolling-template blend needs the averaging window."""
+        return bool(self.emit_frames or self.output is not None or self.E)
+
+    # -- drain side (scheduler thread; takes the lock itself) -------------
+
+    def on_drained(self, n: int, host: dict, kept, ref_used: dict) -> None:
+        """Account one drained batch (host arrays already sliced [:n]).
+        Mirrors the one-shot drain: exact-warp rescue of flagged frames
+        (when their input pixels were kept), QC NaN-ing otherwise,
+        rolling-template tail collection, writer append, telemetry."""
+        if self.error is not None:
+            return  # failed stream: entries drain without accounting
+        cfg = self.mc.config
+        if cfg.rescue_warp and kept is not None:
+            self.mc._rescue_flagged(host, kept, n, ref_used)
+        elif "template_corr" in host and "warp_ok" in host:
+            # Never-rescued out-of-bound frames: their QC was measured
+            # against a zeroed warp — NaN beats silently wrong.
+            host["template_corr"] = np.where(
+                host["warp_ok"], host["template_corr"], np.nan
+            )
+        corrected = host.pop("corrected", None)
+        if self.E and corrected is not None:
+            self._tail.append({
+                "corrected": np.asarray(corrected, np.float32),
+                "warp_ok": np.asarray(
+                    host.get("warp_ok", np.ones(len(corrected), bool)), bool
+                ),
+            })
+            have = sum(len(t["corrected"]) for t in self._tail)
+            while have - len(self._tail[0]["corrected"]) >= self.W_roll:
+                have -= len(self._tail.pop(0)["corrected"])
+        if corrected is not None:
+            corrected = _cast_output(corrected, self.out_dt)
+            if self.writer is None and self.output is not None:
+                # Lazy writer construction on the scheduler thread at
+                # the first drained batch — file I/O stays off the
+                # client submit path (and its lock).
+                from kcmc_tpu.io.async_writer import AsyncBatchWriter
+                from kcmc_tpu.io.formats import make_writer
+
+                inner = make_writer(
+                    self.output, int(self.expected_frames),
+                    tuple(corrected.shape[1:]), self.out_dt,
+                    compression=self.compression,
+                )
+                depth = self.mc.config.writer_depth
+                self.writer = (
+                    AsyncBatchWriter(inner, depth=depth)
+                    if depth > 0
+                    else inner
+                )
+            if self.writer is not None:
+                self.writer.append_batch(corrected)
+            if self.emit_frames:
+                host["corrected"] = corrected
+        with self._cond:
+            self._outs.append(host)
+            if self.telemetry is not None:
+                self.telemetry.note_batch(self.done, n, host)
+            self.done += n
+            boundary = (
+                self._next_boundary is not None
+                and self.done == self._next_boundary
+                and not (self.closing and not self.pending)
+            )
+            self._cond.notify_all()
+        if boundary:
+            # Rolling-template update at the boundary (host blend path;
+            # frame-exact window slicing inside _rolled_template). Runs
+            # on the scheduler thread, after every pre-boundary frame
+            # of THIS session drained — other sessions' batches keep
+            # the window busy meanwhile.
+            self.ref_frame = self.mc._rolled_template(
+                self.ref_frame,
+                [t["corrected"] for t in self._tail],
+                [t["warp_ok"] for t in self._tail],
+                self.W_roll,
+            )
+            self._tail.clear()
+            self.ref = self.mc.backend.prepare_reference(self.ref_frame)
+            with self._cond:
+                self._next_boundary += self.E
+                self._cond.notify_all()
+
+    def entry_done(self) -> None:
+        """Scheduler-side accounting: one of this session's dispatched
+        batches has been fully handled (drained, laddered, or failed).
+        Owned by the SCHEDULER so in-flight counts stay correct on
+        every error path."""
+        with self._cond:
+            self.inflight = max(0, self.inflight - 1)
+            self._cond.notify_all()
+
+    def drained_out(self) -> bool:
+        """True when every admitted frame has drained (finalize gate).
+        A failed stream only waits for its in-flight entries — its
+        pending frames were dropped by `fail`."""
+        if self.error is not None:
+            return self.inflight == 0
+        return (
+            not self.pending and self.inflight == 0
+            and self.dispatched == self.done
+        )
+
+    def fail(self, exc: BaseException) -> None:
+        """Fatal stream error (ladder exhausted with mark-failed off, or
+        a scheduler-side bug): fail waiters, drop pending work."""
+        with self._cond:
+            if self.error is None:
+                self.error = exc
+            self.closing = True
+            self.pending.clear()
+            self._cond.notify_all()
+
+    def finalize(self) -> None:
+        """Build the final CorrectionResult and tear the stream down.
+        Called by the SCHEDULER thread once the stream fully drained —
+        the writer teardown deliberately happens on a different thread
+        than the one that created it (AsyncBatchWriter.close is
+        cross-thread safe)."""
+        with self._cond:
+            if self._finalizing or self.closed:
+                return
+            self._finalizing = True
+            # Shallow-copy each batch dict: the merge below runs
+            # OUTSIDE the lock, and a concurrent fetch() pops delivered
+            # pixels from the shared dicts mid-merge otherwise.
+            outs = [dict(o) for o in self._outs]
+            done = self.done
+        err: BaseException | None = None
+        try:
+            if self.writer is not None:
+                self.writer.close()
+        except BaseException as e:  # surfaced on result()
+            err = e
+        elapsed = (
+            max(time.perf_counter() - self._t0, 1e-9)
+            if self._t0 is not None
+            else 0.0
+        )
+        timing: dict = {
+            "n_frames": done,
+            "frames_per_sec": done / elapsed if elapsed else None,
+            "elapsed_s": elapsed,
+        }
+        merged = merge_outputs(outs)
+        corrected = merged.pop("corrected", None)
+        transforms = merged.pop("transform", None)
+        fields = merged.pop("field", None)
+        transforms = self.mc._finalize_robustness(
+            merged, transforms, 0, done, timing
+        )
+        result = CorrectionResult(
+            corrected=(
+                corrected
+                if corrected is not None
+                else np.empty((0,), np.float32)
+            ),
+            transforms=transforms,
+            fields=fields,
+            diagnostics=merged,
+            timing=timing,
+        )
+        if self.telemetry is not None:
+            try:
+                if err is None and self.error is None:
+                    self.telemetry.finish(timing)
+                else:
+                    self.telemetry.close(err or self.error)
+            except BaseException as e:
+                err = err or e
+        with self._cond:
+            if err is not None and self.error is None:
+                self.error = err
+            self._result = result
+            self.closed = True
+            self._cond.notify_all()
+
+    # -- results side (client threads) ------------------------------------
+
+    def fetch(self, timeout: float | None = None) -> dict | None:
+        """Incremental results: block until at least one undelivered
+        batch drained (or the stream closed), then return a merged dict
+        ``{"first_frame", "n", <output arrays>}``. Returns None when
+        the stream is closed and exhausted; raises the stream's error
+        if it failed. Delivered corrected frames are released from
+        session memory."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self.error is not None
+                or len(self._outs) > self._outs_delivered
+                or self.closed,
+                timeout=timeout,
+            )
+            if self.error is not None:
+                raise self.error
+            if not ok:
+                raise TimeoutError(
+                    f"no results within {timeout}s for session {self.sid}"
+                )
+            new = self._outs[self._outs_delivered :]
+            if not new:
+                return None  # closed and exhausted
+            first = self._frames_delivered
+            self._outs_delivered = len(self._outs)
+            n = sum(len(next(iter(o.values()))) for o in new if o)
+            self._frames_delivered += n
+            merged = merge_outputs(new)
+            # Release delivered pixels — frames dominate memory; the
+            # final merge stays key-uniform because fetch always
+            # consumes a PREFIX of the batch list (keys come from
+            # outs[0], so a popped prefix excludes "corrected" from the
+            # final result consistently).
+            for o in new:
+                o.pop("corrected", None)
+        merged["first_frame"] = first
+        merged["n"] = n
+        return merged
+
+    def result(self, timeout: float | None = None) -> CorrectionResult:
+        """Block until the stream is finalized; return its result (or
+        raise its error)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self.closed, timeout=timeout):
+                raise TimeoutError(
+                    f"session {self.sid} did not finalize within {timeout}s"
+                )
+            if self.error is not None:
+                raise self.error
+            self._result_delivered = True
+            return self._result
+
+    # -- telemetry snapshot (heartbeat thread) -----------------------------
+
+    def snapshot(self) -> dict:
+        elapsed = (
+            max(time.perf_counter() - self._t0, 1e-9)
+            if self._t0 is not None
+            else None
+        )
+        return {
+            "name": f"{self.tenant}/{self.sid}",
+            "frames": self.done,
+            "fps": (self.done / elapsed) if elapsed else 0.0,
+        }

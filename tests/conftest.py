@@ -35,3 +35,19 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _isolate_cli_logging():
+    """Keep `advise()` routing order-independent across tests.
+
+    Any test that drives `__main__.main()` in-process flips the module
+    to logger routing with a handler bound to pytest's captured stderr;
+    without this reset, later `pytest.warns` contracts fail and the
+    handler writes to a closed stream.
+    """
+    yield
+    from kcmc_tpu.obs import log as obs_log
+
+    if obs_log.cli_logging_active():
+        obs_log.reset_cli_logging()

@@ -1,0 +1,148 @@
+"""Cross-stream batching parity: the serving scheduler must produce the
+SAME per-frame outputs as one-shot `correct()` runs of the same frames.
+
+The acceptance contract (ISSUE 6): two concurrent sessions through the
+scheduler, on the numpy and CPU-jax backends, match two sequential
+one-shot runs within 1e-4 — including an uneven interleave and a
+session that closes mid-window. Parity holds structurally (per-frame
+registration keyed by the session-local global index, per-entry
+references) so the observed deltas are 0 or float32 reduction-order
+noise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.serve.scheduler import StreamScheduler
+from kcmc_tpu.utils.synthetic import make_drift_stack
+
+TOL = 1e-4
+BASE_KW = dict(
+    model="translation", batch_size=8, max_keypoints=64, n_hypotheses=32,
+)
+
+
+def _stack(n, seed=0, shape=(48, 48)):
+    d = make_drift_stack(
+        n_frames=n, shape=shape, model="translation", max_drift=3.0,
+        seed=seed,
+    )
+    return d.stack.astype(np.float32)
+
+
+def _assert_close(res, truth):
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+    for key in ("n_inliers", "n_matches"):
+        np.testing.assert_array_equal(
+            res.diagnostics[key], truth.diagnostics[key]
+        )
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_two_concurrent_sessions_match_sequential_oneshot(backend):
+    s1, s2 = _stack(20, seed=0), _stack(14, seed=1)
+    truth1 = MotionCorrector(backend=backend, **BASE_KW).correct(s1)
+    truth2 = MotionCorrector(backend=backend, **BASE_KW).correct(s2)
+
+    mc = MotionCorrector(backend=backend, **BASE_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        a = sched.open_session(tenant="A")
+        b = sched.open_session(tenant="B")
+        # uneven interleave: submit sizes unrelated to the batch size,
+        # alternating between streams
+        sched.submit(a.sid, s1[:7])
+        sched.submit(b.sid, s2[:3])
+        sched.submit(a.sid, s1[7:9])
+        sched.submit(b.sid, s2[3:14])
+        sched.submit(a.sid, s1[9:20])
+        ra = sched.close_session(a.sid, timeout=180)
+        rb = sched.close_session(b.sid, timeout=180)
+    finally:
+        sched.stop()
+    _assert_close(ra, truth1)
+    _assert_close(rb, truth2)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_session_closing_mid_window_leaves_other_stream_exact(backend):
+    """One session closes while the other's batches are still flowing
+    through the shared window — the survivor's outputs must stay
+    exact, and the closer's partial stream must equal a one-shot run
+    of exactly the frames it submitted."""
+    s1, s2 = _stack(9, seed=2), _stack(24, seed=3)
+    truth1 = MotionCorrector(backend=backend, **BASE_KW).correct(s1)
+    truth2 = MotionCorrector(backend=backend, **BASE_KW).correct(s2)
+
+    mc = MotionCorrector(backend=backend, **BASE_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        a = sched.open_session(tenant="closer")
+        b = sched.open_session(tenant="survivor")
+        sched.submit(b.sid, s2[:16])
+        sched.submit(a.sid, s1)  # 9 frames: one full batch + a padded tail
+        ra = sched.close_session(a.sid, timeout=180)  # closes mid-traffic
+        sched.submit(b.sid, s2[16:])
+        rb = sched.close_session(b.sid, timeout=180)
+    finally:
+        sched.stop()
+    assert ra.timing["n_frames"] == 9
+    _assert_close(ra, truth1)
+    _assert_close(rb, truth2)
+
+
+@pytest.mark.parametrize("backend", ["numpy", "jax"])
+def test_rolling_template_stream_matches_oneshot(backend):
+    """Rolling-template sessions: boundary updates land at the same
+    absolute frame indices as a one-shot run, with the same averaging
+    window, regardless of how the stream was sliced into submits."""
+    stack = _stack(32, seed=4)
+    truth = MotionCorrector(
+        backend=backend, template_update_every=16, **BASE_KW
+    ).correct(stack)
+
+    mc = MotionCorrector(backend=backend, template_update_every=16, **BASE_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="roll")
+        for lo in range(0, 32, 5):  # submit size coprime with E and B
+            sched.submit(s.sid, stack[lo : lo + 5])
+        res = sched.close_session(s.sid, timeout=180)
+    finally:
+        sched.stop()
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+
+
+def test_corrected_pixels_match_oneshot_jax():
+    stack = _stack(12, seed=5)
+    truth = MotionCorrector(backend="jax", **BASE_KW).correct(stack)
+    mc = MotionCorrector(backend="jax", **BASE_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="pix", emit_frames=True)
+        sched.submit(s.sid, stack)
+        res = sched.close_session(s.sid, timeout=180)
+    finally:
+        sched.stop()
+    assert res.corrected.shape == truth.corrected.shape
+    assert np.abs(res.corrected - truth.corrected).max() < TOL
+
+
+def test_explicit_reference_matches_oneshot_numpy():
+    stack = _stack(10, seed=6)
+    ref = stack[3]
+    truth = MotionCorrector(
+        backend="numpy", reference=ref, **BASE_KW
+    ).correct(stack)
+    mc = MotionCorrector(backend="numpy", **BASE_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="ref", reference=ref)
+        sched.submit(s.sid, stack)
+        res = sched.close_session(s.sid, timeout=180)
+    finally:
+        sched.stop()
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
