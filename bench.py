@@ -413,6 +413,141 @@ def multichip_judged_json_line(
     return json.dumps(rec)
 
 
+_COLDSTART_CHILD = """
+import json, time
+t0 = time.perf_counter()
+import numpy as np
+from kcmc_tpu import MotionCorrector
+mc = MotionCorrector(model={model!r}, backend="jax", batch_size={batch},
+                     plan_buckets=({size},))
+rng = np.random.default_rng(0)
+stack = rng.normal(size=({batch}, {size}, {size})).astype("float32") + 1.0
+res = mc.correct(stack)
+t_first = time.perf_counter() - t0
+pc = res.timing.get("plan_cache", {{}})
+print(json.dumps({{
+    "first_frame_s": round(t_first, 3),
+    "compile_s": round(pc.get("compile_s", 0.0), 3),
+    "stamp_hits": pc.get("stamp_hits", 0),
+    "stamp_misses": pc.get("stamp_misses", 0),
+}}), flush=True)
+"""
+
+
+def run_bench_coldstart(
+    size: int, batch: int, model: str, smoke: bool = False,
+) -> dict:
+    """Cold-start anatomy: process start -> first corrected frame,
+    cold compile cache vs warm (docs/PERFORMANCE.md).
+
+    Each measurement is a REAL process: a subprocess constructs a
+    corrector with `plan_buckets=(size,)` and `KCMC_COMPILE_CACHE`
+    pointed at a shared directory, then corrects one batch. Run 1
+    (cold) pays trace + XLA compile and populates the persistent
+    compile cache + exported-program blobs; run 2 (warm) deserializes
+    both — its plan stats MUST report zero stamp misses (the
+    "second run compiles zero new programs" contract the CI coldstart
+    job asserts). The speedup is compile-bound: the piecewise config
+    (the largest compiled program) shows the full effect everywhere,
+    while cheap-to-compile configs on fast-compiling platforms bottom
+    out at import + first-batch execution time.
+    """
+    import os
+    import subprocess
+    import tempfile
+
+    def one_run(m, sz, b, cache_dir, tag):
+        child = _COLDSTART_CHILD.format(model=m, size=sz, batch=b)
+        env = dict(
+            os.environ,
+            KCMC_COMPILE_CACHE=cache_dir,
+            PYTHONPATH=os.pathsep.join(
+                [os.path.dirname(os.path.abspath(__file__))]
+                + ([os.environ["PYTHONPATH"]] if os.environ.get("PYTHONPATH") else [])
+            ),
+        )
+        if smoke:
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        p = subprocess.run(
+            [sys.executable, "-c", child],
+            env=env, capture_output=True, text=True, timeout=1800,
+        )
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"coldstart {tag} run failed:\n{p.stderr[-2000:]}"
+            )
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+        print(
+            f"[bench] coldstart {m} {sz}² {tag}: first frame "
+            f"{rec['first_frame_s']:.2f}s (compile {rec['compile_s']:.2f}s, "
+            f"stamp misses {rec['stamp_misses']})",
+            file=sys.stderr,
+        )
+        return rec
+
+    def one_pair(m, sz, b):
+        # The bench-wide honesty convention (see "Measuring honestly"):
+        # single process starts swing ±30% on a shared host, so the
+        # judged cold/warm numbers are the MEDIAN of `reps` pairs (each
+        # pair against a FRESH cache dir, so every cold is really
+        # cold), with every sample recorded for audit.
+        reps = 1 if smoke else 3
+        colds, warms = [], []
+        for rep in range(reps):
+            with tempfile.TemporaryDirectory() as td:
+                cache = os.path.join(td, "cache")
+                colds.append(one_run(m, sz, b, cache, f"cold[{rep}]"))
+                warms.append(one_run(m, sz, b, cache, f"warm[{rep}]"))
+        cold_s = float(np.median([r["first_frame_s"] for r in colds]))
+        warm_s = float(np.median([r["first_frame_s"] for r in warms]))
+        return {
+            "cold_s": round(cold_s, 3),
+            "warm_s": round(warm_s, 3),
+            "speedup": round(cold_s / max(warm_s, 1e-9), 2),
+            "cold_runs_s": [r["first_frame_s"] for r in colds],
+            "warm_runs_s": [r["first_frame_s"] for r in warms],
+            "compile_s_cold": float(
+                np.median([r["compile_s"] for r in colds])
+            ),
+            "compile_s_warm": float(
+                np.median([r["compile_s"] for r in warms])
+            ),
+            "run1_stamp_misses": colds[-1]["stamp_misses"],
+            "run2_stamp_misses": max(r["stamp_misses"] for r in warms),
+            "run2_stamp_hits": warms[-1]["stamp_hits"],
+        }
+
+    rows = {model: one_pair(model, size, batch)}
+    if not smoke and model != "piecewise":
+        # The compile-heaviest contract config: where cold start hurts
+        # most, and where the cache's effect is platform-independent.
+        rows["piecewise"] = one_pair("piecewise", min(size, 256), batch)
+    return rows
+
+
+def coldstart_judged_json_line(
+    model: str, size: int, rows: dict, manifest: dict | None = None,
+) -> str:
+    """The --coldstart judged line: value = the flagship config's WARM
+    process-start -> first-corrected-frame seconds; per-config rows
+    (cold/warm/speedup/compile seconds, run-2 stamp misses) ride along.
+    vs_baseline = best speedup / 5.0 — the >= 5x warm-start target."""
+    flag = rows[model]
+    best = max(r["speedup"] for r in rows.values())
+    rec = {
+        "metric": f"coldstart_first_frame_{model}_{size}x{size}",
+        "value": flag["warm_s"],
+        "unit": "seconds",
+        "cold_s": flag["cold_s"],
+        "speedup": flag["speedup"],
+        "vs_baseline": round(best / 5.0, 3),
+        "configs": rows,
+    }
+    if manifest:
+        rec["manifest"] = manifest
+    return json.dumps(rec)
+
+
 def _run_with_retry(run, *args, **kw):
     """This image's tunneled TPU occasionally drops a remote_compile
     mid-flight; that is infrastructure, not a benchmark failure — one
@@ -440,7 +575,9 @@ def main() -> None:
     ap.add_argument("--frames", type=int, default=2048)
     ap.add_argument("--size", type=int, default=512)
     ap.add_argument("--model", default="translation")
-    ap.add_argument("--batch", type=int, default=64)
+    # default None so --coldstart can tell an explicit --batch 64 from
+    # the unset default (its latency metric defaults to batch 1)
+    ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--host-io", action="store_true")
     ap.add_argument(
         "--all", action="store_true",
@@ -473,6 +610,21 @@ def main() -> None:
         help="concurrent client streams for --serve (default 2)",
     )
     ap.add_argument(
+        "--coldstart", action="store_true",
+        help="cold-start mode: measure process start -> first corrected "
+        "frame in fresh subprocesses, cold compile cache vs warm "
+        "(persistent compile cache + exported-program blobs), and emit "
+        "a judged line with per-config cold/warm/speedup — the warm "
+        "run must compile zero new programs (run2_stamp_misses == 0). "
+        "With --smoke: tiny CPU run, the CI guard",
+    )
+    ap.add_argument(
+        "--plans", action="store_true",
+        help="run the flagship row with execution plans ENABLED "
+        "(plan_buckets=(size,)): guards the <2%% overhead contract of "
+        "the bucketed program at its exact shape",
+    )
+    ap.add_argument(
         "--smoke", action="store_true",
         help="tiny CPU-friendly run (64 frames @ 64², flagship + "
         "streaming rows only) — the CI guard for the throughput path; "
@@ -492,6 +644,9 @@ def main() -> None:
         help="device count for --multichip (0 or -1 = all visible)",
     )
     args = ap.parse_args()
+    explicit_batch = args.batch
+    if args.batch is None:
+        args.batch = 64
     if args.multichip and args.smoke:
         # Self-sufficient CI/dev invocation on machines without a real
         # mesh: force the 8-device virtual CPU platform BEFORE the
@@ -509,7 +664,27 @@ def main() -> None:
         args.size = min(args.size, 64)
         args.batch = min(args.batch, 16)
         args.flagship_only = True
-        args.streaming = True
+        args.streaming = not args.coldstart
+
+    if args.coldstart:
+        # Subprocess-based (each measurement is a real process start);
+        # no jax import needed in THIS process beyond the manifest.
+        # batch_size=1 by default: first-corrected-frame is a LATENCY
+        # metric (a serving session's first frame), so the measured
+        # program registers one frame — the compile being amortized is
+        # the same mechanism at any B. An explicit --batch measures
+        # exactly that batch size.
+        rows = run_bench_coldstart(
+            args.size,
+            explicit_batch if explicit_batch is not None else 1,
+            args.model, smoke=args.smoke,
+        )
+        print(
+            coldstart_judged_json_line(
+                args.model, args.size, rows, manifest=_bench_manifest()
+            )
+        )
+        return
 
     import jax
 
@@ -553,7 +728,15 @@ def main() -> None:
             print(f"[bench] --stages unavailable: {e}", file=sys.stderr)
 
     run = run_bench_host if args.host_io else run_bench_device
-    r = _run_with_retry(run, args.frames, args.size, args.model, args.batch)
+    flag_kw = {}
+    if args.plans:
+        # Plans enabled at the flagship's exact shape: the bucketed
+        # program adds one fused elementwise mask pass per warp — this
+        # row guards the <2% overhead contract against the plain line.
+        flag_kw["plan_buckets"] = (args.size,)
+    r = _run_with_retry(
+        run, args.frames, args.size, args.model, args.batch, **flag_kw
+    )
     print(
         f"[bench] {args.model} {args.size}x{args.size}: {r['fps']:.1f} fps, "
         f"rmse {r['rmse_px']:.3f} px ({r['n_frames']} frames)",

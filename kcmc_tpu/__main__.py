@@ -50,6 +50,29 @@ def _cmd_info(args) -> int:
     return 0
 
 
+def _parse_buckets(spec: str) -> tuple:
+    """CLI bucket-spec grammar: comma-separated entries, each a side
+    (square bucket) or HxW (rectangular), e.g. "512,1024" or
+    "480x640,1024"."""
+    out: list = []
+    try:
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "x" in part:
+                h, w = part.split("x", 1)
+                out.append((int(h), int(w)))
+            else:
+                out.append(int(part))
+    except ValueError:
+        raise SystemExit(
+            f"--buckets: cannot parse {spec!r} — expected comma-"
+            "separated sides or HxW pairs, e.g. '512,1024' or '480x640'"
+        )
+    return tuple(out)
+
+
 def _parse_reference_and_overrides(args):
     """Shared CLI → MotionCorrector argument mapping (2D and 3D paths)."""
     ref = args.reference
@@ -91,6 +114,13 @@ def _parse_reference_and_overrides(args):
 
             os.environ.pop("KCMC_DEVICES", None)
         overrides["mesh_devices"] = devices
+    # execution plans (kcmc_tpu/plans; docs/PERFORMANCE.md): buckets
+    # opt into AOT shape-bucketed execution; the cache dir layers the
+    # persistent compile cache under it (KCMC_COMPILE_CACHE also works)
+    if getattr(args, "buckets", ""):
+        overrides["plan_buckets"] = _parse_buckets(args.buckets)
+    if getattr(args, "compile_cache", ""):
+        overrides["compile_cache_dir"] = args.compile_cache
     # observability (docs/OBSERVABILITY.md): all off by default
     if getattr(args, "trace", ""):
         overrides["trace_path"] = args.trace
@@ -200,6 +230,18 @@ def _cmd_correct(args) -> int:
         summary["stalls_s"] = {k: round(v, 3) for k, v in stalls.items()}
     if res.timing.get("pipeline"):
         summary["pipeline"] = res.timing["pipeline"]
+    pc = res.timing.get("plan_cache")
+    if pc:
+        # compact warm-up/compile accounting (full events in the trace
+        # metadata and `kcmc_tpu report`)
+        summary["plan_cache"] = {
+            k: pc.get(k, 0)
+            for k in (
+                "programs_compiled", "compile_s", "stamp_hits",
+                "stamp_misses", "bucket_exact", "bucket_padded",
+                "bucket_fallback",
+            )
+        }
     rb = res.robustness
     if rb is not None and any(rb.values()):
         # only when something actually happened: retries, failovers,
@@ -399,6 +441,36 @@ def _cmd_serve(args) -> int:
     return serve_main(args)
 
 
+def _cmd_warmup(args) -> int:
+    """Pre-populate the execution-plan caches for a config set: AOT
+    compile every hot program per declared shape bucket (and dtype),
+    stamping the persistent compile cache so the NEXT process — a
+    production boot, an elastic scale-out replica, a failback — starts
+    warm. Prints one JSON line of build stats; `stamp_misses == 0`
+    means everything deserialized from a previous run's cache."""
+    from kcmc_tpu import MotionCorrector
+
+    ref, overrides = _parse_reference_and_overrides(args)
+    # passed explicitly below (the shared mapper also collects it)
+    overrides.pop("template_update_every", None)
+    mc = MotionCorrector(
+        model=args.model, backend=args.backend, reference=ref,
+        template_update_every=args.template_update, **overrides,
+    )
+    dtypes = tuple(
+        d.strip() for d in args.dtypes.split(",") if d.strip()
+    ) or ("float32",)
+    try:
+        stats = mc.warmup(dtypes=dtypes, progress=args.progress)
+    except ValueError as e:
+        raise SystemExit(f"warmup: {e}")
+    # drop the verbose backend snapshot; the build summary (programs,
+    # stamp hits/misses, seconds) is the contract surface
+    stats.pop("plan_cache", None)
+    print(json.dumps(stats))
+    return 0
+
+
 def _cmd_report(args) -> int:
     """Render a human-readable run report from either run artifact:
     a --frame-records JSONL or a `correct --transforms` npz."""
@@ -542,6 +614,20 @@ def main(argv=None) -> int:
         "Also settable via the KCMC_FAULT_PLAN env var",
     )
     p.add_argument(
+        "--buckets", default="", metavar="SPEC",
+        help="AOT execution-plan shape buckets, e.g. '512,1024' or "
+        "'480x640,1024': 2D matrix-model inputs pad to the smallest "
+        "covering bucket (parity-clean) so odd shapes hit warm "
+        "executables; pre-build with `kcmc_tpu warmup` "
+        "(docs/PERFORMANCE.md 'Cold-start anatomy')",
+    )
+    p.add_argument(
+        "--compile-cache", default="", metavar="DIR",
+        help="persistent compilation-cache directory (also via "
+        "KCMC_COMPILE_CACHE): later processes deserialize previously "
+        "compiled programs instead of rebuilding them",
+    )
+    p.add_argument(
         "--trace", default="", metavar="PATH",
         help="export a Chrome trace-event JSON of the run (stages, "
         "pipeline stalls, per-batch dispatch, writer thread); load in "
@@ -627,6 +713,20 @@ def main(argv=None) -> int:
         "depths, admission decisions, batch occupancy (0 = off)",
     )
     p.add_argument(
+        "--buckets", default="", metavar="SPEC",
+        help="AOT execution-plan shape buckets (see `correct "
+        "--buckets`): the server pre-compiles every hot program per "
+        "bucket BEFORE the ready line, so sessions open against warm "
+        "plans; with --compile-cache a re-booted server deserializes "
+        "instead of recompiling (ready record reports warmup_s and "
+        "plan-cache hits/misses)",
+    )
+    p.add_argument(
+        "--compile-cache", default="", metavar="DIR",
+        help="persistent compilation-cache directory (also via "
+        "KCMC_COMPILE_CACHE)",
+    )
+    p.add_argument(
         "--trace", default="", metavar="PATH",
         help="per-session Chrome traces (every session derives its "
         "own session-id filename from PATH)",
@@ -637,6 +737,62 @@ def main(argv=None) -> int:
         "filenames)",
     )
     p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser(
+        "warmup",
+        help="pre-populate the execution-plan caches for a config set: "
+        "AOT compile every hot program per shape bucket and stamp the "
+        "persistent compile cache, so the next process starts warm "
+        "(docs/PERFORMANCE.md 'Cold-start anatomy')",
+    )
+    p.add_argument(
+        "--buckets", default="", metavar="SPEC", required=True,
+        help="shape buckets to build, e.g. '512,1024' or '480x640'",
+    )
+    p.add_argument(
+        "--compile-cache", default="", metavar="DIR",
+        help="persistent compilation-cache directory (also via "
+        "KCMC_COMPILE_CACHE; without one the build only warms THIS "
+        "process and stamps nothing)",
+    )
+    p.add_argument(
+        "--dtypes", default="float32",
+        help="comma-separated input dtypes to warm per bucket "
+        "(default float32; integer dtypes also warm the device-side "
+        "output cast), e.g. 'float32,uint16'",
+    )
+    p.add_argument(
+        "--model", default="translation",
+        choices=["translation", "rigid", "similarity", "affine",
+                 "homography", "piecewise"],
+    )
+    p.add_argument("--backend", default="jax")
+    p.add_argument(
+        "--devices", type=int, default=None, metavar="N",
+        help="warm the sharded programs of an N-chip mesh "
+        "(see `correct --devices`)",
+    )
+    p.add_argument("--reference", default="0",
+                   help="unused for warm-up math; accepted for parity "
+                   "with `correct` flag sets")
+    p.add_argument("--batch-size", type=int, default=0)
+    p.add_argument("--max-keypoints", type=int, default=0)
+    p.add_argument("--hypotheses", type=int, default=0)
+    p.add_argument("--warp", default="",
+                   choices=["", "auto", "jnp", "pallas", "separable"])
+    p.add_argument("--quality", action="store_true")
+    p.add_argument(
+        "--template-update", type=int, default=0,
+        help="also warm the rolling-template update program for this "
+        "cadence (0 = skip it)",
+    )
+    p.add_argument(
+        "--transform-polish", type=int, default=-1,
+        help="polish passes the warmed programs compile with (must "
+        "match the serving config; default: config default)",
+    )
+    p.add_argument("--progress", action="store_true")
+    p.set_defaults(fn=_cmd_warmup)
 
     p = sub.add_parser(
         "report",

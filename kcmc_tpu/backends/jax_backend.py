@@ -70,14 +70,43 @@ def _cast_corrected(corrected: jnp.ndarray, dtype_name: str) -> jnp.ndarray:
     return jnp.clip(jnp.rint(corrected), lo, hi).astype(dt)
 
 
-def _sanitize_nonfinite(frames: jnp.ndarray) -> jnp.ndarray:
+def _sanitize_nonfinite(
+    frames: jnp.ndarray, valid_mask: jnp.ndarray | None = None
+) -> jnp.ndarray:
     """Replace non-finite pixels with each frame's finite mean (the
-    `sanitize_input` config knob; see config.py for the rationale)."""
+    `sanitize_input` config knob; see config.py for the rationale).
+    `valid_mask` (broadcastable bool, optional) restricts the mean to
+    the valid extent of bucket-padded frames, so the replacement value
+    matches what the unpadded frame would have computed (the zero pad
+    is finite and never replaced either way)."""
     finite = jnp.isfinite(frames)
+    stat = finite if valid_mask is None else finite & valid_mask
     axes = tuple(range(1, frames.ndim))
-    n = jnp.maximum(jnp.sum(finite, axis=axes, keepdims=True), 1)
-    mean = jnp.sum(jnp.where(finite, frames, 0.0), axis=axes, keepdims=True) / n
+    n = jnp.maximum(jnp.sum(stat, axis=axes, keepdims=True), 1)
+    mean = jnp.sum(jnp.where(stat, frames, 0.0), axis=axes, keepdims=True) / n
     return jnp.where(finite, frames, mean)
+
+
+def _mask_valid_extent(
+    corrected: jnp.ndarray, transforms: jnp.ndarray, valid_hw: jnp.ndarray
+) -> jnp.ndarray:
+    """Zero warped pixels whose SOURCE sample lies outside the valid
+    (h, w) extent of a bucket-padded frame (kcmc_tpu/plans).
+
+    The unbucketed gather warp writes 0 for out-of-bounds samples; on a
+    padded canvas those samples land in the zero pad instead and a
+    bilinear read straddling the valid edge would blend real pixels
+    against pad zeros — up to a pixel-intensity difference along a
+    1-px boundary curve. Recomputing the source coverage (one fused
+    elementwise pass; ops/warp.coverage_mask with the valid extent)
+    restores out-of-bounds-is-zero exactly, for every warp kernel
+    family."""
+    from kcmc_tpu.ops.warp import coverage_mask
+
+    B, H, W = corrected.shape
+    return jax.vmap(
+        lambda img, M: img * coverage_mask((H, W), M, valid_hw=valid_hw)
+    )(corrected, transforms)
 
 
 @jax.jit
@@ -122,6 +151,9 @@ def _coverage_field(fields: jnp.ndarray, shape) -> jnp.ndarray:
     return jax.vmap(
         lambda f: coverage_mask_flow(upsample_field(f, shape))
     )(fields)
+
+
+_EXPORT_ADVISED = False  # one background-export notice per process
 
 
 @functools.cache
@@ -190,9 +222,21 @@ class JaxBackend:
                         self.mesh.axis_names, self.mesh.devices.shape
                     )
                 }
+            if self._plan.enabled:
+                info["plan"] = {
+                    "buckets": [list(b) for b in self._plan.buckets],
+                    "compile_cache_dir": self._plan.cache_dir,
+                    "rung": self._plan.rung,
+                }
         except Exception:
             pass
         return info
+
+    def plan_cache_stats(self) -> dict:
+        """Execution-plan snapshot (bucket routing counters, compile
+        events, plan-stamp hits/misses) — lands in timing["plan_cache"],
+        the run manifest, and the serve `stats` verb."""
+        return self._plan.stats()
 
     def __init__(self, config: CorrectorConfig, mesh=None, **_options):
         self.config = config
@@ -210,6 +254,15 @@ class JaxBackend:
         # keypoint arrays with masked rows (the pre-round-6 hard
         # divisibility error is gone — see parallel/sharded.py's
         # pad_reference_to_mesh).
+        #
+        # Execution-plan runtime (kcmc_tpu/plans): shape-bucket routing,
+        # the persistent compile cache (compile_cache_dir /
+        # KCMC_COMPILE_CACHE — enabled as a construction side effect
+        # when configured), and compile accounting (every program's
+        # first build is timed, stamped, and traced).
+        from kcmc_tpu.plans.runtime import PlanRuntime
+
+        self._plan = PlanRuntime(config, backend_name=self.name, mesh=mesh)
 
     # -- reference preparation --------------------------------------------
 
@@ -224,9 +277,57 @@ class JaxBackend:
         return pad_reference_to_mesh(ref, mesh_size(self.mesh))
 
     def prepare_reference(self, ref_frame: np.ndarray) -> dict:
+        shape = tuple(int(s) for s in np.shape(ref_frame))
+        bucket = self._plan.route(shape) if len(shape) == 2 else None
+        return self._prepare_reference_impl(ref_frame, bucket)
+
+    def _get_prep_fn(self, shape, bucketed: bool):
+        """The single-scale 2D reference detect+describe as ONE jitted
+        (and plan-instrumented) program — the "reference" program of
+        the execution plan, so its trace rides the exported-program
+        bridge on warm starts and its compile is stamped/accounted like
+        the batch program's."""
+        key = ("prep", shape, self.config, bucketed)
+        fn = self._batch_fns.get(key)
+        if fn is None:
+            cfg = self.config
+
+            def detect_describe(frame, valid_hw=None):
+                kps = detect_keypoints(
+                    frame,
+                    max_keypoints=cfg.max_keypoints,
+                    threshold=cfg.detect_threshold,
+                    nms_size=cfg.nms_size,
+                    border=cfg.border,
+                    harris_k=cfg.harris_k,
+                    window_sigma=cfg.harris_window_sigma,
+                    cand_tile=cfg.cand_tile,
+                    valid_hw=valid_hw,
+                )
+                desc = describe_keypoints(
+                    frame, kps, oriented=cfg.resolved_oriented(),
+                    blur_sigma=cfg.blur_sigma,
+                )
+                return {"xy": kps.xy, "desc": desc, "valid": kps.valid}
+
+            if bucketed:
+                def prep(frame, valid_hw):
+                    return detect_describe(frame, valid_hw)
+            else:
+                def prep(frame):
+                    return detect_describe(frame)
+
+            fn = self._instrument_program("reference", shape, jax.jit(prep))
+            self._batch_fns[key] = fn
+        return fn
+
+    def _prepare_reference_impl(self, ref_frame, bucket) -> dict:
         cfg = self.config
         frame = jnp.asarray(ref_frame, jnp.float32)
         if cfg.sanitize_input:
+            # Sanitize at the TRUE shape (before any bucket padding) so
+            # the finite-mean replacement value matches the unbucketed
+            # path exactly.
             frame = _sanitize_nonfinite(frame[None])[0]
         if frame.ndim == 2:
             if cfg.n_octaves > 1:
@@ -240,22 +341,37 @@ class JaxBackend:
                     "xy": kps.xy[0], "desc": desc[0],
                     "valid": kps.valid[0], "frame": frame,
                 })
-            kps = detect_keypoints(
-                frame,
-                max_keypoints=cfg.max_keypoints,
-                threshold=cfg.detect_threshold,
-                nms_size=cfg.nms_size,
-                border=cfg.border,
-                harris_k=cfg.harris_k,
-                window_sigma=cfg.harris_window_sigma,
-                cand_tile=cfg.cand_tile,
+            valid_hw = None
+            plan_frame = frame
+            if bucket is not None:
+                # Execution-plan bucket routing: detect on the frame
+                # zero-padded to the bucket shape, selection masked to
+                # the true extent — identical keypoints/descriptors to
+                # the unpadded frame (ops/detect.valid_extent_mask),
+                # from the BUCKET-shaped compiled programs. The ref
+                # dict keeps the true-shape template in "frame" (the
+                # host-facing seam: failover, rescue polish, rolling
+                # blends, checkpoints) and the padded one in
+                # "_plan_frame" (the batch program's canvas).
+                h, w = int(frame.shape[0]), int(frame.shape[1])
+                if (h, w) != bucket:
+                    plan_frame = jnp.pad(
+                        frame, ((0, bucket[0] - h), (0, bucket[1] - w))
+                    )
+                valid_hw = jnp.asarray([h, w], jnp.int32)
+            prep = self._get_prep_fn(
+                tuple(int(s) for s in plan_frame.shape), bucket is not None
             )
-            desc = describe_keypoints(
-                frame, kps, oriented=cfg.resolved_oriented(), blur_sigma=cfg.blur_sigma
+            got = prep(
+                plan_frame, *(() if valid_hw is None else (valid_hw,))
             )
-            return self._mesh_ref(
-                {"xy": kps.xy, "desc": desc, "valid": kps.valid, "frame": frame}
-            )
+            ref = {
+                "xy": got["xy"], "desc": got["desc"], "valid": got["valid"],
+                "frame": frame,
+            }
+            if bucket is not None:
+                ref["_plan_frame"] = plan_frame
+            return self._mesh_ref(ref)
         from kcmc_tpu.ops.detect3d import detect_keypoints_3d
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d
 
@@ -313,12 +429,17 @@ class JaxBackend:
             rep = NamedSharding(self.mesh, PartitionSpec())
             frames = jax.device_put(frames, rep)
             ok = jax.device_put(ok, rep)
-        new_frame = _blend_template(
-            jnp.asarray(ref["frame"], jnp.float32),
-            frames,
-            ok,
-            jnp.float32(alpha),
-        )
+        blend_shape = tuple(int(s) for s in frames.shape)
+        # First-build accounting only (the blend's trace+compile
+        # happens inside this dispatch; nothing here blocks the device
+        # stream). prepare_reference below keeps its own accounting.
+        with self._plan.maybe_timed("update_reference", blend_shape, "float32"):
+            new_frame = _blend_template(
+                jnp.asarray(ref["frame"], jnp.float32),
+                frames,
+                ok,
+                jnp.float32(alpha),
+            )
         return self.prepare_reference(new_frame)
 
     # -- batch processing --------------------------------------------------
@@ -360,8 +481,35 @@ class JaxBackend:
         (it is part of the compiled program, and the quality metrics
         read it); only the transfer is skipped."""
         shape = tuple(frames.shape[1:])
-        fn = self._get_batch_fn(shape)
+        plan = self._plan
+        bucket = plan.route(shape) if plan.active else None
         frames_j = jnp.asarray(frames)
+        valid_hw = None
+        if bucket is not None:
+            # Execution-plan bucket routing: pad to the smallest
+            # covering bucket so this batch hits a warm bucket-shaped
+            # executable instead of a fresh per-shape trace; detection
+            # is masked to the true extent inside the program and the
+            # corrected frames slice back below (parity-clean — see
+            # kcmc_tpu/plans and tests/test_plans.py).
+            if bucket != shape:
+                plan.note_route("bucket_padded")
+                frames_j = jnp.pad(
+                    frames_j,
+                    (
+                        (0, 0),
+                        (0, bucket[0] - shape[0]),
+                        (0, bucket[1] - shape[1]),
+                    ),
+                )
+            else:
+                plan.note_route("bucket_exact")
+            valid_hw = jnp.asarray(shape, jnp.int32)
+            fn = self._get_batch_fn(bucket, bucketed=True)
+        else:
+            if plan.active and plan.routable(shape):
+                plan.note_route("bucket_fallback")
+            fn = self._get_batch_fn(shape)
         idx_j = jnp.asarray(frame_indices, jnp.uint32)
         B_caller = None
         if self.mesh is not None:
@@ -382,12 +530,23 @@ class JaxBackend:
                 B_caller = B_in
             frames_j = shard_frames(frames_j, self.mesh)
             idx_j = shard_frames(idx_j, self.mesh)
-        out = fn(
-            frames_j, ref["xy"], ref["desc"], ref["valid"], ref["frame"],
+        args = (
+            frames_j, ref["xy"], ref["desc"], ref["valid"],
+            ref["_plan_frame"] if valid_hw is not None else ref["frame"],
             idx_j,
         )
+        if valid_hw is not None:
+            args = args + (valid_hw,)
+        out = fn(*args)
         if B_caller is not None:
             out = {k: v[:B_caller] for k, v in out.items()}
+        if valid_hw is not None and bucket != shape and "corrected" in out:
+            # Slice the corrected frames back to the true extent ON
+            # DEVICE, before any D2H copy — downstream (quality
+            # metrics, rescue, writers, templates) sees true-shape
+            # arrays exactly as on the unbucketed path.
+            out = dict(out)
+            out["corrected"] = out["corrected"][:, : shape[0], : shape[1]]
         if (
             self.config.quality_metrics
             and "corrected" in out
@@ -421,13 +580,142 @@ class JaxBackend:
                     v.copy_to_host_async()
         return out
 
-    def _get_batch_fn(self, shape):
-        key = (shape, self.config)
-        if key not in self._batch_fns:
-            self._batch_fns[key] = self._build_batch_fn(shape)
-        return self._batch_fns[key]
+    def _get_batch_fn(self, shape, bucketed: bool = False):
+        key = (shape, self.config, bucketed)
+        fn = self._batch_fns.get(key)
+        if fn is None:
+            fn = self._instrument_program(
+                "register", shape, self._build_batch_fn(shape, bucketed)
+            )
+            self._batch_fns[key] = fn
+        return fn
 
-    def _build_batch_fn(self, shape):
+    def _instrument_program(self, program, shape, fn):
+        """Compile accounting + exported-program bridging for a hot
+        jitted program ("register", "reference").
+
+        The first call per input dtype (each dtype is its own compiled
+        executable) runs under the plan runtime's timer —
+        `jit_compile`/`plan_build` trace spans, stamp hit/miss
+        counters, persistent-cache stamps. With a persistent cache
+        configured, the first call also consults the exported-program
+        blob cache (plans/exports.py): a hit DESERIALIZES the traced
+        program in milliseconds and serves the first calls through
+        it — skipping seconds of Python retracing — while a background
+        thread warms the ordinary jit path (its XLA compile hits the
+        persistent cache) and dispatch swaps over; a miss runs the
+        normal trace+compile and exports+primes the blob in the
+        background for the next process. Steady state is the plain jit
+        call either way, behind one dict lookup per call."""
+        import threading
+
+        plan = self._plan
+        routes: dict[str, Any] = {}  # dtype -> "jit" | Exported bridge
+        lock = threading.Lock()
+        use_exports = self.mesh is None  # shard_map programs: jit only
+
+        def specs_of(arrs):
+            return [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrs]
+
+        def first_call(lead, args, dt):
+            key = plan.program_stamp_key(program, shape, dt)
+            exp = None
+            if use_exports and plan.cache.persistent:
+                from kcmc_tpu.plans.exports import load_exported
+
+                exp = load_exported(plan.cache_dir, key)
+            if exp is not None:
+                with plan.timed(program, shape, dt):
+                    out = exp.call(lead, *args)
+                with lock:
+                    # Swap-to-jit warming starts at the first STEADY
+                    # bridged call (see dispatch), not here: a short
+                    # cold-start process that only ever makes one call
+                    # should not pay a concurrent dummy execution, and
+                    # long-lived processes reach their second call
+                    # within one batch anyway.
+                    routes[dt] = (exp, specs_of((lead,) + args))
+                return out
+            with plan.timed(program, shape, dt):
+                out = fn(lead, *args)
+            with lock:
+                routes[dt] = "jit"
+            if use_exports and plan.cache.persistent:
+                from kcmc_tpu.plans.exports import export_and_prime
+
+                # Non-daemon (a daemon thread killed mid-XLA-compile
+                # aborts interpreter teardown), so a short-lived CLI
+                # process may visibly wait at exit for this — say so,
+                # once per process.
+                global _EXPORT_ADVISED
+                if not _EXPORT_ADVISED:
+                    _EXPORT_ADVISED = True
+                    from kcmc_tpu.obs.log import advise
+
+                    advise(
+                        "kcmc: exporting freshly compiled programs to "
+                        "the plan cache in the background — a short-"
+                        "lived process may wait for it at exit; later "
+                        "processes start warm",
+                        stacklevel=2,
+                    )
+                specs = specs_of((lead,) + args)
+                threading.Thread(
+                    target=export_and_prime,
+                    args=(plan.cache_dir, key, fn, specs),
+                    name="kcmc-plan-export",
+                    daemon=False,
+                ).start()
+            return out
+
+        # Swap-to-jit warming starts only after a few STEADY bridged
+        # calls: a short-lived CLI process serves its handful of
+        # batches through the exported program and exits immediately —
+        # starting the (non-daemon: a daemon thread killed mid-XLA-
+        # compile aborts interpreter teardown) retrace+compile thread
+        # there would block exit to rebuild a program the process will
+        # never use. Long-lived processes cross the threshold within
+        # their first seconds of traffic.
+        _SWAP_AFTER_CALLS = 4
+        swap_calls: dict[str, int] = {}
+
+        def start_swap(dt, exp, specs):
+            def warm_jit():
+                # Populate the jit dispatch cache off the latency path
+                # (one zero-filled call; the XLA compile is a
+                # persistent-cache deserialize), then swap steady-state
+                # dispatch back to the plain jit call.
+                try:
+                    dummy = [np.zeros(s.shape, s.dtype) for s in specs]
+                    jax.block_until_ready(fn(*dummy))
+                except Exception:
+                    return  # keep bridging; exp.call stays correct
+                with lock:
+                    routes[dt] = "jit"
+
+            threading.Thread(
+                target=warm_jit, name="kcmc-plan-swap", daemon=False
+            ).start()
+
+        def dispatch(lead, *args):
+            dt = str(lead.dtype)
+            route = routes.get(dt)
+            if route == "jit":
+                return fn(lead, *args)
+            if route is not None:
+                exp, specs = route
+                n = swap_calls.get(dt, 0) + 1
+                swap_calls[dt] = n
+                if n == _SWAP_AFTER_CALLS:
+                    start_swap(dt, exp, specs)
+                return exp.call(lead, *args)  # bridging
+            if plan.first_time(program, shape, dt):
+                return first_call(lead, tuple(args), dt)
+            return fn(lead, *args)
+
+        return dispatch
+
+    def _build_batch_fn(self, shape, bucketed: bool = False):
         """Assemble the LOCAL batch program: stage-wise over the batch —
         vmapped detection, batched descriptor extraction (Pallas patch
         kernel on accelerators), vmapped match + consensus, then the
@@ -435,23 +723,40 @@ class JaxBackend:
         kernels live (their batch axis is a grid axis, which cannot sit
         inside a vmap); the jnp fallbacks fuse identically. Multi-device
         execution wraps the same local program in shard_map.
+
+        `bucketed` builds the execution-plan variant: a trailing
+        `valid_hw` (2,) int argument carries the true extent of
+        bucket-padded frames through detection masking, the sanitize
+        statistics, and the post-warp valid-coverage zeroing — one
+        compiled program per BUCKET serves every true shape within it.
         """
         is_3d = len(shape) == 3
-        local = self._build_local_3d(shape) if is_3d else self._build_local_2d(shape)
+        local = (
+            self._build_local_3d(shape)
+            if is_3d
+            else self._build_local_2d(shape, bucketed=bucketed)
+        )
         if self.mesh is not None:
             from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
 
-            return make_sharded_batch_fn(local, self.mesh)
+            return make_sharded_batch_fn(
+                local, self.mesh, extra_replicated=1 if bucketed else 0
+            )
         return jax.jit(local)
 
-    def _detect_describe_2d(self, frames, use_pallas: bool, multi_scale=True):
+    def _detect_describe_2d(
+        self, frames, use_pallas: bool, multi_scale=True, valid_hw=None
+    ):
         """The 2D detect+describe stage for a (B, H, W) float32 batch:
         single-scale by default; with `n_octaves > 1`, the ORB scale
         pyramid — per-octave fixed-K detection and description on
         MXU-resized images, merged into one multi-scale keypoint set in
         base coordinates (ops/pyramid.py). Shared by the batch program
         and prepare_reference so reference and frame keypoints always
-        come from the same pipeline."""
+        come from the same pipeline. `valid_hw` (traced (2,) ints)
+        masks selection to the true extent of bucket-padded frames
+        (execution plans; single-scale only — bucket routing gates
+        pyramid configs out)."""
         cfg = self.config
         oriented = cfg.resolved_oriented()
 
@@ -467,6 +772,7 @@ class JaxBackend:
                 smooth_sigma=cfg.blur_sigma,
                 window_sigma=cfg.harris_window_sigma,
                 cand_tile=cfg.cand_tile,
+                valid_hw=valid_hw,
             )
             desc = describe_keypoints_batch(
                 fr,
@@ -495,12 +801,18 @@ class JaxBackend:
             per.append(stage(oc.frames, ko, b))
         return merge_octave_keypoints(per, octs)
 
-    def _build_local_2d(self, shape):
+    def _build_local_2d(self, shape, bucketed: bool = False):
         cfg = self.config
         oriented = cfg.resolved_oriented()
         use_pallas_patches = self._on_accelerator()
         base_key = jax.random.key(cfg.seed)
         is_pw = cfg.model == "piecewise"
+        if bucketed and is_pw:
+            raise ValueError(
+                "bucketed execution covers 2D matrix models only (the "
+                "piecewise patch grid spans the frame; routing gates it "
+                "out) — this is a routing bug, not a user error"
+            )
         if is_pw:
             flow_warp = self._resolve_flow_warp()
             field_warp = self._resolve_field_warp(shape)
@@ -518,12 +830,33 @@ class JaxBackend:
                 slack=cfg.match_slack, nms_tile=cfg.cand_tile,
             )
 
-        def local(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices):
+        def core(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices,
+                 valid_hw):
             # Frames upload in their native dtype (uint16 stacks halve
             # the host->device bytes); all math runs in float32.
             frames = frames.astype(jnp.float32)
+            if valid_hw is None:
+                vwarp = batch_warp if not is_pw else None
+                valid_rect = None
+            else:
+                # Bucketed program (execution plans): frames are
+                # zero-padded to this bucket; `valid_hw` carries the
+                # true (h, w) extent. Three seams keep the padded run
+                # parity-clean vs the unpadded one: the sanitize
+                # statistics restrict to the valid rect, detection
+                # masks selection to it, and every warp's output zeroes
+                # pixels whose source sample left it (the unbucketed
+                # out-of-bounds-is-zero semantics).
+                from kcmc_tpu.ops.warp import valid_rect_mask
+
+                valid_rect = valid_rect_mask(shape, valid_hw)
+
+                def vwarp(fr, Ms):
+                    c, ok = batch_warp(fr, Ms)
+                    return _mask_valid_extent(c, Ms, valid_hw), ok
+
             if cfg.sanitize_input:
-                frames = _sanitize_nonfinite(frames)
+                frames = _sanitize_nonfinite(frames, valid_rect)
             if banded_geom is not None:
                 from kcmc_tpu.ops.match_banded import build_banded_ref
 
@@ -534,7 +867,7 @@ class JaxBackend:
                 )
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
             kps, desc = self._detect_describe_2d(
-                frames, use_pallas_patches
+                frames, use_pallas_patches, valid_hw=valid_hw
             )
 
             def tail(frame, kp, d, key):
@@ -630,7 +963,7 @@ class JaxBackend:
                 # gives corrected0 = ref-aligned via M_r, so
                 # ref -> frame is M1 @ M_r.
                 coarse = out["transform"]
-                corrected0, ok0 = batch_warp(frames, coarse)
+                corrected0, ok0 = vwarp(frames, coarse)
                 kps2, desc2 = self._detect_describe_2d(
                     corrected0, use_pallas_patches, multi_scale=False
                 )
@@ -693,7 +1026,7 @@ class JaxBackend:
                 out["corrected"], out["warp_ok"] = corrected, ok
             else:
                 out = dict(out)
-                corrected, ok = batch_warp(frames, out["transform"])
+                corrected, ok = vwarp(frames, out["transform"])
                 for _ in range(int(cfg.transform_polish)):
                     from kcmc_tpu.ops.polish import polish_transforms
 
@@ -708,13 +1041,31 @@ class JaxBackend:
                     newM = polish_transforms(
                         corrected, ref_frame, out["transform"],
                         cfg.model, grid=cfg.polish_grid,
+                        valid_hw=valid_hw,
                     )
                     out["transform"] = jnp.where(
                         ok[:, None, None], newM, out["transform"]
                     )
-                    corrected, ok = batch_warp(frames, out["transform"])
+                    corrected, ok = vwarp(frames, out["transform"])
                 out["corrected"], out["warp_ok"] = corrected, ok
             return out
+
+        if bucketed:
+            # Execution-plan variant: the trailing valid_hw (2,) int
+            # array rides through shard_map replicated (P() spec).
+            def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                      indices, valid_hw):
+                return core(
+                    frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                    indices, valid_hw,
+                )
+        else:
+            def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                      indices):
+                return core(
+                    frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                    indices, None,
+                )
 
         return local
 

@@ -276,6 +276,14 @@ class NumpyBackend:
         if self.config.mesh_devices:
             # recorded so a manifest shows the knob was set but unused
             info["mesh_devices_ignored"] = int(self.config.mesh_devices)
+        if getattr(self.config, "plan_buckets", ()):
+            # Execution plans amortize COMPILATION, which the numpy
+            # oracle has none of — buckets are accepted and ignored
+            # (like mesh_devices) so a bucketed jax config fails over
+            # here without a config scrub; recorded for the manifest.
+            info["plan_buckets_ignored"] = [
+                list(b) for b in self.config.plan_buckets
+            ]
         return info
 
     def _detect_describe_2d(self, frame: np.ndarray, multi_scale=True):

@@ -272,6 +272,35 @@ class CorrectorConfig:
             self.trace_path or self.frame_records_path or self.heartbeat_s > 0
         )
 
+    # -- execution plans / AOT compilation (kcmc_tpu/plans;
+    #    docs/PERFORMANCE.md "Cold-start anatomy") ------------------------
+    # Shape-bucket ladder for AOT execution plans: entries are positive
+    # ints (square buckets) or (H, W) pairs, e.g. (512, 1024) or
+    # ((480, 640), 1024). Empty (default) = off. With buckets declared,
+    # `MotionCorrector.warmup()` / `kcmc_tpu warmup` ahead-of-time
+    # compiles every hot program per bucket, and 2D matrix-model inputs
+    # whose shape is not a bucket are zero-padded to the smallest
+    # covering bucket (detection masked to the valid extent, outputs
+    # sliced back — parity-clean vs the unbucketed path) so arbitrary
+    # shapes hit a warm executable instead of a fresh JIT trace.
+    # Pyramid (n_octaves > 1), banded-matching, piecewise, and 3D
+    # configs never pad (they fall back to exact-shape compiles; AOT
+    # warm-up at declared shapes still applies). NOT resume-signature
+    # neutral: padded-canvas polish measures over the bucket extent, so
+    # flipping it mid-run restarts instead of resuming. The numpy
+    # backend ignores it (no compilation to amortize), so failover
+    # needs no config scrub.
+    plan_buckets: tuple = ()
+    # Persistent compilation-cache directory (None = off; the
+    # KCMC_COMPILE_CACHE env var applies when unset — a non-None config
+    # value wins). Wires JAX's on-disk compilation cache plus the plan
+    # stamp registry under it, so a NEW process deserializes previously
+    # compiled executables instead of rebuilding them — the base layer
+    # of millisecond cold starts (`bench.py --coldstart`). Resume-
+    # signature neutral: caching only changes WHEN compiles happen,
+    # never what a run computes.
+    compile_cache_dir: str | None = None
+
     # -- input hygiene -----------------------------------------------------
     # Replace non-finite input pixels (dead/hot sensor pixels, NaN
     # padding) with the frame's finite mean, on device, before
@@ -570,6 +599,23 @@ class CorrectorConfig:
             raise ValueError(
                 f"writer_depth must be >= 0 batches (0 = synchronous "
                 f"writes), got {self.writer_depth}"
+            )
+        # Normalize the bucket ladder eagerly (ints/lists/pairs ->
+        # canonical sorted tuple of (H, W) pairs) so the frozen config
+        # hashes and digests on one spelling; a typo'd spec fails at
+        # construction. plans/buckets.py is import-light (no jax).
+        from kcmc_tpu.plans.buckets import normalize_buckets
+
+        object.__setattr__(
+            self, "plan_buckets", normalize_buckets(self.plan_buckets)
+        )
+        if self.compile_cache_dir is not None and (
+            not isinstance(self.compile_cache_dir, str)
+            or not self.compile_cache_dir.strip()
+        ):
+            raise ValueError(
+                "compile_cache_dir must be a non-empty path string or "
+                f"None, got {self.compile_cache_dir!r}"
             )
         if self.warp not in ("auto", "jnp", "pallas", "separable", "matrix"):
             raise ValueError(

@@ -51,6 +51,11 @@ _ROBUSTNESS_SIG_NEUTRAL = {
         # serving QoS knobs schedule WHEN work dispatches, never what a
         # one-shot file run computes
         "serve_queue_depth", "serve_inflight", "serve_degrade_watermark",
+        # the persistent compile cache changes WHEN compiles happen,
+        # never what a run computes (plan_buckets is deliberately NOT
+        # here: padded-canvas polish measures over the bucket extent,
+        # so flipping buckets mid-run must restart, not resume)
+        "compile_cache_dir",
     )
 }
 
@@ -732,6 +737,49 @@ class MotionCorrector:
             ),
         )
 
+    # -- execution plans (kcmc_tpu/plans) --------------------------------
+
+    def warmup(
+        self, buckets=None, dtypes=None, programs=None, progress=False
+    ) -> dict:
+        """Ahead-of-time compile every hot program for the declared
+        shape buckets (`plan_buckets`, or an explicit `buckets=`), so
+        the first real batch pays dispatch, not trace + XLA compile.
+
+        With `compile_cache_dir` / KCMC_COMPILE_CACHE set, the build
+        also populates the persistent compilation cache: a NEW process
+        running the same warmup deserializes every executable from disk
+        (`stamp_misses == 0` in the returned stats — the coldstart
+        contract `bench.py --coldstart` measures and CI asserts).
+
+        dtypes: input dtypes to warm per bucket (default float32;
+        integer dtypes also warm the device-side output cast).
+        programs: subset of ("reference", "register",
+        "update_reference", "apply"); default all that apply.
+        Returns the build stats (programs built, stamp hits/misses,
+        seconds, and the backend's full plan-cache snapshot).
+        """
+        from kcmc_tpu.plans import ExecutionPlan
+
+        return ExecutionPlan(
+            self, buckets=buckets, dtypes=dtypes, programs=programs
+        ).build(progress=progress)
+
+    def _plan_timing(self, timing: dict) -> None:
+        """Attach the backend's plan-cache snapshot to a run's timing
+        (and through it the CLI summary, the --transforms npz, the
+        trace metadata, and `kcmc_tpu report`) whenever execution plans
+        are configured or any program compiled during the run."""
+        stats_fn = getattr(self.backend, "plan_cache_stats", None)
+        if stats_fn is None:
+            return
+        try:
+            stats = stats_fn()
+        except Exception:
+            return
+        if stats.get("enabled") or stats.get("programs_compiled"):
+            timing["plan_cache"] = stats
+
     # -- observability ---------------------------------------------------
 
     def _begin_telemetry(self, timer: StageTimer, total: int | None = None):
@@ -1393,6 +1441,7 @@ class MotionCorrector:
         fields = merged.pop("field", None)
         timing = timer.report(n_frames=len(indices))
         timing["warp_escalated"] = self._escalated
+        self._plan_timing(timing)
         timing["pipeline"] = {
             "drain_flushes": state["flushes"],
             "template_updates": n_updates,
@@ -2434,6 +2483,7 @@ class MotionCorrector:
         # took no wall time here and would overstate throughput).
         timing = timer.report(n_frames=cursor["done"] - restored)
         timing["warp_escalated"] = self._escalated
+        self._plan_timing(timing)
         timing["pipeline"] = {
             "drain_flushes": dp_state["flushes"],
             "template_updates": n_updates,

@@ -172,6 +172,40 @@ def _stage_table(timing: dict) -> list[str]:
     fps = timing.get("frames_per_sec")
     if fps:
         lines.append(f"Throughput: {fps:.1f} frames/sec")
+    plan = timing.get("plan_cache")
+    if plan:
+        # Warm-up / compile accounting (kcmc_tpu/plans): what this run
+        # compiled vs deserialized, and how batches routed to buckets.
+        lines.append("Warm-up / compile cache (execution plans):")
+        cache = plan.get("cache_dir") or "off"
+        lines.append(
+            f"  persistent cache: {cache}  rung: {plan.get('rung', 'full')}"
+        )
+        if plan.get("buckets"):
+            lines.append(
+                "  buckets: "
+                + ", ".join("x".join(map(str, b)) for b in plan["buckets"])
+                + (
+                    f"  routed exact={plan.get('bucket_exact', 0)}"
+                    f" padded={plan.get('bucket_padded', 0)}"
+                    f" fallback={plan.get('bucket_fallback', 0)}"
+                )
+            )
+        lines.append(
+            f"  programs compiled: {plan.get('programs_compiled', 0)}"
+            f" in {plan.get('compile_s', 0.0):.2f}s"
+            f"  (stamp hits {plan.get('stamp_hits', 0)},"
+            f" misses {plan.get('stamp_misses', 0)})"
+        )
+        for ev in (plan.get("events") or [])[:8]:
+            shape = "x".join(str(s) for s in ev.get("shape", []))
+            hit = ev.get("stamp_hit")
+            tag = "" if hit is None else (" [cached]" if hit else " [fresh]")
+            lines.append(
+                f"    {ev.get('program', '?'):<18} {shape:<12}"
+                f" {ev.get('dtype', ''):<8} {ev.get('seconds', 0.0):>8.3f}s"
+                f"{tag}"
+            )
     return lines
 
 

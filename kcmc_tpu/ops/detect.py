@@ -156,6 +156,35 @@ def tile_max_argmax(resp: jnp.ndarray, T: int):
     return tile_val, tile_arg
 
 
+def valid_extent_mask(
+    shape: tuple[int, int], border: int, valid_hw: jnp.ndarray
+) -> jnp.ndarray:
+    """Selectable-region mask for a frame zero-PADDED to `shape` whose
+    true content occupies the top-left `valid_hw` = (h, w) extent (the
+    execution-plan shape buckets, kcmc_tpu/plans).
+
+    Keypoints must come only from [border, h-border) x [border,
+    w-border): the pad boundary's response ridge (real content against
+    the zero pad) would otherwise inflate the frame's peak response and
+    crowd the fixed-K selection — the exact border-ring trap the
+    relative threshold already dodges at the frame edge. Masking
+    `nms_resp` to -inf outside this region makes padded detection
+    IDENTICAL to detection on the unpadded frame: zero padding + the
+    SAME-zero-padding convolutions leave every response value inside
+    the valid region bit-equal, and selection sees the identical
+    candidate set. `valid_hw` is a traced (2,) int array, so one
+    compiled program serves every true extent within the bucket.
+    """
+    H, W = shape
+    h = valid_hw[0]
+    w = valid_hw[1]
+    ys = jnp.arange(H, dtype=jnp.int32)[:, None]
+    xs = jnp.arange(W, dtype=jnp.int32)[None, :]
+    return (
+        (ys >= border) & (ys < h - border) & (xs >= border) & (xs < w - border)
+    )
+
+
 def _maxpool_same(x: jnp.ndarray, size: int) -> jnp.ndarray:
     # Separable: max over rows then columns (max is associative/idempotent).
     x = lax.reduce_window(
@@ -312,6 +341,7 @@ def detect_keypoints(
     harris_k: float = 0.04,
     window_sigma: float = WINDOW_SIGMA,
     cand_tile: int = CAND_TILE,
+    valid_hw: jnp.ndarray | None = None,
 ) -> Keypoints:
     """Detect up to `max_keypoints` Harris corners in a (H, W) frame.
 
@@ -322,11 +352,18 @@ def detect_keypoints(
     suppression) — the candidate-reduction grid both backends share.
     `window_sigma` is the Harris structure-tensor window: the detector's
     density ceiling (see CorrectorConfig.harris_window_sigma).
+    `valid_hw` (traced (2,) ints, optional) restricts selection to the
+    top-left (h, w) valid extent of a zero-padded frame — the
+    execution-plan shape buckets (see valid_extent_mask).
     """
     resp = harris_response(img, k=harris_k, window_sigma=window_sigma)
     # NMS: keep strict local maxima of the response.
     is_max = resp >= _maxpool_same(resp, nms_size)
     nms_resp = jnp.where(is_max, resp, -jnp.inf)
+    if valid_hw is not None:
+        nms_resp = jnp.where(
+            valid_extent_mask(resp.shape, border, valid_hw), nms_resp, -jnp.inf
+        )
     ox_f, oy_f = _subpixel_fields(resp)
     return _select_keypoints(
         nms_resp, ox_f, oy_f, max_keypoints, threshold, border, cand_tile
@@ -353,6 +390,7 @@ def detect_keypoints_batch(
     interpret: bool = False,
     window_sigma: float = WINDOW_SIGMA,
     cand_tile: int = CAND_TILE,
+    valid_hw: jnp.ndarray | None = None,
 ):
     """Detect keypoints over a (B, H, W) batch; fields carry a batch axis.
 
@@ -365,6 +403,12 @@ def detect_keypoints_batch(
     sigma-blurred batch for the descriptor stage (`gaussian_blur`
     semantics) — a free ride on the fused kernel's resident slab when
     the Pallas path runs, two separate conv passes otherwise.
+
+    `valid_hw` (traced (2,) ints, optional) restricts selection to the
+    top-left (h, w) valid extent of zero-padded frames — the
+    execution-plan shape buckets. The mask lands on the dense nms
+    field, so the fused Pallas route and the jnp route mask
+    identically (see valid_extent_mask).
     """
     B, H, W = frames.shape
     if smooth_sigma is not None and smooth_sigma <= 0.0:
@@ -396,11 +440,18 @@ def detect_keypoints_batch(
                 window_sigma=window_sigma,
                 smooth_sigma=smooth_sigma, interpret=interpret,
             )
+            nms_field = out[0]
+            if valid_hw is not None:
+                nms_field = jnp.where(
+                    valid_extent_mask((H, W), border, valid_hw)[None],
+                    nms_field,
+                    -jnp.inf,
+                )
             kps = jax.vmap(
                 lambda nr, ox, oy: _select_keypoints(
                     nr, ox, oy, max_keypoints, threshold, border, cand_tile
                 )
-            )(*out[:3])
+            )(nms_field, out[1], out[2])
             return (kps, out[3]) if smooth_sigma is not None else kps
     kps = jax.vmap(
         lambda f: detect_keypoints(
@@ -412,6 +463,7 @@ def detect_keypoints_batch(
             harris_k=harris_k,
             window_sigma=window_sigma,
             cand_tile=cand_tile,
+            valid_hw=valid_hw,
         )
     )(frames)
     if smooth_sigma is not None:

@@ -268,6 +268,7 @@ def polish_transforms(
     model_name: str,
     grid: tuple[int, int] = (4, 4),
     window_frac: float = 0.25,
+    valid_hw: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """One photometric polish pass for a batch of matrix transforms.
 
@@ -279,6 +280,16 @@ def polish_transforms(
     keep their transform unchanged — as do regions the gate zeroed,
     which contribute zero-shift support nowhere (weight 0) rather than
     fake identity evidence.
+
+    `valid_hw` (traced (2,) ints, optional): the true (h, w) extent of
+    frames bucket-padded to (H, W) (execution plans). The coverage gate
+    then treats everything outside the valid extent — output pixels in
+    the pad, and samples the warp drew from it — as uncovered, so
+    boundary regions drop out of the fit. Without this, the pad edge
+    (real content against synthetic zeros, at the SAME place in
+    corrected and template) correlates perfectly at zero shift and
+    biases the fitted update toward identity (measured ~0.3 px on a
+    50x70-in-64x80 affine run).
     """
     model = get_model(model_name)
     B, H, W = corrected.shape
@@ -293,7 +304,17 @@ def polish_transforms(
     # measures fine) while dropping zoom borders (10-100% contaminated).
     from kcmc_tpu.ops.warp import coverage_mask
 
-    cov = jax.vmap(lambda M: coverage_mask((H, W), M))(transforms)
+    if valid_hw is None:
+        cov = jax.vmap(lambda M: coverage_mask((H, W), M))(transforms)
+    else:
+        # Bucketed canvas: a region is covered only where the OUTPUT
+        # pixel lies in the valid rect AND its source sample stays in
+        # the valid extent (both shared definitions live in ops/warp).
+        from kcmc_tpu.ops.warp import valid_rect_mask
+
+        cov = valid_rect_mask((H, W), valid_hw)[None] & jax.vmap(
+            lambda M: coverage_mask((H, W), M, valid_hw=valid_hw)
+        )(transforms)
     covw = _windowed_mean(cov.astype(jnp.float32), grid, window_frac)
     sig = sig & (covw >= 0.98)
     centers = region_centers(grid, (H, W)).reshape(-1, 2)  # (P, 2)

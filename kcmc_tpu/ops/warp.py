@@ -88,15 +88,43 @@ def warp_frame_flow(frame: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
     return bilinear_sample(frame, xs + flow[..., 0], ys + flow[..., 1])
 
 
-def coverage_mask(shape: tuple[int, int], M: jnp.ndarray) -> jnp.ndarray:
-    """Boolean mask of output pixels whose source sample is in-bounds."""
+def coverage_mask(
+    shape: tuple[int, int], M: jnp.ndarray, valid_hw: jnp.ndarray | None = None
+) -> jnp.ndarray:
+    """Boolean mask of output pixels whose source sample is in-bounds.
+
+    `valid_hw` (traced (2,) ints, optional) bounds the SOURCE check to
+    the top-left (h, w) valid extent of a bucket-padded frame instead
+    of the full canvas — the one definition of the perspective-divide
+    source-bounds test the execution-plan masking (backends/
+    jax_backend._mask_valid_extent) and the polish coverage gate
+    (ops/polish.py) share with the plain coverage path."""
     H, W = shape
     xs, ys = _grid((H, W))
     w = M[2, 0] * xs + M[2, 1] * ys + M[2, 2]
     w = jnp.where(jnp.abs(w) < 1e-8, 1e-8, w)
     sx = (M[0, 0] * xs + M[0, 1] * ys + M[0, 2]) / w
     sy = (M[1, 0] * xs + M[1, 1] * ys + M[1, 2]) / w
-    return (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+    if valid_hw is None:
+        wmax, hmax = float(W - 1), float(H - 1)
+    else:
+        wmax = (valid_hw[1] - 1).astype(jnp.float32)
+        hmax = (valid_hw[0] - 1).astype(jnp.float32)
+    return (sx >= 0) & (sx <= wmax) & (sy >= 0) & (sy <= hmax)
+
+
+def valid_rect_mask(
+    shape: tuple[int, int], valid_hw: jnp.ndarray
+) -> jnp.ndarray:
+    """(H, W) bool mask of the top-left (h, w) valid extent of a
+    bucket-padded canvas (execution plans) — the one definition shared
+    by the batch program's sanitize statistics and the polish coverage
+    gate (detection's border-inset variant lives in
+    ops/detect.valid_extent_mask)."""
+    H, W = shape
+    ys = jnp.arange(H, dtype=jnp.int32)[:, None]
+    xs = jnp.arange(W, dtype=jnp.int32)[None, :]
+    return (ys < valid_hw[0]) & (xs < valid_hw[1])
 
 
 def coverage_mask_flow(flow: jnp.ndarray) -> jnp.ndarray:

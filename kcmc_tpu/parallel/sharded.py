@@ -57,7 +57,10 @@ def shard_map(f, *, mesh, in_specs, out_specs, **kw):
 from kcmc_tpu.parallel.mesh import FRAME_AXIS
 
 
-def make_sharded_batch_fn(local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS):
+def make_sharded_batch_fn(
+    local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS,
+    extra_replicated: int = 0,
+):
     """Wrap a local batch program into a sharded one.
 
     local_batch_fn(frames, ref_xy, ref_desc, ref_valid, ref_frame,
@@ -66,18 +69,24 @@ def make_sharded_batch_fn(local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS):
     frame indices, so per-frame RANSAC keys stay device-count-
     independent.
 
+    `extra_replicated` trailing arguments are passed through REPLICATED
+    (P() spec) — the bucketed execution-plan program appends its
+    `valid_hw` extent this way (one tiny (2,) int array, identical on
+    every chip).
+
     Returns a jitted fn whose frame-axis inputs/outputs are sharded over
     `mesh`; ref_* inputs are sharded over the *keypoint* axis (the
     reference frame over its row axis) and all-gathered on device.
     """
 
-    def local_block(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices):
+    def local_block(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices,
+                    *extra):
         # One all-gather per reference array: K/n -> K on every chip.
         ref_xy = lax.all_gather(ref_xy, axis, tiled=True)
         ref_desc = lax.all_gather(ref_desc, axis, tiled=True)
         ref_valid = lax.all_gather(ref_valid, axis, tiled=True)
         return local_batch_fn(
-            frames, ref_xy, ref_desc, ref_valid, ref_frame, indices
+            frames, ref_xy, ref_desc, ref_valid, ref_frame, indices, *extra
         )
 
     sharded = shard_map(
@@ -87,7 +96,8 @@ def make_sharded_batch_fn(local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS):
         # by the photometric polish; its row count — e.g. a 12-deep
         # volume — need not divide the mesh, unlike the keypoint
         # arrays, whose K is mesh-padded by construction).
-        in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(axis)),
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(), P(axis))
+        + (P(),) * extra_replicated,
         out_specs=P(axis),
     )
     return jax.jit(sharded)

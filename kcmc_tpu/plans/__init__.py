@@ -1,0 +1,40 @@
+"""Execution plans: shape-bucketed AOT compilation and the persistent
+compile cache (ROADMAP open item 2; docs/PERFORMANCE.md "Cold-start
+anatomy").
+
+The subsystem in one breath: declare a ladder of frame-shape *buckets*
+(`plan_buckets`), and every hot program — reference preparation, the
+registration batch program, rolling-template updates, the apply warp —
+is compiled ahead of time per bucket (`ExecutionPlan`, usually via
+`MotionCorrector.warmup()` or the `kcmc_tpu warmup` CLI); arbitrary
+input shapes zero-pad to the smallest covering bucket with
+masked/sliced parity, so they hit a warm executable instead of a fresh
+trace. Underneath, `compile_cache_dir` / `KCMC_COMPILE_CACHE` wires
+JAX's persistent compilation cache plus a per-program stamp registry,
+so a NEW process (cold start, elastic scale-out, numpy→jax failback)
+deserializes every executable from disk — cache hit/miss stats land in
+`timing["plan_cache"]`, the run manifest, and the serve `stats` verb.
+"""
+
+from kcmc_tpu.plans.buckets import normalize_buckets, route_shape
+from kcmc_tpu.plans.cache import (
+    PlanCache,
+    active_compile_cache_dir,
+    disable_compile_cache,
+    enable_compile_cache,
+)
+from kcmc_tpu.plans.plan import ExecutionPlan
+from kcmc_tpu.plans.runtime import PlanRuntime, add_tracer, discard_tracer
+
+__all__ = [
+    "ExecutionPlan",
+    "PlanCache",
+    "PlanRuntime",
+    "active_compile_cache_dir",
+    "add_tracer",
+    "disable_compile_cache",
+    "discard_tracer",
+    "enable_compile_cache",
+    "normalize_buckets",
+    "route_shape",
+]

@@ -1,0 +1,76 @@
+"""Shape-bucket policy for AOT execution plans.
+
+Every distinct frame shape costs one JIT trace + XLA compile per
+program, so serving arbitrary input sizes from a warm cache needs a
+QUANTIZED shape space: a declared ladder of (H, W) *buckets*. An input
+whose shape is not itself a bucket is zero-padded bottom/right to the
+smallest covering bucket, registered there (detection masked to the
+valid extent — see backends/jax_backend.py's bucketed program), and the
+outputs are sliced back — so arbitrary shapes hit one of a FIXED set of
+compiled executables instead of paying a fresh trace each.
+
+This module is the pure policy layer (no jax import): spec
+normalization, validation, and routing. Kept import-light because
+`CorrectorConfig.__post_init__` normalizes `plan_buckets` through it.
+"""
+
+from __future__ import annotations
+
+
+def normalize_buckets(spec) -> tuple[tuple[int, int], ...]:
+    """Canonicalize a bucket spec into a sorted tuple of (H, W) pairs.
+
+    Accepts None/()/[], a bare int (one square bucket), or an iterable
+    whose entries are positive ints (square buckets) or (H, W) pairs —
+    so ``(512, 1024)`` is a ladder of two squares and ``((480, 640),)``
+    one rectangular bucket. Result is area-sorted (routing picks the first
+    cover, i.e. the smallest), deduplicated, hashable — the canonical
+    form stored back into the frozen config so config digests and the
+    jitted-program cache key on one spelling.
+    """
+    if spec is None:
+        return ()
+    if isinstance(spec, int):
+        # bare int: a one-rung ladder of one square bucket
+        spec = (spec,)
+    out: list[tuple[int, int]] = []
+    for entry in spec:
+        if isinstance(entry, bool):
+            raise ValueError(f"plan bucket entries must be ints, got {entry!r}")
+        if isinstance(entry, int):
+            hw = (entry, entry)
+        elif (
+            isinstance(entry, (tuple, list))
+            and len(entry) == 2
+            and all(isinstance(s, int) and not isinstance(s, bool) for s in entry)
+        ):
+            hw = (int(entry[0]), int(entry[1]))
+        else:
+            raise ValueError(
+                "plan bucket entries must be a positive int (square) or "
+                f"an (H, W) pair of positive ints, got {entry!r}"
+            )
+        if hw[0] < 32 or hw[1] < 32:
+            raise ValueError(
+                f"plan bucket {hw} is too small — buckets must be at "
+                "least 32x32 (the detection border + descriptor patch "
+                "leave no selectable interior below that)"
+            )
+        if hw not in out:
+            out.append(hw)
+    return tuple(sorted(out, key=lambda hw: (hw[0] * hw[1], hw[0])))
+
+
+def route_shape(
+    shape, buckets: tuple[tuple[int, int], ...]
+) -> tuple[int, int] | None:
+    """The smallest bucket covering `shape` (H <= bH and W <= bW), or
+    None when no bucket covers it (the caller falls back to an
+    exact-shape compile and counts a `bucket_fallback`)."""
+    if len(shape) != 2:
+        return None
+    h, w = int(shape[0]), int(shape[1])
+    for bh, bw in buckets:  # area-sorted: first cover is the smallest
+        if h <= bh and w <= bw:
+            return (bh, bw)
+    return None

@@ -1,0 +1,195 @@
+"""Persistent compile-cache wiring and the plan-stamp registry.
+
+Two layers make a cold process start warm:
+
+* **JAX's persistent compilation cache** (`jax_compilation_cache_dir`)
+  stores the XLA executables themselves — a recompile of an identical
+  program in a NEW process deserializes from disk instead of running
+  the XLA pipeline. `enable_compile_cache` wires it (opt-in via the
+  `compile_cache_dir` config field / `KCMC_COMPILE_CACHE` env var) with
+  the size/time thresholds zeroed so every kcmc program is eligible.
+* **Plan stamps** (`PlanCache`): a tiny JSON-per-program registry under
+  `<cache_dir>/kcmc_plans/` recording WHICH programs a previous process
+  already compiled through the persistent cache, keyed by (program,
+  shape bucket, dtype, mesh shape, consensus-budget rung, config
+  digest, kcmc + jax versions). The stamp layer is what makes cache
+  hit/miss statistics honest and cheap: a "stamp hit" means the XLA
+  binaries for that exact program key went through the persistent cache
+  before, so this process's compile is a deserialize, not a build — and
+  `stamp_misses == 0` on a rerun is the machine-checkable "second run
+  compiled zero new programs" contract the CI coldstart job asserts.
+
+Stamps are only consulted/written when a persistent cache directory is
+active — a stamp without the underlying XLA cache would claim warmth it
+cannot deliver.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import threading
+
+_ENABLE_LOCK = threading.Lock()
+_ENABLED_DIR: str | None = None
+
+
+def enable_compile_cache(path: str) -> str | None:
+    """Point JAX's persistent compilation cache at `path` (process-
+    global; idempotent per directory). Returns the active directory, or
+    None when this jax build exposes no compilation-cache config.
+
+    The min-compile-time / min-entry-size thresholds are zeroed so
+    small programs (the CPU-sized CI shapes) are cached too — the
+    default 1 s floor would silently skip exactly the programs the
+    coldstart smoke test asserts on.
+    """
+    global _ENABLED_DIR
+    path = os.path.abspath(path)
+    with _ENABLE_LOCK:
+        if _ENABLED_DIR == path:
+            return _ENABLED_DIR
+        if _ENABLED_DIR is not None:
+            # FIRST-writer-wins: jax's cache dir is process-global, so
+            # re-pointing it for a second corrector would leave the
+            # first one stamping programs under a directory the XLA
+            # cache no longer writes to — stamps claiming warmth the
+            # binaries cannot deliver. Every runtime uses the RETURNED
+            # dir for its stamps, so all correctors of one process
+            # share the first-configured cache coherently.
+            from kcmc_tpu.obs.log import advise
+
+            advise(
+                f"kcmc: compile cache already active at {_ENABLED_DIR}; "
+                f"ignoring the request to re-point it at {path} (one "
+                "persistent cache per process)",
+                stacklevel=3,
+            )
+            return _ENABLED_DIR
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        try:
+            jax.config.update("jax_compilation_cache_dir", path)
+        except Exception:
+            return None
+        for flag, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.0),
+            ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ):
+            try:
+                jax.config.update(flag, val)
+            except Exception:
+                pass  # older jax: threshold flag absent, defaults apply
+        _reset_jax_cache_state()
+        _ENABLED_DIR = path
+        return _ENABLED_DIR
+
+
+def _reset_jax_cache_state() -> None:
+    """Drop jax's memoized cache-enabled decision.
+
+    jax decides ONCE per process whether the persistent cache is in use
+    (`compilation_cache.is_cache_used` memoizes at the first compile) —
+    and trivial compiles happen at import time (module-level jnp
+    constants), i.e. BEFORE a backend construction can configure the
+    directory. Without this reset, enabling the cache after import
+    silently caches nothing: every write logs "cache is disabled/not
+    initialized"."""
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
+    except Exception:
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:
+            pass
+
+
+def disable_compile_cache() -> None:
+    """Unset the persistent compilation cache (tests: a tmpdir cache
+    must not outlive its test)."""
+    global _ENABLED_DIR
+    with _ENABLE_LOCK:
+        if _ENABLED_DIR is None:
+            return
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        _reset_jax_cache_state()
+        _ENABLED_DIR = None
+
+
+def active_compile_cache_dir() -> str | None:
+    return _ENABLED_DIR
+
+
+class PlanCache:
+    """Stamp registry under `<root>/kcmc_plans/` (root = the compile
+    cache directory; None disables — checks report miss-less inactivity
+    and stamps are skipped)."""
+
+    def __init__(self, root: str | None):
+        self.root = (
+            os.path.join(os.path.abspath(root), "kcmc_plans") if root else None
+        )
+
+    @property
+    def persistent(self) -> bool:
+        return self.root is not None
+
+    @staticmethod
+    def program_key(**fields) -> str:
+        """Deterministic key of a compiled program: sha256 of the
+        canonical JSON of its identity fields, 24 hex chars."""
+        canon = json.dumps(
+            {k: fields[k] for k in sorted(fields)},
+            sort_keys=True,
+            separators=(",", ":"),
+            default=str,
+        )
+        return hashlib.sha256(canon.encode()).hexdigest()[:24]
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, f"{key}.json")
+
+    def check(self, key: str) -> bool:
+        """Whether a previous process stamped this program key."""
+        if self.root is None:
+            return False
+        try:
+            return os.path.exists(self._path(key))
+        except OSError:
+            return False
+
+    def stamp(self, key: str, meta: dict) -> None:
+        """Record a successfully built program (atomic write; best
+        effort — a read-only cache dir must not fail the run)."""
+        if self.root is None:
+            return
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8") as f:
+                    json.dump(meta, f, default=str)
+                    f.write("\n")
+                os.replace(tmp, self._path(key))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError:
+            pass

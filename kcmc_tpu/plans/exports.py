@@ -1,0 +1,119 @@
+"""Exported-program blobs: the zero-retrace layer of the plan cache.
+
+JAX's persistent compilation cache removes the XLA compile from a warm
+process start, but the *Python trace + lowering* of the big batch
+programs still costs seconds per program — on CPU it is the dominant
+warm-start term. `jax.export` removes it: a traced program serializes
+to a StableHLO blob that a new process DESERIALIZES in milliseconds and
+calls directly; its XLA compile then hits the persistent cache. The
+plan cache stores one blob per program key under
+`<cache_dir>/kcmc_exports/`.
+
+The exported call path (`Exported.call`) re-dispatches through a
+primitive per call — fine for the first batches of a cold process,
+not for the steady-state hot loop. The backend therefore uses a blob
+only as a BRIDGE: the first call(s) run through it while a background
+thread warms the ordinary jit path (whose XLA compile also hits the
+persistent cache), and dispatch swaps over as soon as that lands —
+steady state is byte-for-byte the unexported path.
+
+Everything here is best-effort: any failure (old jax without
+`jax.export`, platform mismatch, stale blob) silently falls back to
+the ordinary trace+compile path.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def _exports_dir(root: str | None) -> str | None:
+    return (
+        os.path.join(os.path.abspath(root), "kcmc_exports") if root else None
+    )
+
+
+def blob_path(root: str | None, key: str) -> str | None:
+    d = _exports_dir(root)
+    return os.path.join(d, f"{key}.bin") if d else None
+
+
+def save_exported(root: str | None, key: str, fn, arg_specs) -> bool:
+    """Trace+export `fn` at `arg_specs` (ShapeDtypeStructs) and persist
+    the serialized program. Returns True on success; never raises."""
+    path = blob_path(root, key)
+    if path is None:
+        return False
+    try:
+        from jax import export as jexport
+
+        blob = jexport.export(fn)(*arg_specs).serialize()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return True
+    except Exception:
+        return False
+
+
+def export_and_prime(root: str | None, key: str, fn, arg_specs) -> bool:
+    """Background tail of a first-build: serialize the traced program
+    AND run the exported call path once on zero inputs, so its XLA
+    executable lands in the persistent compilation cache under the
+    exported-call cache key. (The exported module hashes differently
+    from the jit path's module, so without this priming a warm
+    process's bridge call would pay a full XLA compile — the exact cost
+    the blob exists to remove.) Never raises."""
+    if not save_exported(root, key, fn, arg_specs):
+        return False
+    try:
+        import numpy as np
+
+        import jax
+
+        exp = load_exported(root, key)
+        if exp is None:
+            return False
+        dummy = [np.zeros(s.shape, s.dtype) for s in arg_specs]
+        jax.block_until_ready(exp.call(*dummy))
+        return True
+    except Exception:
+        return False
+
+
+def load_exported(root: str | None, key: str):
+    """Deserialize the exported program for `key`, or None. The
+    returned object's `.call(*args)` runs it (exact shapes/dtypes);
+    deserialization is milliseconds — the trace it replaces is
+    seconds. Never raises."""
+    path = blob_path(root, key)
+    if path is None:
+        return None
+    try:
+        if not os.path.exists(path):
+            return None
+        from jax import export as jexport
+
+        import jax
+
+        exp = jexport.deserialize(open(path, "rb").read())
+        # A blob records the platform(s) it was lowered for; a CPU
+        # process must not try to run a TPU blob (the program key
+        # already separates platforms — this is the backstop for a
+        # cache dir shared across heterogeneous hosts).
+        plats = {p.lower() for p in getattr(exp, "platforms", ())}
+        if plats and jax.default_backend().lower() not in plats:
+            return None
+        return exp
+    except Exception:
+        return None

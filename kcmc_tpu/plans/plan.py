@@ -1,0 +1,230 @@
+"""ExecutionPlan: ahead-of-time build of every hot program.
+
+A cold process pays its first batch's latency in JIT trace + XLA
+compile, not hardware. `ExecutionPlan.build()` walks the declared shape
+buckets × dtypes and drives each hot program through the backend's REAL
+entry points with zero-filled inputs — reference preparation, the
+registration batch program, the rolling-template `update_reference`
+program, and (for matrix/piecewise models) the apply/stabilize warp —
+so each lowers and compiles exactly the executable production traffic
+will hit, through the backend's instrumented compile accounting
+(PlanRuntime.timed: plan stamps, hit/miss counters, `plan_build` trace
+spans). With a persistent compile cache underneath
+(`compile_cache_dir` / `KCMC_COMPILE_CACHE`), a SECOND process's build
+deserializes every XLA binary from disk: `stamp_misses == 0`, and the
+process-start → first-corrected-frame latency drops by the full
+compile cost (`bench.py --coldstart` measures it).
+
+Warm-by-execution is deliberate (vs a bare `jit(...).lower().compile()`):
+the dummy call populates the exact `jit` dispatch cache the production
+path consults — an AOT-compiled executable held on the side would need
+its own routing layer and would still leave the first real call to pay
+a second cache lookup chain. The zero-filled batch's execution rides
+along in the measured build time (one batch at the bucket shape —
+noise next to a compile).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+_DEFAULT_PROGRAMS = ("reference", "register", "update_reference", "apply")
+
+
+class ExecutionPlan:
+    """AOT warm-up driver for one corrector's hot programs.
+
+    Parameters
+    ----------
+    corrector:
+        The `MotionCorrector` whose backend (and config) to warm —
+        normally via `MotionCorrector.warmup(...)`, which constructs
+        this. The corrector supplies the compiled batch size, the
+        rolling-template knobs, and the backend instance.
+    buckets:
+        Shape buckets to build for; default: the config's
+        `plan_buckets`. Must be non-empty.
+    dtypes:
+        Input dtypes to warm per bucket (frames upload in their native
+        dtype, and each dtype is its own compiled program); default
+        ("float32",). Integer dtypes additionally warm the device-side
+        output cast.
+    programs:
+        Subset of ("reference", "register", "update_reference",
+        "apply") to build; default all that apply to the config
+        (`update_reference` only with rolling templates armed; `apply`
+        only for 2D models).
+    """
+
+    def __init__(
+        self, corrector, buckets=None, dtypes=None, programs=None
+    ):
+        from kcmc_tpu.plans.buckets import normalize_buckets
+
+        self.mc = corrector
+        self.backend = corrector.backend
+        self.config = corrector.config
+        plan = getattr(self.backend, "_plan", None)
+        if self.config.model == "rigid3d":
+            raise ValueError(
+                "execution plans cover 2D models; rigid3d volumes "
+                "compile per (D, H, W) shape on first use"
+            )
+        self.buckets = (
+            normalize_buckets(buckets)
+            if buckets is not None
+            else (plan.buckets if plan is not None else ())
+        )
+        if not self.buckets:
+            raise ValueError(
+                "no shape buckets to build — set plan_buckets in the "
+                "config (or pass buckets=) so the plan knows which "
+                "shapes to compile for"
+            )
+        self.dtypes = tuple(
+            str(np.dtype(d)) for d in (dtypes or ("float32",))
+        )
+        progs = tuple(programs) if programs is not None else _DEFAULT_PROGRAMS
+        if "update_reference" in progs and (
+            corrector.template_update_every <= 0
+            or not hasattr(self.backend, "update_reference")
+        ):
+            progs = tuple(p for p in progs if p != "update_reference")
+        self.programs = progs
+
+    def build(self, progress: bool = False) -> dict:
+        """Build every (bucket, dtype) program; returns the build stats
+        summary (counts, stamp hits/misses, seconds, and the backend's
+        full plan-cache snapshot)."""
+        backend = self.backend
+        plan = getattr(backend, "_plan", None)
+        before = plan.stats() if plan is not None else None
+        if plan is not None:
+            plan.building = True
+        t0 = time.perf_counter()
+        built = []
+        try:
+            for bucket in self.buckets:
+                ref = None
+                if "reference" in self.programs or {
+                    "register", "update_reference"
+                } & set(self.programs):
+                    ref = backend.prepare_reference(
+                        np.zeros(bucket, np.float32)
+                    )
+                    built.append(("reference", bucket, "float32"))
+                    if progress:
+                        print(f"[plan] reference {bucket} ready", flush=True)
+                first_out = None
+                for dt in self.dtypes:
+                    if "register" in self.programs:
+                        out = self._build_register(ref, bucket, dt)
+                        if first_out is None:
+                            first_out = out
+                        built.append(("register", bucket, dt))
+                        if progress:
+                            print(
+                                f"[plan] register {bucket} {dt} ready",
+                                flush=True,
+                            )
+                if "update_reference" in self.programs and first_out is not None:
+                    # dtype-invariant: the blend casts every tail to
+                    # float32, so one build per bucket covers all
+                    self._build_update(ref, first_out, bucket)
+                    built.append(("update_reference", bucket, "float32"))
+                if "apply" in self.programs:
+                    self._build_apply(bucket)
+                    built.append(("apply", bucket, "float32"))
+        finally:
+            if plan is not None:
+                plan.building = False
+        build_s = time.perf_counter() - t0
+        summary = {
+            "buckets": [list(b) for b in self.buckets],
+            "dtypes": list(self.dtypes),
+            "programs": list(self.programs),
+            "programs_built": len(built),
+            "build_s": round(build_s, 3),
+        }
+        if plan is not None:
+            after = plan.stats()
+            for k in ("stamp_hits", "stamp_misses", "programs_compiled"):
+                summary[k] = after[k] - before[k]
+            summary["compile_s"] = round(
+                after["compile_s"] - before["compile_s"], 3
+            )
+            summary["persistent"] = after["persistent"]
+            summary["cache_dir"] = after["cache_dir"]
+            summary["plan_cache"] = after
+        return summary
+
+    # -- per-program builders ---------------------------------------------
+
+    def _dummy_batch(self, bucket, dtype) -> np.ndarray:
+        B = self.config.batch_size
+        return np.zeros((B,) + tuple(bucket), np.dtype(dtype))
+
+    def _build_register(self, ref, bucket, dtype):
+        B = self.config.batch_size
+        batch = self._dummy_batch(bucket, dtype)
+        idx = np.arange(B, dtype=np.uint32)
+        kw = {}
+        dispatch = getattr(self.backend, "process_batch_async", None)
+        if dispatch is not None:
+            kw["to_host"] = False
+            dt = np.dtype(dtype)
+            if np.issubdtype(dt, np.integer):
+                # integer stacks take the device-side output cast —
+                # its tiny program is part of the hot path too
+                kw["cast_dtype"] = dt
+            out = dispatch(batch, ref, idx, **kw)
+        else:
+            out = self.backend.process_batch(batch, ref, idx)
+        # Block on one small per-frame output so the compile (and the
+        # dummy execution) is really finished before this returns; the
+        # corrected frames stay on device.
+        np.asarray(out["n_inliers"])
+        return out
+
+    def _build_update(self, ref, out, bucket):
+        mc = self.mc
+        W = min(mc.template_window, mc.template_update_every)
+        corrected = out.get("corrected")
+        if corrected is None:
+            return
+        tail_c = [corrected[:W]]
+        tail_ok = [np.ones(min(W, int(corrected.shape[0])), bool)]
+        self.backend.update_reference(
+            ref, tail_c, tail_ok, W, mc.template_update_alpha
+        )
+
+    def _build_apply(self, bucket) -> None:
+        """Warm the apply/stabilize resample path (`apply_correction`'s
+        warpers) for this bucket at the corrector's batch size."""
+        if getattr(self.backend, "name", "") != "jax":
+            return
+        plan = getattr(self.backend, "_plan", None)
+        B = self.config.batch_size
+        frames = np.zeros((B,) + tuple(bucket), np.float32)
+        import contextlib
+
+        ctx = (
+            plan.maybe_timed("apply", bucket, "float32")
+            if plan is not None
+            else contextlib.nullcontext()
+        )
+        if self.config.model == "piecewise":
+            from kcmc_tpu.ops.warp import fast_apply_fields
+
+            gh, gw = self.config.patch_grid
+            fields = np.zeros((B, gh, gw, 2), np.float32)
+            with ctx:
+                fast_apply_fields(frames, fields)
+            return
+        from kcmc_tpu.ops.warp import fast_apply_matrix
+
+        Ms = np.tile(np.eye(3, dtype=np.float32), (B, 1, 1))
+        with ctx:
+            fast_apply_matrix(frames, Ms)

@@ -1,0 +1,307 @@
+"""PlanRuntime: the per-backend execution-plan state.
+
+One instance rides on every JaxBackend. It owns:
+
+* the normalized bucket ladder and shape routing (plans/buckets.py);
+* the plan-stamp cache handle (plans/cache.py) resolved from
+  `compile_cache_dir` / the `KCMC_COMPILE_CACHE` env var;
+* compile accounting — every program's FIRST invocation per
+  (program, shape, dtype, rung) is timed through `timed()`, which
+  checks/writes plan stamps, updates the hit/miss counters, and emits
+  `plan_build` / `jit_compile` trace spans plus `plan_cache_hit` /
+  `plan_cache_miss` instants to any registered tracer (obs/run.py
+  registers the run's Tracer while a traced run is live);
+* the bucket-routing counters (`bucket_exact` / `bucket_padded` /
+  `bucket_fallback`), incremented per dispatched batch.
+
+`stats()` is the snapshot that lands in `timing["plan_cache"]`, the run
+manifest, `kcmc_tpu report`, and the serve `stats` verb.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from kcmc_tpu.plans.buckets import normalize_buckets, route_shape
+from kcmc_tpu.plans.cache import PlanCache, enable_compile_cache
+
+# -- tracer listeners ------------------------------------------------------
+# Registered by RunTelemetry while a traced run is live; compile events
+# from ANY thread (scheduler warm-ups, serve prefetches) become spans on
+# the live trace. Module-level because compiles happen below the level
+# where a run's telemetry handle is visible.
+_LISTENER_LOCK = threading.Lock()
+_TRACERS: list = []
+
+_EVENT_CAP = 128  # bounded per-backend event history in stats()
+
+_CODE_FPR: str | None = None  # process-wide source fingerprint (lazy)
+
+
+def add_tracer(tracer) -> None:
+    with _LISTENER_LOCK:
+        if tracer not in _TRACERS:
+            _TRACERS.append(tracer)
+
+
+def discard_tracer(tracer) -> None:
+    with _LISTENER_LOCK:
+        try:
+            _TRACERS.remove(tracer)
+        except ValueError:
+            pass
+
+
+def _live_tracers() -> list:
+    with _LISTENER_LOCK:
+        return list(_TRACERS)
+
+
+_MATRIX_MODELS = ("translation", "rigid", "similarity", "affine", "homography")
+
+
+class PlanRuntime:
+    def __init__(self, config, backend_name: str = "jax", mesh=None):
+        self.config = config
+        self.backend_name = backend_name
+        self.buckets = normalize_buckets(getattr(config, "plan_buckets", ()))
+        cache_dir = getattr(config, "compile_cache_dir", None) or os.environ.get(
+            "KCMC_COMPILE_CACHE"
+        ) or None
+        if cache_dir:
+            cache_dir = enable_compile_cache(cache_dir)
+        self.cache_dir = cache_dir
+        self.cache = PlanCache(cache_dir)
+        self.mesh_shape = (
+            tuple(int(s) for s in mesh.devices.shape) if mesh is not None else None
+        )
+        # Consensus-budget rung label: "full" by default; the serving
+        # scheduler tags its reduced-budget backend's runtime
+        # "degraded" so plan keys and stats distinguish the two rungs
+        # (the config digest already differs — the label is for
+        # readability and for the serve stats() breakdown).
+        self.rung = "full"
+        self.building = False  # True inside ExecutionPlan.build
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._config_sha: str | None = None
+        self.counters = {
+            "programs_compiled": 0,
+            "compile_s": 0.0,
+            "stamp_hits": 0,
+            "stamp_misses": 0,
+            "bucket_exact": 0,
+            "bucket_padded": 0,
+            "bucket_fallback": 0,
+        }
+        self.events: list[dict] = []
+
+    # -- routing -----------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        """Whether bucket routing is configured at all."""
+        return bool(self.buckets)
+
+    @property
+    def enabled(self) -> bool:
+        """Whether any plan surface (routing or persistent cache) is on."""
+        return self.active or self.cache.persistent
+
+    def routable(self, shape) -> bool:
+        """Whether bucket routing covers this config + frame rank.
+
+        Routing is gated to the configurations whose padded execution
+        is parity-clean by construction: 2D matrix models, single-scale
+        (the pyramid's MXU resize would blend pad zeros into octave
+        pixels), dense matching (the banded matcher's spatial buckets
+        are laid out over the padded extent), and a detection border
+        that keeps every descriptor patch inside the valid extent —
+        with border below the descriptor support radius, the unpadded
+        path edge-REPLICATES out-of-frame patch samples while the
+        padded canvas would serve literal zeros there, silently
+        breaking the identical-descriptors contract near the valid
+        edge. Everything else still benefits from AOT plan WARM-UP at
+        declared shapes — it just never pads.
+        """
+        from kcmc_tpu.ops.patterns import ROT_RADIUS
+
+        cfg = self.config
+        return (
+            self.active
+            and len(shape) == 2
+            and cfg.model in _MATRIX_MODELS
+            and cfg.n_octaves <= 1
+            and cfg.match_radius is None
+            # +1: subpixel keypoint positions shift patch support by
+            # up to half a pixel each way
+            and cfg.border >= ROT_RADIUS + 1
+        )
+
+    def route(self, shape) -> tuple[int, int] | None:
+        """The bucket for `shape`, or None (not routable / no cover)."""
+        if not self.routable(shape):
+            return None
+        return route_shape(shape, self.buckets)
+
+    def note_route(self, kind: str) -> None:
+        """Count one dispatched batch's routing outcome
+        (`bucket_exact` / `bucket_padded` / `bucket_fallback`)."""
+        with self._lock:
+            self.counters[kind] += 1
+
+    # -- compile accounting ------------------------------------------------
+
+    def config_sha(self) -> str:
+        if self._config_sha is None:
+            from kcmc_tpu.obs.manifest import config_digest
+
+            self._config_sha = config_digest(self.config)[1]
+        return self._config_sha
+
+    def code_fingerprint(self) -> str:
+        """Source-content fingerprint of the installed kcmc_tpu tree
+        (sha256 over sorted (relpath, size, mtime_ns) of every .py —
+        stat-only, computed once per process). Part of every program
+        key: JAX's own persistent cache is content-addressed and misses
+        safely after a code edit, but exported-program blobs and stamps
+        are key-addressed — without this, an editable-install edit that
+        doesn't bump __version__ would silently replay a STALE traced
+        program while stats report cache hits."""
+        global _CODE_FPR
+        if _CODE_FPR is None:
+            import hashlib
+
+            import kcmc_tpu
+
+            root = os.path.dirname(os.path.abspath(kcmc_tpu.__file__))
+            h = hashlib.sha256()
+            entries = []
+            for dirpath, dirnames, files in os.walk(root):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for f in files:
+                    if not f.endswith(".py"):
+                        continue
+                    p = os.path.join(dirpath, f)
+                    try:
+                        st = os.stat(p)
+                    except OSError:
+                        continue
+                    entries.append(
+                        (os.path.relpath(p, root), st.st_size, st.st_mtime_ns)
+                    )
+            for e in sorted(entries):
+                h.update(repr(e).encode())
+            _CODE_FPR = h.hexdigest()[:16]
+        return _CODE_FPR
+
+    def first_time(self, program: str, shape, dtype: str) -> bool:
+        """Whether this (program, shape, dtype) has not yet been built
+        in this process — the gate for the `timed()` wrapper, so steady
+        state pays one set lookup, not a timestamp pair."""
+        key = (program, tuple(shape), str(dtype), self.rung)
+        with self._lock:
+            if key in self._seen:
+                return False
+            self._seen.add(key)
+            return True
+
+    def program_stamp_key(self, program: str, shape, dtype: str) -> str:
+        from kcmc_tpu import __version__
+
+        import jax
+
+        return self.cache.program_key(
+            kcmc=__version__,
+            code=self.code_fingerprint(),
+            jax=jax.__version__,
+            platform=jax.default_backend(),
+            backend=self.backend_name,
+            config=self.config_sha(),
+            program=program,
+            shape=tuple(int(s) for s in shape),
+            dtype=str(dtype),
+            mesh=self.mesh_shape,
+            rung=self.rung,
+        )
+
+    def maybe_timed(self, program: str, shape, dtype: str):
+        """`timed(...)` on the first build of this program key, a
+        no-op context afterwards — so call sites guard one `with`
+        block instead of duplicating the guarded call in timed and
+        untimed branches."""
+        if self.first_time(program, shape, dtype):
+            return self.timed(program, shape, dtype)
+        return contextlib.nullcontext()
+
+    @contextlib.contextmanager
+    def timed(self, program: str, shape, dtype: str):
+        """Time one first-build of a program; account stamps, counters,
+        events, and trace spans. The span is named `plan_build` inside
+        an ExecutionPlan build and `jit_compile` for an inline (lazily
+        triggered) build — the wall time covers trace + lowering + XLA
+        compile (a persistent-cache hit makes the last a deserialize)
+        plus the warming call's own execution."""
+        stamp_key = self.program_stamp_key(program, shape, dtype)
+        hit = self.cache.check(stamp_key)
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            # failed builds are not stamped and not counted as compiles
+            raise
+        dur = time.perf_counter() - t0
+        event = {
+            "program": program,
+            "shape": list(int(s) for s in shape),
+            "dtype": str(dtype),
+            "rung": self.rung,
+            "seconds": round(dur, 4),
+            "stamp_hit": bool(hit) if self.cache.persistent else None,
+        }
+        with self._lock:
+            self.counters["programs_compiled"] += 1
+            self.counters["compile_s"] += dur
+            if self.cache.persistent:
+                self.counters["stamp_hits" if hit else "stamp_misses"] += 1
+            if len(self.events) < _EVENT_CAP:
+                self.events.append(event)
+        span = "plan_build" if self.building else "jit_compile"
+        for tracer in _live_tracers():
+            try:
+                tracer.complete(span, t0, dur, cat="plan", args=event)
+                if self.cache.persistent:
+                    tracer.instant(
+                        "plan_cache_hit" if hit else "plan_cache_miss",
+                        cat="plan",
+                        args={"program": program, "key": stamp_key},
+                    )
+            except Exception:
+                pass
+        if not hit:
+            self.cache.stamp(
+                stamp_key,
+                dict(event, key=stamp_key, config_sha256=self.config_sha()),
+            )
+
+    # -- snapshot ----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            counters = dict(self.counters)
+            events = list(self.events)
+        return {
+            "enabled": self.enabled,
+            "persistent": self.cache.persistent,
+            "cache_dir": self.cache_dir,
+            "buckets": [list(b) for b in self.buckets],
+            "rung": self.rung,
+            **{
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in counters.items()
+            },
+            "events": events,
+        }

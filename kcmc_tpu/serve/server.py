@@ -212,8 +212,12 @@ def _json_safe(obj):
 
 def serve_main(args) -> int:
     """`python -m kcmc_tpu serve` body (argparse args from __main__)."""
-    from kcmc_tpu import MotionCorrector
+    import time
 
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.obs.log import advise
+
+    t_boot = time.perf_counter()
     overrides = dict(args.overrides)
     mc = MotionCorrector(
         model=args.model,
@@ -222,6 +226,22 @@ def serve_main(args) -> int:
         template_update_every=args.template_update,
         **overrides,
     )
+    # Execution-plan warm-up BEFORE the ready line: with plan_buckets
+    # declared, every hot program compiles (or deserializes from the
+    # persistent compile cache) now, so sessions open against warm
+    # plans instead of paying JIT at first contact. The ready record
+    # reports the cost so operators can verify a resident server
+    # actually started warm (stamp_misses == 0 on a re-boot).
+    warm = None
+    if mc.config.plan_buckets and getattr(mc.backend, "_plan", None) is not None:
+        try:
+            warm = mc.warmup()
+        except Exception as e:
+            advise(
+                f"kcmc serve: execution-plan warm-up failed "
+                f"({type(e).__name__}: {e}); programs compile lazily",
+                stacklevel=2,
+            )
     server = ServeServer(
         mc, host=args.host, port=args.port, heartbeat_s=args.heartbeat
     )
@@ -238,19 +258,28 @@ def serve_main(args) -> int:
         )
     except ValueError:
         pass
-    print(
-        json.dumps({
-            "serving": True,
-            "host": server.host,
-            "port": server.port,
-            "model": mc.config.model,
-            "backend": mc.backend_name,
-            "batch_size": mc.config.batch_size,
-            "queue_depth": mc.config.serve_queue_depth,
-            "inflight": mc.config.serve_inflight,
-        }),
-        flush=True,
-    )
+    ready = {
+        "serving": True,
+        "host": server.host,
+        "port": server.port,
+        "model": mc.config.model,
+        "backend": mc.backend_name,
+        "batch_size": mc.config.batch_size,
+        "queue_depth": mc.config.serve_queue_depth,
+        "inflight": mc.config.serve_inflight,
+        # process start -> ready wall time (includes backend + mesh
+        # construction and the plan warm-up when configured)
+        "warmup_s": round(time.perf_counter() - t_boot, 3),
+    }
+    if warm is not None:
+        ready["plan_cache"] = {
+            "programs_built": warm.get("programs_built", 0),
+            "stamp_hits": warm.get("stamp_hits", 0),
+            "stamp_misses": warm.get("stamp_misses", 0),
+            "build_s": warm.get("build_s", 0.0),
+            "persistent": warm.get("persistent", False),
+        }
+    print(json.dumps(ready), flush=True)
     try:
         while not server.wait(timeout=0.5):
             pass

@@ -15,9 +15,15 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 # NOTE: do NOT enable JAX_COMPILATION_CACHE_DIR here — the persistent
-# compilation cache hangs indefinitely in this image (verified: even a
-# trivial jit never completes with it set).
+# compilation cache hangs indefinitely in this image when armed at
+# import time via the env var against the axon TPU tunnel (verified in
+# round 3; enabling it AFTER import on the CPU backend works — that is
+# what kcmc_tpu/plans/cache.enable_compile_cache does, and the plans
+# tests that need it opt in against a tmpdir and disable it after).
+# KCMC_COMPILE_CACHE is popped too so an operator's ambient cache dir
+# never leaks compile-cache state into the suite.
 os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+os.environ.pop("KCMC_COMPILE_CACHE", None)
 
 # The image's TPU-tunnel plugin ("axon", registered by sitecustomize)
 # force-sets jax_platforms="axon,cpu" via jax.config, which overrides the

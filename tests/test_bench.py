@@ -111,3 +111,45 @@ def test_scaling_row_efficiency_math():
     )
     assert row["efficiency"] == 0.8
     assert row["fps_1chip"] == 100.0 and row["fps_mesh"] == 640.0
+
+
+def test_bench_cli_has_coldstart_flags():
+    out = subprocess.run(
+        [sys.executable, _BENCH, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--coldstart" in out.stdout
+    assert "--plans" in out.stdout
+
+
+def test_coldstart_judged_json_line_contract():
+    """The --coldstart judged line: one parseable JSON line with the
+    warm first-frame latency as the value, per-config cold/warm/speedup
+    rows, and vs_baseline = best speedup / 5 (the >=5x warm target)."""
+    sys.path.insert(0, os.path.dirname(_BENCH))
+    import bench
+
+    rows = {
+        "translation": {
+            "cold_s": 130.0, "warm_s": 10.0, "speedup": 13.0,
+            "compile_s_cold": 120.0, "compile_s_warm": 2.0,
+            "run1_stamp_misses": 2, "run2_stamp_misses": 0,
+            "run2_stamp_hits": 2,
+        },
+        "piecewise": {
+            "cold_s": 28.5, "warm_s": 3.8, "speedup": 7.5,
+            "compile_s_cold": 24.6, "compile_s_warm": 1.3,
+            "run1_stamp_misses": 2, "run2_stamp_misses": 0,
+            "run2_stamp_hits": 2,
+        },
+    }
+    line = bench.coldstart_judged_json_line("translation", 512, rows)
+    assert "\n" not in line
+    rec = json.loads(line)
+    assert rec["metric"] == "coldstart_first_frame_translation_512x512"
+    assert rec["value"] == 10.0
+    assert rec["unit"] == "seconds"
+    assert rec["speedup"] == 13.0
+    assert rec["vs_baseline"] == round(13.0 / 5.0, 3)
+    assert rec["configs"]["piecewise"]["run2_stamp_misses"] == 0

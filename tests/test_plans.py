@@ -1,0 +1,429 @@
+"""Execution plans (kcmc_tpu/plans): bucket-padding parity, AOT
+warm-up, persistent plan stamps, and the obs/serve surfaces.
+
+The load-bearing contract is PARITY: a 2D matrix-model input routed
+through a padding bucket must produce the same results as the
+unbucketed path — detection masked to the valid extent is candidate-
+for-candidate identical (zero pad + SAME-zero-padding convolutions
+leave every response value in the valid region bit-equal), and the
+post-warp valid-coverage mask restores out-of-bounds-is-zero exactly,
+so with the photometric polish off the parity is BITWISE; the tests
+assert 1e-4 to leave float headroom across BLAS builds. The polish
+measures over the bucket canvas (valid-extent-gated regions), so
+polish-on runs agree to the partition-noise level instead — asserted
+against ground truth, not bit-parity (docs/PERFORMANCE.md "Cold-start
+anatomy" documents the semantic).
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from kcmc_tpu.config import CorrectorConfig
+from kcmc_tpu.corrector import MotionCorrector
+from kcmc_tpu.plans.buckets import normalize_buckets, route_shape
+from kcmc_tpu.utils.synthetic import make_drift_stack
+
+
+@pytest.fixture
+def drift_stack():
+    data = make_drift_stack(
+        n_frames=10, shape=(50, 70), model="translation", max_drift=6.0,
+        seed=0,
+    )
+    return np.asarray(data.stack, np.float32)
+
+
+def _correct(stack, output_dtype="float32", **kw):
+    defaults = dict(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=100, transform_polish=0,
+    )
+    defaults.update(kw)
+    return MotionCorrector(**defaults).correct(
+        stack, output_dtype=output_dtype
+    )
+
+
+# -- bucket policy ---------------------------------------------------------
+
+
+def test_normalize_buckets_canonical():
+    assert normalize_buckets(None) == ()
+    assert normalize_buckets(()) == ()
+    assert normalize_buckets(512) == ((512, 512),)
+    # ladder of squares + a rectangle, area-sorted, deduplicated
+    got = normalize_buckets((512, (480, 640), 512, 1024))
+    assert got == ((512, 512), (480, 640), (1024, 1024))
+    assert normalize_buckets([64]) == ((64, 64),)
+
+
+def test_normalize_buckets_rejects_garbage():
+    with pytest.raises(ValueError):
+        normalize_buckets((8,))  # below the 32x32 floor
+    with pytest.raises(ValueError):
+        normalize_buckets(("512",))
+    with pytest.raises(ValueError):
+        normalize_buckets(((64, 64, 64),))
+
+
+def test_route_shape_smallest_cover():
+    buckets = normalize_buckets((64, (64, 80), 128))
+    assert route_shape((50, 70), buckets) == (64, 80)
+    assert route_shape((64, 64), buckets) == (64, 64)
+    assert route_shape((100, 100), buckets) == (128, 128)
+    assert route_shape((500, 500), buckets) is None
+    assert route_shape((50,), buckets) is None
+
+
+def test_config_normalizes_and_validates():
+    c = CorrectorConfig(plan_buckets=[64, (64, 80)])
+    assert c.plan_buckets == ((64, 64), (64, 80))
+    assert hash(c) is not None  # stays hashable (jit cache key)
+    with pytest.raises(ValueError):
+        CorrectorConfig(compile_cache_dir="")
+    with pytest.raises(ValueError):
+        CorrectorConfig(plan_buckets=(16,))
+
+
+# -- bucket-padding parity -------------------------------------------------
+
+
+def test_padded_route_parity_translation(drift_stack):
+    """Odd (50, 70) frames through a (64, 80) bucket: transforms,
+    corrected pixels, and the detection diagnostics all match the
+    unbucketed path (uneven tail batch 10 % 4 != 0 and non-aligned
+    K=100 included); quality metrics are computed at the true shape."""
+    kw = dict(quality_metrics=True)
+    plain = _correct(drift_stack, **kw)
+    routed = _correct(drift_stack, plan_buckets=((64, 80),), **kw)
+    np.testing.assert_allclose(
+        routed.transforms, plain.transforms, atol=1e-4
+    )
+    np.testing.assert_allclose(routed.corrected, plain.corrected, atol=1e-4)
+    for k in ("n_keypoints", "n_matches", "n_inliers"):
+        np.testing.assert_array_equal(
+            routed.diagnostics[k], plain.diagnostics[k]
+        )
+    np.testing.assert_allclose(
+        routed.diagnostics["template_corr"],
+        plain.diagnostics["template_corr"],
+        atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        routed.diagnostics["coverage"], plain.diagnostics["coverage"],
+        atol=1e-5,
+    )
+    assert routed.timing["plan_cache"]["bucket_padded"] > 0
+
+
+def test_exact_bucket_shape_counts_exact(drift_stack):
+    """A shape that IS a bucket routes with no padding; results match
+    the plain program and the exact-hit counter records it."""
+    data = make_drift_stack(
+        n_frames=6, shape=(64, 80), model="translation", max_drift=5.0,
+        seed=2,
+    )
+    stack = np.asarray(data.stack, np.float32)
+    plain = _correct(stack)
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=100, transform_polish=0, plan_buckets=((64, 80),),
+    )
+    routed = mc.correct(stack)
+    np.testing.assert_allclose(
+        routed.transforms, plain.transforms, atol=1e-4
+    )
+    stats = mc.backend.plan_cache_stats()
+    assert stats["bucket_exact"] > 0
+    assert stats["bucket_padded"] == 0
+
+
+def test_unroutable_shape_counts_fallback(drift_stack):
+    """No covering bucket: the run falls back to an exact-shape compile
+    (results untouched) and counts the miss."""
+    plain = _correct(drift_stack)
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=100, transform_polish=0, plan_buckets=(32,),
+    )
+    routed = mc.correct(drift_stack)
+    np.testing.assert_allclose(
+        routed.transforms, plain.transforms, atol=1e-4
+    )
+    assert mc.backend.plan_cache_stats()["bucket_fallback"] > 0
+
+
+def test_rolling_template_parity_through_buckets():
+    """Rolling template updates (device-resident path) compose with
+    bucket routing: blends happen at the true shape, re-extraction
+    routes through the bucket."""
+    data = make_drift_stack(
+        n_frames=12, shape=(50, 70), model="translation", max_drift=5.0,
+        seed=4,
+    )
+    stack = np.asarray(data.stack, np.float32)
+    kw = dict(template_update_every=5, template_window=4)
+    plain = _correct(stack, **kw)
+    routed = _correct(stack, plan_buckets=((64, 80),), **kw)
+    np.testing.assert_allclose(
+        routed.transforms, plain.transforms, atol=1e-4
+    )
+
+
+def test_uint16_native_dtype_parity():
+    """Native-dtype (uint16) uploads through a padding bucket: the
+    zero pad is valid uint16; outputs cast identically."""
+    data = make_drift_stack(
+        n_frames=6, shape=(50, 70), model="translation", max_drift=5.0,
+        seed=5,
+    )
+    stack = np.clip(np.asarray(data.stack) * 40000, 0, 65535).astype(
+        np.uint16
+    )
+    plain = _correct(stack, output_dtype="input")
+    routed = _correct(
+        stack, plan_buckets=((64, 80),), output_dtype="input"
+    )
+    np.testing.assert_array_equal(routed.corrected, plain.corrected)
+    np.testing.assert_allclose(
+        routed.transforms, plain.transforms, atol=1e-4
+    )
+
+
+def test_polish_on_padded_route_hits_same_accuracy():
+    """With the photometric polish ON, padded-route regions are
+    measured over the bucket canvas (valid-extent-gated), so bit-parity
+    is not the contract — landing on the same accuracy plateau is:
+    both routes must beat the unpolished floor and agree closely."""
+    from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
+
+    data = make_drift_stack(
+        n_frames=8, shape=(100, 120), model="affine", max_drift=5.0, seed=1
+    )
+    stack = np.asarray(data.stack, np.float32)
+    truth = relative_transforms(data.transforms)
+    kw = dict(model="affine", max_keypoints=128, transform_polish=1)
+    plain = _correct(stack, **kw)
+    routed = _correct(stack, plan_buckets=(128,), **kw)
+    shape = (100, 120)
+    rmse_plain = transform_rmse(plain.transforms, truth, shape)
+    rmse_routed = transform_rmse(routed.transforms, truth, shape)
+    unpolished = _correct(stack, **dict(kw, transform_polish=0))
+    rmse_unpolished = transform_rmse(unpolished.transforms, truth, shape)
+    assert rmse_routed < 0.6 * rmse_unpolished  # polish still engages
+    assert abs(rmse_routed - rmse_plain) < 0.02  # same plateau
+    np.testing.assert_allclose(
+        routed.transforms, plain.transforms, atol=0.1
+    )
+
+
+def test_numpy_backend_ignores_buckets(drift_stack):
+    """The numpy oracle accepts and ignores plan_buckets (failover from
+    a bucketed jax run needs no config scrub) — results identical."""
+    plain = _correct(drift_stack, backend="numpy")
+    routed = _correct(
+        drift_stack, backend="numpy", plan_buckets=((64, 80),)
+    )
+    np.testing.assert_array_equal(routed.transforms, plain.transforms)
+    info = MotionCorrector(
+        model="translation", backend="numpy", plan_buckets=(64,)
+    ).backend.runtime_info()
+    assert info["plan_buckets_ignored"] == [[64, 64]]
+
+
+def test_mesh_bucketed_parity(drift_stack):
+    """Bucket routing composes with mesh sharding (valid_hw rides
+    replicated through shard_map; exports disabled on mesh)."""
+    plain = _correct(drift_stack)
+    routed = _correct(
+        drift_stack, plan_buckets=((64, 80),), mesh_devices=2
+    )
+    np.testing.assert_allclose(
+        routed.transforms, plain.transforms, atol=1e-4
+    )
+
+
+# -- warm-up / persistent plan cache ---------------------------------------
+
+
+@pytest.fixture
+def compile_cache(tmp_path):
+    """A tmpdir persistent compile cache, force-disabled afterwards so
+    the process-global jax config never points at a deleted dir."""
+    from kcmc_tpu.plans.cache import disable_compile_cache
+
+    yield str(tmp_path / "cache")
+    disable_compile_cache()
+
+
+def test_warmup_builds_and_second_backend_hits_stamps(compile_cache):
+    common = dict(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=64, plan_buckets=(48,),
+        compile_cache_dir=compile_cache,
+    )
+    mc1 = MotionCorrector(**common)
+    w1 = mc1.warmup()
+    assert w1["programs_built"] >= 2  # reference + register (+ apply)
+    assert w1["stamp_misses"] >= 1
+    assert w1["persistent"] is True
+    # A FRESH backend (same config): every program is stamped, so the
+    # rebuild reports hits only — the cross-process warm-start contract
+    # (jit objects are new, the persistent caches are not).
+    mc2 = MotionCorrector(**common)
+    w2 = mc2.warmup()
+    assert w2["stamp_misses"] == 0
+    assert w2["stamp_hits"] == w1["stamp_hits"] + w1["stamp_misses"]
+    # stamps live under the cache dir
+    import os
+
+    assert os.path.isdir(os.path.join(compile_cache, "kcmc_plans"))
+
+
+def test_export_bridge_serves_warm_batches(compile_cache, drift_stack):
+    """A warm-start backend (fresh jit objects, populated caches)
+    serves its batches through the deserialized exported program (the
+    jit swap engages later, after a few steady calls) — multi-batch
+    results match the plain path bitwise-ish throughout."""
+    common = dict(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=100, transform_polish=0,
+        plan_buckets=((64, 80),), compile_cache_dir=compile_cache,
+    )
+    import glob
+    import os
+    import time
+
+    mc_cold = MotionCorrector(**common)
+    cold = mc_cold.correct(drift_stack)  # builds + exports (background)
+    # The export threads run in the background — wait for the blobs
+    # (reference + register) so the warm run deterministically takes
+    # the bridge path instead of racing to a plain rebuild.
+    deadline = time.monotonic() + 120
+    exports = os.path.join(compile_cache, "kcmc_exports", "*.bin")
+    while len(glob.glob(exports)) < 2 and time.monotonic() < deadline:
+        time.sleep(0.2)
+    assert len(glob.glob(exports)) >= 2, "export blobs never landed"
+    # Fresh backend = a new process's state as far as jit caches go;
+    # the exported blobs + stamps persist.
+    mc_warm = MotionCorrector(**common)
+    warm = mc_warm.correct(drift_stack)  # 3 batches: bridge then swap
+    np.testing.assert_allclose(
+        warm.transforms, cold.transforms, atol=1e-5
+    )
+    np.testing.assert_allclose(warm.corrected, cold.corrected, atol=1e-4)
+    pc = warm.timing["plan_cache"]
+    assert pc["stamp_misses"] == 0 and pc["stamp_hits"] >= 2
+
+
+def test_warmup_requires_buckets():
+    mc = MotionCorrector(model="translation", backend="jax")
+    with pytest.raises(ValueError, match="bucket"):
+        mc.warmup()
+
+
+def test_warmed_correct_runs_and_reports(compile_cache, drift_stack):
+    """After warmup, a correction at an odd covered shape dispatches
+    with zero stamp misses and reports plan stats in timing."""
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=100, transform_polish=0,
+        plan_buckets=((64, 80),), compile_cache_dir=compile_cache,
+    )
+    mc.warmup()
+    res = mc.correct(drift_stack)
+    pc = res.timing["plan_cache"]
+    assert pc["enabled"] and pc["persistent"]
+    assert pc["bucket_padded"] > 0
+    assert pc["stamp_misses"] >= 1  # this process built them fresh
+    plain = _correct(drift_stack)
+    np.testing.assert_allclose(
+        res.transforms, plain.transforms, atol=1e-4
+    )
+
+
+def test_trace_carries_plan_spans(tmp_path, drift_stack):
+    """A traced run records jit_compile spans (cat="plan") and the
+    plan_cache snapshot rides in the trace metadata timing."""
+    trace = tmp_path / "t.json"
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=96,  # fresh K: forces a compile inside the traced run
+        transform_polish=0, plan_buckets=((64, 80),),
+        trace_path=str(trace),
+    )
+    mc.correct(drift_stack)
+    data = json.loads(trace.read_text())
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "jit_compile" in names
+    assert data["metadata"]["timing"]["plan_cache"]["programs_compiled"] > 0
+
+
+def test_report_renders_plan_section(tmp_path, drift_stack, capsys):
+    """`kcmc_tpu report` on a --transforms npz of a plans run shows the
+    warm-up / compile-cache section."""
+    from kcmc_tpu.__main__ import main
+
+    stack_path = tmp_path / "stack.tif"
+    from kcmc_tpu.io.tiff import write_stack
+
+    write_stack(
+        str(stack_path),
+        np.clip(drift_stack * 40000, 0, 65535).astype(np.uint16),
+    )
+    npz = tmp_path / "reg.npz"
+    rc = main([
+        "correct", str(stack_path), "--transforms", str(npz),
+        "--batch-size", "4", "--max-keypoints", "100",
+        "--transform-polish", "0", "--buckets", "64x80",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["plan_cache"]["bucket_padded"] > 0
+    rc = main(["report", str(npz)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Warm-up / compile cache" in out
+
+
+def test_warmup_cli(tmp_path, capsys):
+    from kcmc_tpu.__main__ import main
+    from kcmc_tpu.plans.cache import disable_compile_cache
+
+    try:
+        rc = main([
+            "warmup", "--buckets", "48", "--batch-size", "4",
+            "--max-keypoints", "64",
+            "--compile-cache", str(tmp_path / "cache"),
+        ])
+        assert rc == 0
+        stats = json.loads(capsys.readouterr().out.strip())
+        assert stats["programs_built"] >= 2
+        assert stats["persistent"] is True
+        assert stats["buckets"] == [[48, 48]]
+    finally:
+        disable_compile_cache()
+
+
+def test_serve_stats_carry_plan_cache():
+    from kcmc_tpu.serve.scheduler import StreamScheduler
+
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=64, plan_buckets=(48,),
+    )
+    sched = StreamScheduler(mc)
+    stats = sched.stats()  # works unstarted: pure snapshot
+    assert stats["plan_cache"]["enabled"] is True
+    assert stats["plan_cache"]["buckets"] == [[48, 48]]
+
+
+def test_compile_cache_dir_is_resume_signature_neutral():
+    from kcmc_tpu.corrector import _ROBUSTNESS_SIG_NEUTRAL
+
+    assert "compile_cache_dir" in _ROBUSTNESS_SIG_NEUTRAL
+    assert "plan_buckets" not in _ROBUSTNESS_SIG_NEUTRAL
