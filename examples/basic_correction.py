@@ -3,8 +3,6 @@
 Run: python examples/basic_correction.py
 """
 
-import numpy as np
-
 from kcmc_tpu import MotionCorrector
 from kcmc_tpu.utils.metrics import relative_transforms, transform_rmse
 from kcmc_tpu.utils.synthetic import make_drift_stack

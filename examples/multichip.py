@@ -12,7 +12,6 @@ Multi-host (one process per host, e.g. a TPU pod):
 """
 
 import jax
-import numpy as np
 
 from kcmc_tpu import MotionCorrector
 from kcmc_tpu.parallel import make_mesh  # , initialize_multihost

@@ -471,6 +471,25 @@ def _cmd_warmup(args) -> int:
     return 0
 
 
+def _cmd_check(args) -> int:
+    """Repo invariant checker (docs/ANALYSIS.md): AST passes over the
+    package enforcing the config-signature registry, jit purity,
+    lock/thread discipline, and the telemetry span registry, gated on
+    a checked-in baseline. Exit 0 = no new findings."""
+    from kcmc_tpu.analysis.cli import main as check_main
+
+    argv = []
+    if args.root:
+        argv += ["--root", args.root]
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    if args.json:
+        argv.append("--json")
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    return check_main(argv)
+
+
 def _cmd_report(args) -> int:
     """Render a human-readable run report from either run artifact:
     a --frame-records JSONL or a `correct --transforms` npz."""
@@ -793,6 +812,34 @@ def main(argv=None) -> int:
     )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_warmup)
+
+    p = sub.add_parser(
+        "check",
+        help="static repo invariant checker: config-signature "
+        "registry, jit purity, lock/thread discipline, span registry "
+        "— exit 0 unless a NEW (non-baselined) finding appears "
+        "(docs/ANALYSIS.md)",
+    )
+    p.add_argument(
+        "--root", default="",
+        help="repo root holding kcmc_tpu/ (default: auto-detected)",
+    )
+    p.add_argument(
+        "--baseline", default="", metavar="PATH",
+        help="baseline of accepted findings (default: the checked-in "
+        "kcmc_tpu/analysis/baseline.json)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable findings report (kind: kcmc_check); "
+        "render with `kcmc_tpu report`",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="rewrite the baseline from current findings (new entries "
+        "get FILL-ME-IN reasons; justify each before committing)",
+    )
+    p.set_defaults(fn=_cmd_check)
 
     p = sub.add_parser(
         "report",

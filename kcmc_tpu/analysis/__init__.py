@@ -1,0 +1,41 @@
+"""AST-based invariant checking for the kcmc_tpu repo itself
+(`kcmc check`; docs/ANALYSIS.md).
+
+Four repo-specific passes over a shared module index enforce the
+contracts that previously lived only in comments:
+
+* ``config-registry`` — every `CorrectorConfig` field classified as
+  resume-signature neutral or affecting, validated and documented;
+* ``jit-purity`` — no host sync / side effects / nondeterminism
+  reachable inside jitted programs;
+* ``lock-discipline`` — lock-order cycles, unlocked cross-thread
+  writes, and the "XLA work only on non-daemon threads" rule;
+* ``span-registry`` — every trace-span and `timing` key literal drawn
+  from the canonical `obs/registry.py` vocabulary.
+
+Stdlib-only on purpose: the checker runs before (and without) jax.
+"""
+
+from kcmc_tpu.analysis.cli import default_passes, main, run_check
+from kcmc_tpu.analysis.core import (
+    Baseline,
+    BaselineEntry,
+    CheckResult,
+    Finding,
+    Module,
+    ModuleIndex,
+    run_passes,
+)
+
+__all__ = [
+    "Baseline",
+    "BaselineEntry",
+    "CheckResult",
+    "Finding",
+    "Module",
+    "ModuleIndex",
+    "default_passes",
+    "main",
+    "run_check",
+    "run_passes",
+]

@@ -1,0 +1,185 @@
+"""`kcmc check`: run the repo's invariant passes and gate on the
+baseline (docs/ANALYSIS.md).
+
+Exit codes: 0 = no new error-severity findings (warnings and baselined
+findings never block); 1 = new errors (or unjustified baseline
+entries); 2 = usage problems (missing baseline file, bad root).
+
+The default baseline ships inside the package
+(`kcmc_tpu/analysis/baseline.json`), so `kcmc check` works from any
+checkout without flags; `--write-baseline` rewrites it from the
+current findings with placeholder reasons for NEW entries — fill the
+reasons in before committing (an empty reason is itself a finding).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def default_passes():
+    from kcmc_tpu.analysis.config_registry import ConfigRegistryPass
+    from kcmc_tpu.analysis.jit_purity import JitPurityPass
+    from kcmc_tpu.analysis.lock_discipline import LockDisciplinePass
+    from kcmc_tpu.analysis.span_registry import SpanRegistryPass
+
+    return [
+        ConfigRegistryPass(),
+        JitPurityPass(),
+        LockDisciplinePass(),
+        SpanRegistryPass(),
+    ]
+
+
+def find_repo_root(start: str | None = None) -> str:
+    """The directory holding the `kcmc_tpu/` package: walk up from
+    this file (source checkouts), falling back to cwd."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    cand = os.path.dirname(os.path.dirname(here))  # …/kcmc_tpu/analysis
+    if os.path.isdir(os.path.join(cand, "kcmc_tpu")):
+        return cand
+    cwd = os.path.abspath(start or os.getcwd())
+    if os.path.isdir(os.path.join(cwd, "kcmc_tpu")):
+        return cwd
+    return cand
+
+
+def default_baseline_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+    )
+
+
+def run_check(
+    root: str,
+    baseline_path: str | None = None,
+    passes=None,
+):
+    from kcmc_tpu.analysis.core import Baseline, ModuleIndex, run_passes
+
+    index = ModuleIndex.from_package(root)
+    bl_path = baseline_path or default_baseline_path()
+    baseline = Baseline.load(bl_path) if os.path.exists(bl_path) else None
+    return run_passes(
+        index, passes if passes is not None else default_passes(), baseline
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kcmc check",
+        description=(
+            "AST-based repo invariant checker: config-signature "
+            "registry, jit purity, lock/thread discipline, span "
+            "registry (docs/ANALYSIS.md)"
+        ),
+    )
+    ap.add_argument(
+        "--root",
+        default=None,
+        help="repo root holding kcmc_tpu/ (default: auto-detected)",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        metavar="PATH",
+        help=(
+            "baseline file of accepted findings (default: the "
+            "checked-in kcmc_tpu/analysis/baseline.json)"
+        ),
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable report on stdout (kind: kcmc_check)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help=(
+            "rewrite the baseline from the current findings (new "
+            "entries get a FILL-ME-IN reason; commit only after "
+            "justifying each)"
+        ),
+    )
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else find_repo_root()
+    if not os.path.isdir(os.path.join(root, "kcmc_tpu")):
+        print(
+            f"kcmc check: no kcmc_tpu/ package under {root!r}",
+            file=sys.stderr,
+        )
+        return 2
+    bl_path = args.baseline or default_baseline_path()
+    if args.baseline and not os.path.exists(bl_path):
+        print(
+            f"kcmc check: baseline {bl_path!r} does not exist",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        result = run_check(root, baseline_path=bl_path)
+    except (ValueError, KeyError, OSError) as e:
+        # a hand-edited baseline with bad JSON / wrong kind / missing
+        # entry fields is a usage error (exit 2), not "new findings"
+        print(
+            f"kcmc check: cannot load baseline {bl_path!r}: {e}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.write_baseline:
+        from kcmc_tpu.analysis.core import Baseline, BaselineEntry
+
+        old = (
+            Baseline.load(bl_path) if os.path.exists(bl_path) else Baseline()
+        )
+        # re-match against the current findings so still-firing entries
+        # survive and stale ones drop out
+        old.split(result.findings)
+        entries = [e for e in old.entries if e.used]
+        known = {(e.rule, e.path, e.match) for e in entries}
+        for f in result.new:
+            key = (f.rule, f.path, f.message)
+            if key not in known:
+                known.add(key)
+                entries.append(
+                    BaselineEntry(
+                        rule=f.rule,
+                        path=f.path,
+                        match=f.message,
+                        reason="FILL-ME-IN: justify or fix",
+                    )
+                )
+        Baseline(entries).save(bl_path)
+        print(
+            f"kcmc check: wrote {len(entries)} baseline entries to "
+            f"{bl_path}",
+            file=sys.stderr,
+        )
+
+    if args.json:
+        print(json.dumps(result.as_dict()))
+    else:
+        for f in result.new:
+            print(f.format())
+        for f in result.baseline_problems:
+            print(f.format())
+        s = result.summary()
+        print(
+            f"kcmc check: {s['findings']} findings "
+            f"({s['baselined']} baselined, {s['new']} new, "
+            f"{s['new_errors']} new errors, "
+            f"{s['stale_baseline']} stale baseline) -> "
+            f"{'OK' if s['ok'] else 'FAIL'}"
+        )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+"""Pass 2 — jit-boundary purity (`jit-purity`).
+
+The zero-stall pipeline only works because every jitted program is pure
+device compute: one hidden `np.asarray`/`.block_until_ready()` inside a
+traced function serializes the dispatch window back to synchronous
+round trips (or worse, traces a host value into the compiled program
+as a constant), and unseeded host randomness or wall-clock reads make
+retraces non-reproducible. Those hazards are invisible in review once
+they hide two calls deep.
+
+From every `jax.jit` / `pjit` / `shard_map` site in the configured
+modules this pass walks the *locally reachable* call graph — callees
+defined in the same module, resolved by bare name, plus `self.`
+methods — and flags, inside traced code:
+
+* host materialization: `np.asarray` / `np.array` / `.item()` /
+  `.tolist()` / `.block_until_ready()` / `jax.device_get`;
+* host scalarization: `float()` / `int()` / `bool()` on a non-constant
+  argument (forces a device sync when the value is traced);
+* side effects: `print`, `open`;
+* nondeterminism: `time.*`, `datetime.*` ("Date"-like reads),
+  `random.*` / `np.random.*` (unseeded host randomness — `jax.random`
+  with an explicit key threads through the trace and is fine).
+
+Cross-module callees are deliberately out of scope: the pass enforces
+what a reader of the jitted file can verify locally; ops-module purity
+is the parity suite's job.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kcmc_tpu.analysis.core import (
+    Finding,
+    FunctionTable,
+    Module,
+    ModuleIndex,
+    attr_chain,
+    enclosing_class,
+    reachable_functions,
+)
+
+# Entry points that begin a traced region. Matched against the LAST
+# dotted component of the call, so `jax.jit`, `functools.partial(
+# jax.jit, ...)`, bare `jit` (`from jax import jit`), `pjit`, and the
+# sharded.py `shard_map` shim all resolve. A non-jax `.jit` matching
+# too is the right failure mode: a visible (baselineable) finding
+# beats a silent false negative.
+JIT_ENTRY_NAMES = frozenset({"jit", "pjit", "shard_map"})
+
+
+def _is_jit_entry(chain: str) -> bool:
+    return chain.rsplit(".", 1)[-1] in JIT_ENTRY_NAMES
+
+# (dotted-suffix, severity, why) — matched against call names inside
+# traced code.
+HAZARD_CALLS = (
+    ("np.asarray", "error", "host materialization of a traced value"),
+    ("np.array", "error", "host materialization of a traced value"),
+    ("numpy.asarray", "error", "host materialization of a traced value"),
+    ("numpy.array", "error", "host materialization of a traced value"),
+    ("jax.device_get", "error", "host transfer inside traced code"),
+    ("print", "warning", "side effect inside traced code"),
+    ("open", "error", "file IO inside traced code"),
+)
+HAZARD_METHOD_CALLS = (
+    (".block_until_ready", "error", "device sync inside traced code"),
+    (".item", "error", "host scalarization of a traced value"),
+    (".tolist", "error", "host materialization of a traced value"),
+)
+HAZARD_PREFIXES = (
+    ("time.", "error", "wall-clock nondeterminism inside traced code"),
+    ("datetime.", "error", "Date-like nondeterminism inside traced code"),
+    ("random.", "error", "unseeded host randomness inside traced code"),
+    ("np.random.", "error", "unseeded host randomness inside traced code"),
+    (
+        "numpy.random.",
+        "error",
+        "unseeded host randomness inside traced code",
+    ),
+)
+SCALARIZERS = ("float", "int", "bool")
+
+
+def _jit_roots(
+    mod: Module, table: FunctionTable
+) -> list[tuple[ast.FunctionDef, str, int]]:
+    """(traced function, how it was entered, jit-site line)."""
+    roots: list[tuple[ast.FunctionDef, str, int]] = []
+    seen: set[int] = set()
+
+    def add(fn: ast.FunctionDef | None, how: str, line: int) -> None:
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            roots.append((fn, how, line))
+
+    # Decorated defs: @jax.jit / @functools.partial(jax.jit, ...).
+    for fns in table.functions.values():
+        for fn in fns:
+            for dec in fn.decorator_list:
+                chain = attr_chain(
+                    dec.func if isinstance(dec, ast.Call) else dec
+                )
+                inner = ""
+                if (
+                    isinstance(dec, ast.Call)
+                    and chain.endswith("partial")
+                    and dec.args
+                ):
+                    inner = attr_chain(dec.args[0])
+                if _is_jit_entry(chain) or (
+                    inner and _is_jit_entry(inner)
+                ):
+                    add(fn, f"@{chain}", dec.lineno)
+
+    # Call sites: jax.jit(fn) / shard_map(fn, ...) with a locally
+    # resolvable function argument (Name, or lambda traced inline).
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not _is_jit_entry(chain):
+            continue
+        arg = node.args[0] if node.args else None
+        if isinstance(arg, ast.Name):
+            cands = table.functions.get(arg.id)
+            add(cands[0] if cands else None, chain, node.lineno)
+        elif isinstance(arg, ast.Lambda):
+            # wrap the lambda body so the walker has a FunctionDef-like
+            # node; ast.Lambda shares .body traversal via ast.walk
+            fn = ast.FunctionDef(
+                name="<lambda>",
+                args=arg.args,
+                body=[ast.Expr(value=arg.body)],
+                decorator_list=[],
+                lineno=arg.lineno,
+                col_offset=arg.col_offset,
+            )
+            add(fn, chain, node.lineno)
+    return roots
+
+
+def _is_const(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) or (
+        isinstance(node, ast.UnaryOp)
+        and isinstance(node.operand, ast.Constant)
+    )
+
+
+class JitPurityPass:
+    name = "jit-purity"
+
+    def __init__(
+        self,
+        module_prefixes: tuple[str, ...] = (
+            "kcmc_tpu/backends/jax_backend.py",
+            "kcmc_tpu/plans/",
+            "kcmc_tpu/parallel/",
+        ),
+    ):
+        self.module_prefixes = module_prefixes
+
+    def _modules(self, index: ModuleIndex) -> list[Module]:
+        out = []
+        for mod in index:
+            if any(mod.path.startswith(p) for p in self.module_prefixes):
+                out.append(mod)
+        return out
+
+    def run(self, index: ModuleIndex) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in self._modules(index):
+            table = FunctionTable(mod.tree)
+            for root, how, site_line in _jit_roots(mod, table):
+                cls = enclosing_class(mod.tree, root)
+                for fn in reachable_functions(table, root, cls):
+                    out.extend(
+                        self._scan_traced(mod, fn, root.name, how)
+                    )
+        # de-dup: one finding per (message, line) — overlapping call
+        # graphs from several jit roots reach the same helper
+        uniq: dict[tuple, Finding] = {}
+        for f in out:
+            uniq.setdefault((f.path, f.line, f.message), f)
+        return list(uniq.values())
+
+    def _scan_traced(
+        self, mod: Module, fn: ast.FunctionDef, root_name: str, how: str
+    ) -> list[Finding]:
+        out = []
+
+        def emit(line, sev, what, why):
+            out.append(
+                Finding(
+                    rule=self.name,
+                    path=mod.path,
+                    line=line,
+                    severity=sev,
+                    message=(
+                        f"{what} inside jit-traced '{root_name}' "
+                        f"(via {fn.name})"
+                    ),
+                    detail=f"{why}; traced through {how}",
+                )
+            )
+
+        # Don't descend into nested defs here — they are separate
+        # entries of the reachable set only if actually CALLED.
+        nested: set[int] = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            ):
+                nested.update(id(sub) for sub in ast.walk(n))
+
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            for suf, sev, why in HAZARD_CALLS:
+                if chain == suf or chain.endswith("." + suf):
+                    emit(node.lineno, sev, f"call to {suf}", why)
+            for suf, sev, why in HAZARD_METHOD_CALLS:
+                if chain.endswith(suf):
+                    emit(node.lineno, sev, f"call to *{suf}()", why)
+            for pref, sev, why in HAZARD_PREFIXES:
+                if chain.startswith(pref):
+                    emit(node.lineno, sev, f"call to {chain}", why)
+            if (
+                chain in SCALARIZERS
+                and node.args
+                and not _is_const(node.args[0])
+            ):
+                emit(
+                    node.lineno,
+                    "warning",
+                    f"{chain}() on a non-constant expression",
+                    "host scalarization syncs if the value is traced",
+                )
+        return out

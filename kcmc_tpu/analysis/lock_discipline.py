@@ -1,0 +1,535 @@
+"""Pass 3 — lock/thread discipline (`lock-order`, `shared-write`,
+`daemon-xla`).
+
+The streaming pipeline, the async writer, the serving scheduler, and
+the plan runtime together hold ~34 `threading` sites whose contracts
+live in comments. Three of them are machine-checkable:
+
+* **lock-order** — per class, build the lock-acquisition graph: an
+  edge a→b when a `with self._b:` executes (directly, or via a
+  `self.m()` call) inside a `with self._a:` body. A cycle is a
+  deadlock waiting for the right interleaving. `threading.Condition(
+  self._lock)` aliases to the underlying lock (waiting on `_wake`
+  IS holding `_lock`), and self-edges are ignored (RLock reentrancy
+  is this repo's documented pattern).
+
+* **shared-write** — an attribute assigned both from a thread-entry
+  function (a `threading.Thread(target=...)` body or anything it
+  reaches) and from consumer-side methods, where at least one write
+  takes no declared lock, is a data race candidate. `__init__` writes
+  are construction-time and exempt.
+
+* **daemon-xla** — the PR-7 rule, learned the hard way: a daemon
+  thread killed mid-XLA-compile aborts interpreter teardown, so
+  threads whose targets reach jax compile/export/dispatch must be
+  non-daemon (and joined on stop). The `serve/scheduler.py`
+  degraded-budget warm-up threads were the motivating catch.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kcmc_tpu.analysis.core import (
+    Finding,
+    FunctionTable,
+    Module,
+    ModuleIndex,
+    attr_chain,
+)
+
+LOCK_CTORS = ("threading.Lock", "threading.RLock")
+CONDITION_CTOR = "threading.Condition"
+THREAD_CTOR = "threading.Thread"
+
+# Call names (bare or trailing attribute) that indicate the callee
+# performs jax compile/export/dispatch work. Deliberately generous:
+# reaching ANY of these from a daemon thread is worth a look.
+XLA_REACHING_NAMES = frozenset(
+    {
+        "get_backend",
+        "JaxBackend",
+        "export_and_prime",
+        "load_exported",
+        "process_batch",
+        "prepare_reference",
+        "update_reference",
+        "apply_transforms",
+        "warmup",
+        "block_until_ready",
+        "device_put",
+        "jit",
+    }
+)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for a `self._x` attribute node, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _ClassModel:
+    """Locks, lock aliases, with-nesting, writes, and threads of one
+    class."""
+
+    def __init__(self, mod: Module, cls: ast.ClassDef, table: FunctionTable):
+        self.mod = mod
+        self.cls = cls
+        self.methods = table.methods.get(cls.name, {})
+        self.locks: dict[str, int] = {}  # attr -> def line
+        self.alias: dict[str, str] = {}  # condition attr -> lock attr
+        self._find_locks()
+        # method -> ordered list of (lock attr, line, body node)
+        self.acquires: dict[str, list[tuple[str, ast.With, str]]] = {
+            m: self._withs(fn) for m, fn in self.methods.items()
+        }
+        self.lock_closure: dict[str, set[str]] = {}
+        for m in self.methods:
+            self.lock_closure[m] = self._closure(m, set())
+
+    def _find_locks(self) -> None:
+        for fn in self.methods.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                chain = (
+                    attr_chain(node.value.func)
+                    if isinstance(node.value, ast.Call)
+                    else ""
+                )
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if chain in LOCK_CTORS:
+                        self.locks[attr] = node.lineno
+                    elif chain == CONDITION_CTOR:
+                        call = node.value
+                        inner = (
+                            _self_attr(call.args[0]) if call.args else None
+                        )
+                        if inner is not None:
+                            self.alias[attr] = inner
+                        else:
+                            # Condition() owns a fresh lock — treat the
+                            # condition attr itself as a lock.
+                            self.locks[attr] = node.lineno
+
+    def canon(self, attr: str) -> str:
+        seen = set()
+        while attr in self.alias and attr not in seen:
+            seen.add(attr)
+            attr = self.alias[attr]
+        return attr
+
+    def is_lock(self, attr: str | None) -> bool:
+        if attr is None:
+            return False
+        c = self.canon(attr)
+        return c in self.locks or attr in self.alias
+
+    def _withs(self, fn: ast.FunctionDef) -> list:
+        """All `with self._lock:` acquisitions in a method."""
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if self.is_lock(attr):
+                    out.append((self.canon(attr), node, fn.name))
+        return out
+
+    def _closure(self, method: str, seen: set) -> set[str]:
+        """Locks a call to `method` may acquire (transitively through
+        self-calls)."""
+        if method in seen:
+            return set()
+        seen.add(method)
+        fn = self.methods.get(method)
+        if fn is None:
+            return set()
+        locks = {a for a, _w, _m in self.acquires.get(method, [])}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee in self.methods:
+                    locks |= self._closure(callee, seen)
+        return locks
+
+    # -- lock-order edges --------------------------------------------------
+
+    def order_edges(self) -> dict[tuple[str, str], tuple[int, str]]:
+        """{(outer, inner): (line, via)} across all methods."""
+        edges: dict[tuple[str, str], tuple[int, str]] = {}
+        for m, fn in self.methods.items():
+            for outer, with_node, _m in self.acquires.get(m, []):
+                for node in ast.walk(with_node):
+                    if node is with_node:
+                        continue
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            attr = _self_attr(item.context_expr)
+                            if self.is_lock(attr):
+                                inner = self.canon(attr)
+                                if inner != outer:
+                                    edges.setdefault(
+                                        (outer, inner),
+                                        (node.lineno, m),
+                                    )
+                    elif isinstance(node, ast.Call):
+                        callee = _self_attr(node.func)
+                        if callee in self.methods:
+                            for inner in self.lock_closure.get(
+                                callee, set()
+                            ):
+                                if inner != outer:
+                                    edges.setdefault(
+                                        (outer, inner),
+                                        (
+                                            node.lineno,
+                                            f"{m} -> self.{callee}()",
+                                        ),
+                                    )
+        return edges
+
+    # -- threads -----------------------------------------------------------
+
+    def threads(self) -> list[dict]:
+        """Every `threading.Thread(...)` constructed in this class."""
+        out = []
+        for m, fn in self.methods.items():
+            for node in ast.walk(fn):
+                if (
+                    not isinstance(node, ast.Call)
+                    or attr_chain(node.func) != THREAD_CTOR
+                ):
+                    continue
+                info = {
+                    "method": m,
+                    "line": node.lineno,
+                    "daemon": False,
+                    "target": None,
+                    "name": None,
+                }
+                for kw in node.keywords:
+                    if kw.arg == "daemon" and isinstance(
+                        kw.value, ast.Constant
+                    ):
+                        info["daemon"] = bool(kw.value.value)
+                    elif kw.arg == "target":
+                        t = _self_attr(kw.value)
+                        if t is not None:
+                            info["target"] = ("self", t)
+                        elif isinstance(kw.value, ast.Name):
+                            info["target"] = ("module", kw.value.id)
+                    elif kw.arg == "name" and isinstance(
+                        kw.value, ast.Constant
+                    ):
+                        info["name"] = kw.value.value
+                out.append(info)
+        return out
+
+
+def _cycles(edges: dict[tuple[str, str], tuple[int, str]]) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    cycles, done = [], set()
+
+    def dfs(node, path, on_path):
+        if node in on_path:
+            cycles.append(path[path.index(node):] + [node])
+            return
+        if node in done:
+            return
+        on_path.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            dfs(nxt, path + [node], on_path)
+        on_path.discard(node)
+        done.add(node)
+
+    for start in sorted(graph):
+        dfs(start, [], set())
+    # de-dup rotations
+    uniq, seen = [], set()
+    for c in cycles:
+        key = frozenset(c)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(c)
+    return uniq
+
+
+def _reaches_xla(
+    table: FunctionTable,
+    cls: str | None,
+    fn: ast.FunctionDef,
+    _seen: set | None = None,
+) -> str | None:
+    """First XLA-reaching call name found in `fn`'s local closure."""
+    seen = _seen if _seen is not None else set()
+    if id(fn) in seen:
+        return None
+    seen.add(id(fn))
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        last = chain.rsplit(".", 1)[-1]
+        if last in XLA_REACHING_NAMES or chain.startswith("jax."):
+            return chain
+        target = None
+        if chain.startswith("self.") and cls is not None:
+            target = table.methods.get(cls, {}).get(last)
+        elif "." not in chain:
+            cands = table.functions.get(chain)
+            target = cands[0] if cands else None
+        if target is not None:
+            hit = _reaches_xla(table, cls, target, seen)
+            if hit is not None:
+                return hit
+    return None
+
+
+class LockDisciplinePass:
+    name = "lock-discipline"
+
+    def run(self, index: ModuleIndex) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in index:
+            table = FunctionTable(mod.tree)
+            class_nodes: set[int] = set()
+            for cls in table.classes.values():
+                class_nodes.update(id(n) for n in ast.walk(cls))
+                model = _ClassModel(mod, cls, table)
+                out.extend(self._check_order(mod, cls, model))
+                out.extend(
+                    self._check_threads(mod, cls, model, table)
+                )
+            out.extend(
+                self._check_module_threads(mod, table, class_nodes)
+            )
+        return out
+
+    def _check_module_threads(
+        self, mod, table, class_nodes: set[int]
+    ) -> list[Finding]:
+        """daemon-xla for threads constructed OUTSIDE any class (module
+        functions, scripts): target resolves by bare name only."""
+        out = []
+        for node in ast.walk(mod.tree):
+            if (
+                id(node) in class_nodes
+                or not isinstance(node, ast.Call)
+                or attr_chain(node.func) != THREAD_CTOR
+            ):
+                continue
+            daemon, target, label = False, None, None
+            for kw in node.keywords:
+                if kw.arg == "daemon" and isinstance(
+                    kw.value, ast.Constant
+                ):
+                    daemon = bool(kw.value.value)
+                elif kw.arg == "target" and isinstance(
+                    kw.value, ast.Name
+                ):
+                    target = kw.value.id
+                elif kw.arg == "name" and isinstance(
+                    kw.value, ast.Constant
+                ):
+                    label = kw.value.value
+            if not daemon or target is None:
+                continue
+            fn = (table.functions.get(target) or [None])[0]
+            if fn is None:
+                continue
+            hit = _reaches_xla(table, None, fn)
+            if hit is not None:
+                out.append(
+                    Finding(
+                        rule="daemon-xla",
+                        path=mod.path,
+                        line=node.lineno,
+                        severity="error",
+                        message=(
+                            f"daemon thread '{label or target}' "
+                            f"reaches jax compile/dispatch via {hit}"
+                        ),
+                        detail=(
+                            "a daemon thread killed mid-XLA-compile "
+                            "aborts interpreter teardown (PR-7 rule); "
+                            "make it non-daemon and join it on stop"
+                        ),
+                    )
+                )
+        return out
+
+    # -- lock-order --------------------------------------------------------
+
+    def _check_order(self, mod, cls, model) -> list[Finding]:
+        out = []
+        edges = model.order_edges()
+        for cycle in _cycles(edges):
+            pretty = " -> ".join(cycle)
+            first = min(
+                (
+                    edges[(a, b)]
+                    for a, b in zip(cycle, cycle[1:])
+                    if (a, b) in edges
+                ),
+                default=(cls.lineno, "?"),
+            )
+            out.append(
+                Finding(
+                    rule="lock-order",
+                    path=mod.path,
+                    line=first[0],
+                    severity="error",
+                    message=(
+                        f"lock acquisition cycle in {cls.name}: "
+                        f"{pretty}"
+                    ),
+                    detail=f"first edge via {first[1]}",
+                )
+            )
+        return out
+
+    # -- threads: shared writes + daemon XLA -------------------------------
+
+    def _check_threads(self, mod, cls, model, table) -> list[Finding]:
+        out = []
+        threads = model.threads()
+        if not threads:
+            return out
+
+        # worker side: thread targets plus their self-call closure
+        worker_methods: set[str] = set()
+
+        def absorb(m: str) -> None:
+            if m in worker_methods or m not in model.methods:
+                return
+            worker_methods.add(m)
+            for node in ast.walk(model.methods[m]):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee is not None:
+                        absorb(callee)
+
+        for t in threads:
+            if t["target"] and t["target"][0] == "self":
+                absorb(t["target"][1])
+
+        # daemon-xla rule
+        for t in threads:
+            if not t["daemon"] or t["target"] is None:
+                continue
+            kind, name = t["target"]
+            fn = (
+                model.methods.get(name)
+                if kind == "self"
+                else (table.functions.get(name) or [None])[0]
+            )
+            if fn is None:
+                continue
+            hit = _reaches_xla(
+                table, cls.name if kind == "self" else None, fn
+            )
+            if hit is not None:
+                label = t["name"] or name
+                out.append(
+                    Finding(
+                        rule="daemon-xla",
+                        path=mod.path,
+                        line=t["line"],
+                        severity="error",
+                        message=(
+                            f"daemon thread '{label}' reaches jax "
+                            f"compile/dispatch via {hit}"
+                        ),
+                        detail=(
+                            "a daemon thread killed mid-XLA-compile "
+                            "aborts interpreter teardown (PR-7 rule); "
+                            "make it non-daemon and join it on stop"
+                        ),
+                    )
+                )
+
+        # shared-write rule
+        out.extend(self._check_shared_writes(mod, cls, model, worker_methods))
+        return out
+
+    def _check_shared_writes(
+        self, mod, cls, model, worker_methods: set[str]
+    ) -> list[Finding]:
+        if not worker_methods:
+            return []
+        # attr -> {"worker"/"consumer" -> [(line, locked)]}
+        writes: dict[str, dict[str, list[tuple[int, bool]]]] = {}
+        for m, fn in model.methods.items():
+            if m == "__init__":
+                continue
+            side = "worker" if m in worker_methods else "consumer"
+            lock_spans = [
+                w for _a, w, _m in model.acquires.get(m, [])
+            ]
+
+            def under_lock(node) -> bool:
+                return any(
+                    any(sub is node for sub in ast.walk(w))
+                    for w in lock_spans
+                )
+
+            for node in ast.walk(fn):
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    # self._x = ... and self._x[...] = ... both count
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    attr = _self_attr(base)
+                    if attr is None or model.is_lock(attr):
+                        continue
+                    writes.setdefault(attr, {}).setdefault(
+                        side, []
+                    ).append((node.lineno, under_lock(node)))
+        out = []
+        for attr, sides in sorted(writes.items()):
+            if "worker" not in sides or "consumer" not in sides:
+                continue
+            unlocked = [
+                (line, side)
+                for side in ("worker", "consumer")
+                for line, locked in sides[side]
+                if not locked
+            ]
+            if unlocked:
+                line = min(ln for ln, _ in unlocked)
+                out.append(
+                    Finding(
+                        rule="shared-write",
+                        path=mod.path,
+                        line=line,
+                        severity="warning",
+                        message=(
+                            f"attribute 'self.{attr}' of {cls.name} is "
+                            "written from both thread-entry and "
+                            "consumer methods without a declared lock"
+                        ),
+                        detail=(
+                            "unlocked write sites: "
+                            + ", ".join(
+                                f"{side}@{ln}" for ln, side in unlocked
+                            )
+                        ),
+                    )
+                )
+        return out

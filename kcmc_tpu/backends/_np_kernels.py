@@ -17,9 +17,8 @@ import numpy as np
 
 from kcmc_tpu.ops.patterns import (
     CAND_TILE,
-    WINDOW_SIGMA,
-    MOMENTS as _MOMENTS,
     MOMENT_RADIUS as _MOMENT_RADIUS,
+    MOMENTS as _MOMENTS,
     N_BITS,
     N_ORIENT_BINS,
     N_WORDS,
@@ -27,6 +26,7 @@ from kcmc_tpu.ops.patterns import (
     PATTERN,
     PATTERN_3D,
     ROT_PATTERNS,
+    WINDOW_SIGMA,
 )
 
 # ---------------------------------------------------------------------------

@@ -448,6 +448,11 @@ class CorrectorConfig:
     max_scale_dev: float = 0.02
 
     def __post_init__(self):
+        # Totality of the resume-signature classification: every field
+        # must be declared neutral or affecting (registries below the
+        # class; `kcmc check`'s config-registry pass enforces the same
+        # statically, this guards vendored/modified configs at runtime).
+        _validate_field_classification()
         if self.blur_sigma <= 0.0:
             raise ValueError(
                 f"blur_sigma must be positive, got {self.blur_sigma}"
@@ -656,3 +661,135 @@ class CorrectorConfig:
 
     def replace(self, **kw) -> "CorrectorConfig":
         return dataclasses.replace(self, **kw)
+
+
+# -- resume-signature field classification ---------------------------------
+#
+# EVERY field above must appear in exactly one of these registries; the
+# split is machine-enforced (runtime: `_validate_field_classification`
+# from `__post_init__`; statically: `kcmc check`'s config-registry
+# pass, which also requires each field documented in docs/API.md).
+#
+# SIG_NEUTRAL_FIELDS shape failure recovery, IO scheduling, execution
+# topology, or pure observability but never the happy-path results —
+# the checkpoint resume signature pins them to their defaults, so
+# changing them between runs (adding --trace to a killed job, resuming
+# a 4-chip run on 8 chips) RESUMES instead of restarting. Everything
+# in SIG_AFFECTING_FIELDS participates in the signature: changing it
+# mid-run restarts, because it changes (or may change) what a run
+# computes. When adding a field, the deciding question is "can two
+# runs differing only in this field produce the same frames?" — if
+# yes it is neutral; when in doubt, affecting (a needless restart
+# beats a silently corrupted resume). Rationale for the subtle calls
+# (writer_depth, mesh_devices, device_templates, plan_buckets) lives
+# in corrector.py next to the signature construction.
+SIG_NEUTRAL_FIELDS = frozenset(
+    {
+        "fault_plan",
+        "retry_attempts",
+        "retry_backoff_s",
+        "retry_backoff_max_s",
+        "retry_jitter",
+        "failover_backend",
+        "degrade_mark_failed",
+        "writer_depth",
+        "mesh_devices",
+        "trace_path",
+        "frame_records_path",
+        "heartbeat_s",
+        "serve_queue_depth",
+        "serve_inflight",
+        "serve_degrade_watermark",
+        "compile_cache_dir",
+    }
+)
+
+SIG_AFFECTING_FIELDS = frozenset(
+    {
+        "model",
+        "max_keypoints",
+        "detect_threshold",
+        "nms_size",
+        "border",
+        "harris_k",
+        "harris_window_sigma",
+        "cand_tile",
+        "oriented",
+        "blur_sigma",
+        "n_octaves",
+        "octave_scale",
+        "pyramid_refine",
+        "ratio",
+        "max_hamming",
+        "mutual",
+        "match_radius",
+        "match_tile",
+        "match_slack",
+        "n_hypotheses",
+        "inlier_threshold",
+        "refine_iters",
+        "seed",
+        "patch_grid",
+        "patch_hypotheses",
+        "refine_hypotheses",
+        "patch_model",
+        "patch_prior",
+        "field_smooth_sigma",
+        "field_passes",
+        "refine_reach_scale",
+        "global_threshold",
+        "field_polish",
+        "transform_polish",
+        "polish_grid",
+        "score_cap",
+        "quality_metrics",
+        "plan_buckets",
+        "sanitize_input",
+        "batch_size",
+        "device_templates",
+        "warp",
+        "rescue_warp",
+        "max_shear_px",
+        "max_rotation_deg",
+        "rescue_warn_fraction",
+        "rescue_escalate",
+        "max_flow_px",
+        "max_projective_px",
+        "max_scale_dev",
+    }
+)
+
+_FIELDS_VALIDATED = False
+
+
+def _validate_field_classification() -> None:
+    """Raise unless the registries partition the dataclass fields.
+
+    Runs once per process (first config construction); cost after that
+    is one global read. A field added to the dataclass but to neither
+    registry fails HERE — at construction — instead of silently landing
+    on one side of the resume signature."""
+    global _FIELDS_VALIDATED
+    if _FIELDS_VALIDATED:
+        return
+    names = {f.name for f in dataclasses.fields(CorrectorConfig)}
+    unclassified = names - SIG_NEUTRAL_FIELDS - SIG_AFFECTING_FIELDS
+    if unclassified:
+        raise TypeError(
+            "CorrectorConfig fields missing from the resume-signature "
+            f"registries (config.py): {sorted(unclassified)} — add each "
+            "to SIG_NEUTRAL_FIELDS or SIG_AFFECTING_FIELDS"
+        )
+    both = SIG_NEUTRAL_FIELDS & SIG_AFFECTING_FIELDS
+    if both:
+        raise TypeError(
+            "CorrectorConfig fields classified as BOTH signature-"
+            f"neutral and signature-affecting: {sorted(both)}"
+        )
+    stale = (SIG_NEUTRAL_FIELDS | SIG_AFFECTING_FIELDS) - names
+    if stale:
+        raise TypeError(
+            "resume-signature registries list names that are not "
+            f"CorrectorConfig fields: {sorted(stale)}"
+        )
+    _FIELDS_VALIDATED = True

@@ -20,6 +20,7 @@ from typing import Any
 
 import numpy as np
 
+from kcmc_tpu import config as _config_mod
 from kcmc_tpu.backends import get_backend
 from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.obs.log import advise
@@ -29,34 +30,31 @@ from kcmc_tpu.utils.metrics import StageTimer
 # Config fields that shape failure recovery, IO scheduling, execution
 # topology, or pure observability but never the happy-path results;
 # pinned to their defaults inside the checkpoint resume signature so
-# changing them between runs doesn't invalidate a resume.
-# (`writer_depth` only reorders WHEN bytes hit disk, never which bytes
-# — checkpoints flush to the durable mark first. The obs knobs only
-# RECORD what ran — re-running a killed job with --trace added must
-# resume it, not restart it. `mesh_devices` is the mesh-shape
-# neutrality contract: a run checkpointed on 4 chips resumes on 8 —
-# the sharded program is the same algorithm with the same global-index
-# RANSAC keys, so cross-shape outputs agree to float32 registration
-# tolerance; byte-identity of a resumed output file holds on the SAME
-# mesh shape. `device_templates` is deliberately NOT neutral: the
-# device blend's reduction order differs from the host path at float32
-# precision, so flipping it mid-run must restart, not resume.)
+# changing them between runs doesn't invalidate a resume. The field
+# set is THE canonical classification in config.py
+# (`SIG_NEUTRAL_FIELDS`, validated total at config construction and by
+# `kcmc check`'s config-registry pass) — this module only pairs each
+# neutral field with its default for the signature's `replace()`.
+# Rationale for the subtle calls: `writer_depth` only reorders WHEN
+# bytes hit disk, never which bytes — checkpoints flush to the durable
+# mark first. The obs knobs only RECORD what ran — re-running a killed
+# job with --trace added must resume it, not restart it.
+# `mesh_devices` is the mesh-shape neutrality contract: a run
+# checkpointed on 4 chips resumes on 8 — the sharded program is the
+# same algorithm with the same global-index RANSAC keys, so
+# cross-shape outputs agree to float32 registration tolerance;
+# byte-identity of a resumed output file holds on the SAME mesh shape.
+# The serving QoS knobs schedule WHEN work dispatches, never what a
+# one-shot file run computes; the persistent compile cache changes
+# WHEN compiles happen, never what a run computes. `device_templates`
+# is deliberately NOT neutral: the device blend's reduction order
+# differs from the host path at float32 precision, so flipping it
+# mid-run must restart, not resume — and neither is `plan_buckets`:
+# padded-canvas polish measures over the bucket extent, so flipping
+# buckets mid-run must restart.
 _ROBUSTNESS_SIG_NEUTRAL = {
     f: CorrectorConfig.__dataclass_fields__[f].default
-    for f in (
-        "fault_plan", "retry_attempts", "retry_backoff_s",
-        "retry_backoff_max_s", "retry_jitter", "failover_backend",
-        "degrade_mark_failed", "writer_depth", "mesh_devices",
-        "trace_path", "frame_records_path", "heartbeat_s",
-        # serving QoS knobs schedule WHEN work dispatches, never what a
-        # one-shot file run computes
-        "serve_queue_depth", "serve_inflight", "serve_degrade_watermark",
-        # the persistent compile cache changes WHEN compiles happen,
-        # never what a run computes (plan_buckets is deliberately NOT
-        # here: padded-canvas polish measures over the bucket extent,
-        # so flipping buckets mid-run must restart, not resume)
-        "compile_cache_dir",
-    )
+    for f in sorted(_config_mod.SIG_NEUTRAL_FIELDS)
 }
 
 

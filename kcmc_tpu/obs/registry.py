@@ -1,0 +1,111 @@
+"""Canonical telemetry-name registry (docs/ANALYSIS.md, pass 4).
+
+THE vocabulary of the observability surface: every trace-span /
+instant / counter name the package emits, and every key of the
+`timing` payload (`StageTimer.report` plus the orchestrator's
+additions) that `obs/report.py`, `__main__.py`, and `bench.py` render.
+`kcmc check`'s span-registry pass enforces both directions — an
+emission site using an unregistered literal fails CI, and a registered
+name with no remaining emission site is flagged stale — so renaming a
+span can never silently drop a series from the report or a Perfetto
+dashboard again.
+
+Adding a name: put it in the right group below, then use the same
+literal at the emission site and (if rendered) in obs/report.py.
+Removing a producer: delete the name here in the same PR, or the
+stale-entry warning fires.
+"""
+
+from __future__ import annotations
+
+# -- trace spans (Tracer.complete / StageTimer stage+stall) ----------------
+
+# StageTimer.stage(...) intervals: the coarse where-did-time-go view.
+STAGE_SPANS = frozenset(
+    {
+        "prepare_reference",
+        "refine_template",
+        "register_batches",
+        "resume_restore",
+        "warp",
+    }
+)
+
+# StageTimer.stall(...)/add_stall(...) seams: consumer time blocked
+# inside a stage on something that should overlap.
+STALL_SPANS = frozenset(
+    {
+        "prefetch_wait",
+        "drain_sync",
+        "writer_backpressure",
+        "writer_flush",
+        "template_update",
+    }
+)
+
+# Per-batch dispatch + background-writer worker spans.
+DISPATCH_SPANS = frozenset({"dispatch_batch"})
+WRITER_SPANS = frozenset(
+    {
+        "writer.append_batch",
+        "writer.backpressure",
+        "writer.flush",
+    }
+)
+
+# Plan-runtime compile accounting (plans/runtime.py `timed`): the span
+# is `plan_build` inside an ExecutionPlan build, `jit_compile` for a
+# lazily triggered inline build.
+PLAN_SPANS = frozenset({"plan_build", "jit_compile"})
+
+# Zero-duration instants.
+INSTANT_NAMES = frozenset(
+    {
+        "checkpoint_save",
+        "checkpoint_resume",
+        "plan_cache_hit",
+        "plan_cache_miss",
+    }
+)
+
+# Counter series.
+COUNTER_NAMES = frozenset({"frames_done"})
+
+SPAN_NAMES = (
+    STAGE_SPANS
+    | STALL_SPANS
+    | DISPATCH_SPANS
+    | WRITER_SPANS
+    | PLAN_SPANS
+    | INSTANT_NAMES
+    | COUNTER_NAMES
+)
+
+# -- timing payload keys ---------------------------------------------------
+
+# Keys of `CorrectionResult.timing`: StageTimer.report's own output
+# plus what the orchestrator/plan layers attach. obs/report.py and the
+# CLI summary read EXACTLY these literals.
+TIMING_KEYS = frozenset(
+    {
+        # StageTimer.report
+        "stages_s",
+        "stage_counts",
+        "stage_mean_s",
+        "stalls_s",
+        "stall_counts",
+        "total_s",
+        "frames_per_sec",
+        # orchestrator attachments
+        "robustness",
+        "warp_escalated",
+        "pipeline",
+        "restored_frames",
+        # plans/runtime.py snapshot
+        "plan_cache",
+        # serve session result timing (serve/session.py; the transport
+        # reads n_frames back in serve/server.py close_session)
+        "n_frames",
+        "elapsed_s",
+    }
+)

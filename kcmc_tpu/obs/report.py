@@ -13,6 +13,15 @@ per-stage means — the `StageTimer` payload), frame-quality percentiles,
 the worst-N frames by consensus support, and the robustness-ladder
 summary. Pure stdlib + numpy: auditing a run must not require an
 accelerator stack.
+
+A `kcmc check --json` artifact (kind: kcmc_check) is also accepted and
+renders as the static-analysis summary line — the CI job's one-stop
+"what did this run conclude" renderer.
+
+The timing keys and span names this renderer reads are the canonical
+vocabulary of `kcmc_tpu/obs/registry.py`; `kcmc check`'s span-registry
+pass verifies every literal here against it, so a producer rename
+cannot silently drop a series from this report.
 """
 
 from __future__ import annotations
@@ -338,10 +347,55 @@ def _worst_frames(records: list[dict], top: int) -> list[dict]:
     return ranked[: max(0, int(top))]
 
 
+def _load_maybe_check(path: str) -> dict | None:
+    """The artifact if it is a `kcmc check --json` report, else None.
+
+    A check report is one JSON object with kind == "kcmc_check";
+    frame-records JSONLs (multi-line) and npz (binary) both fail the
+    single-object parse, so misdetection is structurally impossible."""
+    if path.endswith(".npz"):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            obj = json.loads(f.read(1 << 22))
+    except (OSError, UnicodeDecodeError, ValueError):
+        return None
+    if isinstance(obj, dict) and obj.get("kind") == "kcmc_check":
+        return obj
+    return None
+
+
+def render_check(obj: dict) -> str:
+    """One summary line (+ any new findings) for a check artifact."""
+    ok = bool(obj.get("ok"))
+    lines = [
+        "kcmc check: "
+        f"{obj.get('findings', 0)} findings "
+        f"({obj.get('baselined', 0)} baselined, "
+        f"{obj.get('new', 0)} new, "
+        f"{obj.get('new_errors', 0)} new errors, "
+        f"{obj.get('stale_baseline', 0)} stale baseline) -> "
+        f"{'OK' if ok else 'FAIL'}"
+    ]
+    for f in obj.get("new_findings", []):
+        lines.append(
+            f"  {f.get('path')}:{f.get('line')}: {f.get('severity')} "
+            f"[{f.get('rule')}] {f.get('message')}"
+        )
+    return "\n".join(lines)
+
+
 def main(path: str, top: int = 10, as_json: bool = False) -> int:
     import sys
     import zipfile
 
+    check = _load_maybe_check(path)
+    if check is not None:
+        if as_json:
+            print(json.dumps(check))
+        else:
+            print(render_check(check))
+        return 0
     try:
         run = load_run(path)
     except (

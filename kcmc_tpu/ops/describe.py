@@ -38,8 +38,8 @@ from jax import lax
 
 from kcmc_tpu.ops.detect import Keypoints, gaussian_blur
 from kcmc_tpu.ops.patterns import (  # shared, JAX-free constants
-    MOMENTS as _MOMENTS,
     MOMENT_RADIUS as _MOMENT_RADIUS,
+    MOMENTS as _MOMENTS,
     N_BITS,
     N_ORIENT_BINS,
     N_WORDS,

@@ -10,7 +10,6 @@ matcher/RANSAC code is shared unchanged.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -54,10 +54,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 
 _STRIP = 64  # output rows per program
 _HALO = 16  # slab margin; must be >= conv+nms+subpixel reach (10) and 8-aligned

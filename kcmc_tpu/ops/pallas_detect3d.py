@@ -42,11 +42,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from kcmc_tpu.ops.pallas_detect import _gauss_taps
+from kcmc_tpu.ops.patterns import WINDOW_SIGMA
 
 _BZ = 8  # z-block (and z-halo) size
 _BY = 8  # y-strip (and y-halo) size

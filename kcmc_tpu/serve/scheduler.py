@@ -102,6 +102,10 @@ class StreamScheduler:
         self._recent_depth = 16
         self._running = False
         self._thread: threading.Thread | None = None
+        # Non-daemon degraded-budget warm-up threads (XLA-reaching work
+        # must never run on a daemon thread — PR-7 rule); joined on
+        # stop(). See _spawn_warmup.
+        self._warm_threads: list[threading.Thread] = []
         self._heartbeat = None
         self._heartbeat_s = float(heartbeat_s)
         self._seq = 0
@@ -133,11 +137,9 @@ class StreamScheduler:
             # dependent, so those warm later, per shape, as sessions'
             # references are prepared (_warm_degraded_shape) — well
             # before overload can engage on that shape.
-            threading.Thread(
-                target=self._warm_degraded,
-                name="kcmc-serve-degraded-warm",
-                daemon=True,
-            ).start()
+            self._spawn_warmup(
+                self._warm_degraded, "kcmc-serve-degraded-warm"
+            )
         if self._heartbeat_s > 0:
             from kcmc_tpu.obs.heartbeat import Heartbeat, aggregate_sampler
 
@@ -160,6 +162,32 @@ class StreamScheduler:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        with self._lock:
+            warm, self._warm_threads = self._warm_threads, []
+        for t in warm:
+            t.join(timeout=timeout)
+
+    def _spawn_warmup(self, target, name: str, args: tuple = ()) -> None:
+        """Degraded-budget warm-up threads reach jax compile (backend
+        construction, batch-program builds), so they are NON-daemon and
+        joined on stop — a daemon thread killed mid-XLA-compile aborts
+        interpreter teardown (the PR-7 rule, enforced by `kcmc check`'s
+        daemon-xla pass). Bounded: one construction warm-up plus one
+        per distinct frame shape."""
+        t = threading.Thread(
+            target=target, name=name, args=args, daemon=False
+        )
+        with self._lock:
+            self._warm_threads = [
+                w for w in self._warm_threads if w.is_alive()
+            ]
+            self._warm_threads.append(t)
+            # start INSIDE the lock: stop() swaps the list under the
+            # same lock, so every thread it joins has been started
+            # (join on a never-started thread raises), and a racing
+            # spawn's is_alive() prune cannot drop a tracked thread
+            # between append and start
+            t.start()
 
     def __enter__(self) -> "StreamScheduler":
         return self.start()
@@ -507,12 +535,11 @@ class StreamScheduler:
                 return
             self._degraded_warm_started.add(shape)
         ref, ref_frame = sess.ref, sess.ref_frame
-        threading.Thread(
-            target=self._warm_degraded_shape,
+        self._spawn_warmup(
+            self._warm_degraded_shape,
+            "kcmc-serve-degraded-warm-shape",
             args=(shape, ref, ref_frame),
-            name="kcmc-serve-degraded-warm-shape",
-            daemon=True,
-        ).start()
+        )
 
     def _warm_degraded_shape(self, shape, ref, ref_frame) -> None:
         try:

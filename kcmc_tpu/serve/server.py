@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import json
 import socketserver
-import sys
 import threading
 
 import numpy as np

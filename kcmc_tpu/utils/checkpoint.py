@@ -14,9 +14,10 @@ import hashlib
 import json
 import os
 import tempfile
-from kcmc_tpu.obs.log import advise
 
 import numpy as np
+
+from kcmc_tpu.obs.log import advise
 
 
 def _file_digest(path: str) -> str:

@@ -1,0 +1,576 @@
+"""`kcmc check` — the AST invariant checker (kcmc_tpu/analysis).
+
+Two layers:
+
+* known-bad fixtures per pass: each rule must FIRE on a minimal
+  snippet exhibiting the violation (and stay quiet on the fixed
+  variant) — the demonstrability contract of docs/ANALYSIS.md;
+* the repo itself: a full `run_check` over the working tree must be
+  clean against the checked-in baseline (no new findings, no stale or
+  unjustified baseline entries) — the same gate CI applies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from kcmc_tpu.analysis.config_registry import ConfigRegistryPass
+from kcmc_tpu.analysis.core import (
+    Baseline,
+    BaselineEntry,
+    Finding,
+    ModuleIndex,
+    run_passes,
+)
+from kcmc_tpu.analysis.jit_purity import JitPurityPass
+from kcmc_tpu.analysis.lock_discipline import LockDisciplinePass
+from kcmc_tpu.analysis.span_registry import SpanRegistryPass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def messages_of(findings):
+    return [f.message for f in findings]
+
+
+# -- pass 1: config-registry ----------------------------------------------
+
+CONFIG_TMPL = """
+import dataclasses
+
+@dataclasses.dataclass(frozen=True)
+class CorrectorConfig:
+    model: str = "translation"
+    batch_size: int = 32
+    {extra_field}
+    def __post_init__(self):
+        {post_init}
+
+SIG_NEUTRAL_FIELDS = frozenset({{"batch_size"}})
+SIG_AFFECTING_FIELDS = frozenset({{{affecting}}})
+
+def _validate_field_classification():
+    pass
+"""
+
+
+def config_index(
+    extra_field="", post_init="_validate_field_classification()",
+    affecting='"model"', docs='`model` `batch_size` `mystery`',
+):
+    src = CONFIG_TMPL.format(
+        extra_field=extra_field, post_init=post_init, affecting=affecting
+    )
+    return ModuleIndex.from_sources(
+        {"kcmc_tpu/config.py": src}, docs={"docs/API.md": docs}
+    )
+
+
+def test_config_pass_clean_fixture():
+    findings = ConfigRegistryPass().run(config_index())
+    assert findings == []
+
+
+def test_config_pass_fires_on_unclassified_field():
+    idx = config_index(extra_field="mystery: int = 0")
+    findings = ConfigRegistryPass().run(idx)
+    assert any(
+        "'mystery' is classified in neither" in m
+        for m in messages_of(findings)
+    ), findings
+
+
+def test_config_pass_fires_on_double_classification():
+    idx = config_index(affecting='"model", "batch_size"')
+    findings = ConfigRegistryPass().run(idx)
+    assert any(
+        "BOTH signature registries" in m for m in messages_of(findings)
+    )
+
+
+def test_config_pass_fires_on_ghost_registry_entry():
+    idx = config_index(affecting='"model", "removed_field"')
+    findings = ConfigRegistryPass().run(idx)
+    assert any(
+        "lists 'removed_field'" in m for m in messages_of(findings)
+    )
+
+
+def test_config_pass_fires_on_missing_validator_call():
+    idx = config_index(post_init="pass")
+    findings = ConfigRegistryPass().run(idx)
+    assert any(
+        "_validate_field_classification" in m
+        for m in messages_of(findings)
+    )
+
+
+def test_config_pass_fires_on_undocumented_field():
+    idx = config_index(docs="`model` only")
+    findings = ConfigRegistryPass().run(idx)
+    assert any(
+        "'batch_size' is not documented" in m
+        for m in messages_of(findings)
+    )
+
+
+# -- pass 2: jit-purity ----------------------------------------------------
+
+JIT_BAD = """
+import jax
+import numpy as np
+
+def helper(x):
+    host = np.asarray(x)          # host sync inside traced code
+    print("tracing", host)
+    return x * 2
+
+@jax.jit
+def traced(x):
+    y = helper(x)
+    y.block_until_ready()
+    return float(y)
+"""
+
+JIT_CLEAN = """
+import jax
+import jax.numpy as jnp
+
+def helper(x):
+    return jnp.asarray(x) * 2
+
+@jax.jit
+def traced(x):
+    return helper(x) + 1
+
+def host_driver(x):
+    # host-side code may sync freely: not reachable from a jit root
+    import numpy as np
+    return np.asarray(traced(x))
+"""
+
+
+def test_jit_purity_fires_on_host_sync_inside_jit():
+    idx = ModuleIndex.from_sources(
+        {"kcmc_tpu/backends/jax_backend.py": JIT_BAD}
+    )
+    findings = JitPurityPass().run(idx)
+    msgs = messages_of(findings)
+    assert any("np.asarray" in m for m in msgs), findings
+    assert any("block_until_ready" in m for m in msgs)
+    assert any("print" in m for m in msgs)
+    assert any("float()" in m for m in msgs)
+
+
+def test_jit_purity_quiet_on_clean_module_and_host_code():
+    idx = ModuleIndex.from_sources(
+        {"kcmc_tpu/backends/jax_backend.py": JIT_CLEAN}
+    )
+    assert JitPurityPass().run(idx) == []
+
+
+def test_jit_purity_follows_jit_call_sites_not_just_decorators():
+    src = """
+import jax, time
+
+def impure(x):
+    return x + time.time()
+
+fn = jax.jit(impure)
+"""
+    idx = ModuleIndex.from_sources({"kcmc_tpu/plans/plan.py": src})
+    findings = JitPurityPass().run(idx)
+    assert any("time.time" in m for m in messages_of(findings))
+
+
+def test_jit_purity_ignores_modules_outside_scope():
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/reader.py": JIT_BAD})
+    assert JitPurityPass().run(idx) == []
+
+
+# -- pass 3: lock/thread discipline ---------------------------------------
+
+LOCK_CYCLE = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+LOCK_CYCLE_VIA_CALL = """
+import threading
+
+class Pool:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            self.grab_b()
+
+    def grab_b(self):
+        with self._b:
+            pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+DAEMON_XLA = """
+import threading
+
+class Warmer:
+    def start(self):
+        threading.Thread(
+            target=self._warm, name="warm", daemon=True
+        ).start()
+
+    def _warm(self):
+        from kcmc_tpu.backends import get_backend
+        get_backend("jax", None)
+"""
+
+DAEMON_OK = """
+import threading
+
+class Warmer:
+    def start(self):
+        self._t = threading.Thread(target=self._warm, daemon=False)
+        self._t.start()
+
+    def _warm(self):
+        from kcmc_tpu.backends import get_backend
+        get_backend("jax", None)
+
+    def tick(self):
+        threading.Thread(target=self._log, daemon=True).start()
+
+    def _log(self):
+        print("alive")
+"""
+
+SHARED_WRITE = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(target=self._run, daemon=False)
+
+    def _run(self):
+        self._n = self._n + 1      # worker write, no lock
+
+    def reset(self):
+        self._n = 0                # consumer write, no lock
+"""
+
+
+def test_lock_order_cycle_fires():
+    idx = ModuleIndex.from_sources({"kcmc_tpu/serve/pool.py": LOCK_CYCLE})
+    findings = LockDisciplinePass().run(idx)
+    assert any(
+        f.rule == "lock-order" and "cycle" in f.message for f in findings
+    ), findings
+
+
+def test_lock_order_cycle_through_method_call_fires():
+    idx = ModuleIndex.from_sources(
+        {"kcmc_tpu/serve/pool.py": LOCK_CYCLE_VIA_CALL}
+    )
+    findings = LockDisciplinePass().run(idx)
+    assert any(f.rule == "lock-order" for f in findings), findings
+
+
+def test_lock_order_quiet_on_consistent_nesting():
+    src = LOCK_CYCLE.replace(
+        "with self._b:\n            with self._a:",
+        "with self._a:\n            with self._b:",
+    )
+    idx = ModuleIndex.from_sources({"kcmc_tpu/serve/pool.py": src})
+    assert not [
+        f for f in LockDisciplinePass().run(idx) if f.rule == "lock-order"
+    ]
+
+
+def test_daemon_xla_thread_fires():
+    idx = ModuleIndex.from_sources({"kcmc_tpu/serve/warm.py": DAEMON_XLA})
+    findings = LockDisciplinePass().run(idx)
+    hits = [f for f in findings if f.rule == "daemon-xla"]
+    assert hits and "get_backend" in hits[0].message, findings
+
+
+def test_daemon_xla_quiet_on_non_daemon_and_non_xla_threads():
+    idx = ModuleIndex.from_sources({"kcmc_tpu/serve/warm.py": DAEMON_OK})
+    assert not [
+        f for f in LockDisciplinePass().run(idx) if f.rule == "daemon-xla"
+    ]
+
+
+def test_shared_write_without_lock_fires():
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": SHARED_WRITE})
+    findings = LockDisciplinePass().run(idx)
+    hits = [f for f in findings if f.rule == "shared-write"]
+    assert hits and "self._n" in hits[0].message, findings
+
+
+def test_shared_write_quiet_when_locked():
+    src = SHARED_WRITE.replace(
+        "self._n = self._n + 1      # worker write, no lock",
+        "with self._lock:\n            self._n = self._n + 1",
+    ).replace(
+        "self._n = 0                # consumer write, no lock",
+        "with self._lock:\n            self._n = 0",
+    )
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": src})
+    assert not [
+        f for f in LockDisciplinePass().run(idx) if f.rule == "shared-write"
+    ]
+
+
+# -- pass 4: span-registry -------------------------------------------------
+
+REGISTRY_SRC = """
+SPAN_NAMES = frozenset({"good_span", "good_stall"})
+TIMING_KEYS = frozenset({"stages_s", "total_s"})
+"""
+
+SPAN_BAD = """
+def run(tracer, timer, timing):
+    with tracer.span("rogue_span"):
+        pass
+    with timer.stall("good_stall"):
+        pass
+    timing["rogue_key"] = 1.0
+    return timing.get("stages_s")
+"""
+
+
+def span_index(producer=SPAN_BAD):
+    return ModuleIndex.from_sources(
+        {
+            "kcmc_tpu/obs/registry.py": REGISTRY_SRC,
+            "kcmc_tpu/corrector.py": producer
+            + "\nX = ('good_span',)\n",  # keep good_span non-stale
+        }
+    )
+
+
+def test_span_registry_fires_on_unregistered_span_and_key():
+    findings = SpanRegistryPass().run(span_index())
+    msgs = messages_of(findings)
+    assert any("'rogue_span'" in m for m in msgs), findings
+    assert any("'rogue_key'" in m for m in msgs)
+    # registered names at emission sites stay quiet
+    assert not any("good_stall" in m and "not in" in m for m in msgs)
+
+
+def test_span_registry_flags_stale_registry_entry():
+    idx = ModuleIndex.from_sources(
+        {
+            "kcmc_tpu/obs/registry.py": REGISTRY_SRC,
+            "kcmc_tpu/corrector.py": "def f(timer):\n"
+            "    with timer.stall('good_stall'):\n        pass\n",
+        }
+    )
+    findings = SpanRegistryPass().run(idx)
+    assert any(
+        "'good_span'" in f.message and "no emission site" in f.message
+        for f in findings
+    )
+
+
+def test_span_registry_resolves_union_registries():
+    reg = (
+        "A = frozenset({'a_span'})\n"
+        "B = frozenset({'b_span'})\n"
+        "SPAN_NAMES = A | B\n"
+        "TIMING_KEYS = frozenset()\n"
+    )
+    idx = ModuleIndex.from_sources(
+        {
+            "kcmc_tpu/obs/registry.py": reg,
+            "kcmc_tpu/x.py": "def f(t):\n"
+            "    t.instant('a_span'); t.instant('b_span')\n",
+        }
+    )
+    assert not [
+        f
+        for f in SpanRegistryPass().run(idx)
+        if "not in SPAN_NAMES" in f.message
+    ]
+
+
+# -- baseline mechanics ----------------------------------------------------
+
+
+def test_baseline_splits_and_reports_stale_and_unjustified():
+    f1 = Finding("r", "a.py", 3, "error", "msg one")
+    f2 = Finding("r", "a.py", 9, "error", "msg two")
+    bl = Baseline(
+        [
+            BaselineEntry("r", "a.py", "msg one", "justified"),
+            BaselineEntry("r", "a.py", "gone finding", "was fixed"),
+            BaselineEntry("r", "b.py", "whatever", ""),  # no reason
+        ]
+    )
+    new, accepted = bl.split([f1, f2])
+    assert [f.message for f in new] == ["msg two"]
+    assert [f.message for f in accepted] == ["msg one"]
+    problems = bl.problems()
+    assert any("no justification" in f.message for f in problems)
+    assert any("stale baseline entry" in f.message for f in problems)
+
+
+def test_baseline_keys_ignore_line_numbers():
+    f = Finding("r", "a.py", 123, "error", "stable message")
+    e = BaselineEntry("r", "a.py", "stable message", "ok")
+    assert e.matches(f)
+    f2 = Finding("r", "a.py", 456, "error", "stable message")
+    assert e.matches(f2)
+
+
+def test_run_passes_exit_semantics():
+    idx = ModuleIndex.from_sources({"kcmc_tpu/serve/warm.py": DAEMON_XLA})
+    res = run_passes(idx, [LockDisciplinePass()])
+    assert res.exit_code == 1
+    bl = Baseline(
+        [
+            BaselineEntry(
+                "daemon-xla",
+                "kcmc_tpu/serve/warm.py",
+                "daemon thread 'warm' reaches jax compile/dispatch",
+                "fixture",
+            )
+        ]
+    )
+    res2 = run_passes(idx, [LockDisciplinePass()], bl)
+    assert res2.exit_code == 0 and res2.baselined
+
+
+# -- the repo itself is clean vs the checked-in baseline -------------------
+
+
+def test_repo_is_clean_against_baseline():
+    from kcmc_tpu.analysis.cli import run_check
+
+    res = run_check(REPO_ROOT)
+    assert res.new == [], "NEW findings:\n" + "\n".join(
+        f.format() for f in res.new
+    )
+    blocking = [
+        f for f in res.baseline_problems if f.severity == "error"
+    ]
+    assert blocking == [], "\n".join(f.format() for f in blocking)
+    assert res.exit_code == 0
+    # the four passes all ran
+    assert set(res.passes) == {
+        "config-registry",
+        "jit-purity",
+        "lock-discipline",
+        "span-registry",
+    }
+
+
+def test_cli_json_roundtrip_and_report_rendering(tmp_path, capsys):
+    from kcmc_tpu.analysis.cli import main as check_main
+    from kcmc_tpu.obs.report import main as report_main
+
+    rc = check_main(["--root", REPO_ROOT, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    payload = json.loads(out)
+    assert payload["kind"] == "kcmc_check" and payload["ok"] is True
+
+    art = tmp_path / "check.json"
+    art.write_text(out)
+    rc = report_main(str(art))
+    rendered = capsys.readouterr().out
+    assert rc == 0 and rendered.startswith("kcmc check:")
+    assert "OK" in rendered
+
+
+def test_cli_fails_on_injected_bad_snippet(tmp_path, capsys):
+    """The CI negative contract: a deliberately-bad snippet anywhere in
+    the package must flip `kcmc check` to a nonzero exit."""
+    import shutil
+
+    from kcmc_tpu.analysis.cli import main as check_main
+
+    root = tmp_path / "repo"
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "kcmc_tpu"),
+        root / "kcmc_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"),
+    )
+    (root / "docs").mkdir()
+    shutil.copy(
+        os.path.join(REPO_ROOT, "docs", "API.md"), root / "docs" / "API.md"
+    )
+    assert check_main(["--root", str(root)]) == 0
+    capsys.readouterr()
+    bad = root / "kcmc_tpu" / "serve" / "scheduler.py"
+    bad.write_text(bad.read_text() + "\n\n" + DAEMON_XLA)
+    assert check_main(["--root", str(root)]) == 1
+    assert "daemon-xla" in capsys.readouterr().out
+
+
+def test_write_baseline_roundtrip(tmp_path, capsys):
+    from kcmc_tpu.analysis.cli import main as check_main
+
+    # a package with one daemon-xla finding and no baseline
+    root = tmp_path / "repo"
+    (root / "kcmc_tpu").mkdir(parents=True)
+    (root / "kcmc_tpu" / "warm.py").write_text(DAEMON_XLA)
+    bl = tmp_path / "bl.json"
+    # missing explicit baseline path -> usage error
+    assert check_main(["--root", str(root), "--baseline", str(bl)]) == 2
+    bl.write_text(
+        json.dumps({"kind": "kcmc_check_baseline", "entries": []})
+    )
+    assert check_main(["--root", str(root), "--baseline", str(bl)]) == 1
+    capsys.readouterr()
+    check_main(
+        ["--root", str(root), "--baseline", str(bl), "--write-baseline"]
+    )
+    data = json.loads(bl.read_text())
+    rules = {e["rule"] for e in data["entries"]}
+    assert "daemon-xla" in rules, data
+    # written entries carry placeholder reasons — the reviewer
+    # contract is the FILL-ME-IN marker
+    assert all("FILL-ME-IN" in e["reason"] for e in data["entries"])
+    # rewriting keeps still-firing justified entries and drops none
+    for e in data["entries"]:
+        e["reason"] = "justified for the test"
+    bl.write_text(json.dumps({"kind": "kcmc_check_baseline",
+                              "entries": data["entries"]}))
+    check_main(
+        ["--root", str(root), "--baseline", str(bl), "--write-baseline"]
+    )
+    again = json.loads(bl.read_text())
+    assert {e["match"] for e in again["entries"]} == {
+        e["match"] for e in data["entries"]
+    }
+    assert all(
+        e["reason"] == "justified for the test" for e in again["entries"]
+    )

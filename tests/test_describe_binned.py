@@ -7,11 +7,9 @@ pieces directly: frame-level moments vs the conv definition, the
 aligned-run sort, and the dynamic-block selection matmul.
 """
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from kcmc_tpu.ops.describe import (

@@ -6,9 +6,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from kcmc_tpu.ops.describe import N_WORDS, describe_keypoints
 from kcmc_tpu.ops.detect import detect_keypoints
-from kcmc_tpu.ops.describe import describe_keypoints, N_WORDS
-from kcmc_tpu.ops.match import knn_match, popcount_u32, hamming_matrix
+from kcmc_tpu.ops.match import hamming_matrix, knn_match, popcount_u32
 from kcmc_tpu.utils import synthetic
 
 

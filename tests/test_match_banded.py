@@ -8,11 +8,10 @@ every reference keypoint within R of a query is in its tile's candidate
 window.
 """
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from kcmc_tpu.ops.match import knn_match
 from kcmc_tpu.ops.match_banded import (

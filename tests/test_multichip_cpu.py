@@ -14,10 +14,9 @@ Run under `XLA_FLAGS=--xla_force_host_platform_device_count=8` (the
 repo conftest forces this; the CI `multichip` job sets it explicitly).
 """
 
+import jax
 import numpy as np
 import pytest
-
-import jax
 
 from kcmc_tpu import MotionCorrector
 from kcmc_tpu.config import CorrectorConfig

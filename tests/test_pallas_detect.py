@@ -2,11 +2,10 @@
 on CPU): dense field parity, keypoint-level parity through the shared
 selection stage, the free-ride smooth output, and ragged frame sizes."""
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from kcmc_tpu.ops.detect import (
     _maxpool_same,

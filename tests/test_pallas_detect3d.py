@@ -2,11 +2,10 @@
 (interpret mode on CPU): dense field parity and keypoint-level parity
 through the shared selection stage."""
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from kcmc_tpu.ops.detect3d import (
     _maxpool3_same,

@@ -1,11 +1,10 @@
 """Pallas patch-extraction kernel vs the XLA dynamic_slice gather
 (interpret mode on CPU), and the pallas descriptor path end to end."""
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 from jax import lax
 
 from kcmc_tpu.ops.pallas_patch import extract_patches

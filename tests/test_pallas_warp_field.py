@@ -8,11 +8,10 @@ warp uses (test_warp_field.py allows 0.2 max there; the fused kernel
 holds ~0.005) — with the warp family's bounded-kernel semantics.
 """
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from kcmc_tpu.ops.pallas_warp_field import (
     pick_strip,

@@ -51,7 +51,7 @@ def test_descriptor_bit_parity():
 
     from kcmc_tpu.backends import _np_kernels as K
     from kcmc_tpu.ops.describe import describe_keypoints
-    from kcmc_tpu.ops.detect import Keypoints, detect_keypoints
+    from kcmc_tpu.ops.detect import detect_keypoints
 
     rng = np.random.default_rng(3)
     img = synthetic.render_scene(rng, (128, 128), n_blobs=50)

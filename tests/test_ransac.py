@@ -5,10 +5,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from test_transforms import make_gt, random_points
+
 from kcmc_tpu.models import apply_transform, get_model
 from kcmc_tpu.ops.ransac import ransac_estimate
-
-from test_transforms import make_gt, random_points
 
 
 def corrupt(dst, rng, frac):

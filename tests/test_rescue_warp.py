@@ -6,9 +6,7 @@ zeros. Exercised via the Pallas translation warp's +-128 px bound
 import numpy as np
 
 from kcmc_tpu import MotionCorrector
-from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.utils import synthetic
-from kcmc_tpu.utils.metrics import relative_transforms
 
 
 def _big_shift_stack(shifts):

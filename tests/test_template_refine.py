@@ -32,7 +32,6 @@ def test_refinement_timing_stage_reported():
 
 
 def test_refinement_streaming_path(tmp_path):
-    from kcmc_tpu.io import TiffStack
     from kcmc_tpu.io.tiff import TiffWriter
 
     data = make_drift_stack(

@@ -1,10 +1,9 @@
 """Gather-free flow/homography warps vs the jnp gather implementations."""
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 from kcmc_tpu.ops.warp import warp_batch, warp_frame_flow
 from kcmc_tpu.ops.warp_field import warp_batch_flow, warp_batch_homography

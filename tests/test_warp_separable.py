@@ -1,9 +1,8 @@
 """Separable (shear/scale multi-pass) warp vs the gather warp."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
-
-import jax.numpy as jnp
 
 from kcmc_tpu import MotionCorrector
 from kcmc_tpu.ops.warp import warp_batch
