@@ -19,8 +19,6 @@ Public API mirrors the reference's plugin seam:
 
 __version__ = "0.1.0"
 
-from kcmc_tpu.models import MODELS, TransformModel, apply_transform, get_model
-
 __all__ = [
     "MODELS",
     "TransformModel",
@@ -30,8 +28,22 @@ __all__ = [
 ]
 
 
-def __getattr__(name):  # lazy: avoid importing the full pipeline for model-only use
+def __getattr__(name):
+    # Fully lazy package init (PEP 562): even the model registry pulls
+    # in jax, and the decode-pool workers (io/feeder.py) spawn fresh
+    # interpreters whose only imports are `kcmc_tpu.io` + numpy — an
+    # eager jax import here would tax every worker spawn (and every
+    # model-free CLI path) by seconds.
     try:
+        if name in (
+            "MODELS",
+            "TransformModel",
+            "apply_transform",
+            "get_model",
+        ):
+            from kcmc_tpu import models
+
+            return getattr(models, name)
         if name in (
             "MotionCorrector",
             "CorrectionResult",

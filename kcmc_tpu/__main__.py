@@ -103,6 +103,13 @@ def _parse_reference_and_overrides(args):
         overrides["fault_plan"] = args.inject_faults
     if getattr(args, "writer_depth", -1) >= 0:
         overrides["writer_depth"] = args.writer_depth
+    # --io-threads is the CLI spelling of CorrectorConfig.io_workers
+    # (decode workers / encode threads; 0 = auto) — promoted to a
+    # validated config field so serve/library callers tune ingest too.
+    if getattr(args, "io_threads", 0):
+        overrides["io_workers"] = args.io_threads
+    if getattr(args, "io_prefetch", 0):
+        overrides["io_prefetch"] = args.io_prefetch
     devices = getattr(args, "devices", None)
     if devices is not None:
         if devices == 0:
@@ -230,6 +237,10 @@ def _cmd_correct(args) -> int:
         summary["stalls_s"] = {k: round(v, 3) for k, v in stalls.items()}
     if res.timing.get("pipeline"):
         summary["pipeline"] = res.timing["pipeline"]
+    # Pooled-ingest accounting (io/feeder.py): present when the decode
+    # pool fed the run — pool flavor, width, chunk/span counts.
+    if res.timing.get("feeder"):
+        summary["feeder"] = res.timing["feeder"]
     pc = res.timing.get("plan_cache")
     if pc:
         # compact warm-up/compile accounting (full events in the trace
@@ -366,6 +377,7 @@ def _cmd_apply(args) -> int:
         output_dtype=args.output_dtype,
         n_threads=args.io_threads,
         progress=args.progress,
+        io_prefetch=args.io_prefetch,
     )
     print(json.dumps({"output": args.output, "applied": args.transforms}))
     return 0
@@ -399,6 +411,7 @@ def _cmd_stabilize(args) -> int:
         output_dtype=args.output_dtype,
         n_threads=args.io_threads,
         progress=args.progress,
+        io_prefetch=args.io_prefetch,
     )
     summary = {
         "model": args.model,
@@ -567,7 +580,18 @@ def main(argv=None) -> int:
     p.add_argument("--warp", default="", choices=["", "auto", "jnp", "pallas", "separable"])
     p.add_argument("--compression", default="none",
                    choices=["none", "deflate", "packbits"])
-    p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument(
+        "--io-threads", "--io-workers", type=int, default=0, dest="io_threads",
+        help="host-ingest decode workers / encode threads "
+        "(CorrectorConfig.io_workers; 0 = auto: one per CPU, capped at "
+        "8). GIL-bound pure-Python codec sources decode in a process "
+        "pool of this size (io/feeder.py)",
+    )
+    p.add_argument(
+        "--io-prefetch", type=int, default=0,
+        help="feeder prefetch depth in chunks (io_prefetch; 0 = auto: "
+        "derived from the dispatch window — depth x batch frames ahead)",
+    )
     p.add_argument(
         "--writer-depth", type=int, default=-1,
         help="background-writeback queue depth in batches (default 2: "
@@ -727,6 +751,12 @@ def main(argv=None) -> int:
         "server-side output files (see `correct --writer-depth`)",
     )
     p.add_argument(
+        "--io-threads", "--io-workers", type=int, default=0, dest="io_threads",
+        help="decode-worker / encode-thread budget for session-side IO "
+        "(CorrectorConfig.io_workers; sessions share one process-wide "
+        "pool — see `correct --io-threads`)",
+    )
+    p.add_argument(
         "--heartbeat", type=float, default=0, metavar="SECS",
         help="aggregate serve heartbeat: per-session frames/fps, queue "
         "depths, admission decisions, batch occupancy (0 = off)",
@@ -869,7 +899,15 @@ def main(argv=None) -> int:
     p.add_argument("--compression", default="none",
                    choices=["none", "deflate", "packbits"])
     p.add_argument("--output-dtype", default="input")
-    p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument(
+        "--io-threads", "--io-workers", type=int, default=0,
+        dest="io_threads",
+        help="decode workers / encode threads (see `correct --io-threads`)",
+    )
+    p.add_argument(
+        "--io-prefetch", type=int, default=0,
+        help="feeder prefetch depth in chunks (0 = auto)",
+    )
     p.add_argument("--progress", action="store_true")
     p.set_defaults(fn=_cmd_apply)
 
@@ -907,7 +945,15 @@ def main(argv=None) -> int:
     p.add_argument("--compression", default="none",
                    choices=["none", "deflate", "packbits"])
     p.add_argument("--output-dtype", default="input")
-    p.add_argument("--io-threads", type=int, default=0)
+    p.add_argument(
+        "--io-threads", "--io-workers", type=int, default=0,
+        dest="io_threads",
+        help="decode workers / encode threads (see `correct --io-threads`)",
+    )
+    p.add_argument(
+        "--io-prefetch", type=int, default=0,
+        help="feeder prefetch depth in chunks (0 = auto)",
+    )
     p.add_argument(
         "--inject-faults", default="", metavar="SPEC",
         help="deterministic chaos run (see `correct --inject-faults`)",

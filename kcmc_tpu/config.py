@@ -366,6 +366,25 @@ class CorrectorConfig:
     # registration tolerance across mesh shapes, byte-identical only on
     # the same shape).
     mesh_devices: int = 0
+    # Host-ingest decode parallelism for file-streaming runs (the
+    # promoted `--io-threads` CLI knob — serve/library callers tune
+    # ingest here). 0 = auto (one worker per CPU, capped at 8), 1 =
+    # single-threaded in-process decode (the pre-round-9 behavior),
+    # N >= 2 = that many decode workers. Native-decoder reads and
+    # parallel output encodes use it as their thread budget; sources
+    # whose decode is GIL-bound pure-Python codec work (deflate/LZW/
+    # packbits TIFF fallback, zlib Zarr chunks) shard chunks across a
+    # PROCESS pool of this size instead (io/feeder.py — threads cannot
+    # parallelize those codecs). IO scheduling only: results are
+    # byte-identical at any value.
+    io_workers: int = 0
+    # Feeder prefetch depth in CHUNKS for file-streaming runs. 0 = auto:
+    # derived from the dispatch window — enough chunks to keep
+    # `depth x batch_size` decoded frames ahead of the consumer (one
+    # per in-flight dispatch slot plus one draining), replacing the
+    # fixed prefetch=2 of the single-producer era. Bounds resident
+    # decoded frames at ~io_prefetch x chunk_size.
+    io_prefetch: int = 0
     # Bounded background writeback queue depth for file-streaming runs
     # (correct_file with output=): TIFF/Zarr/HDF5 encode+write runs on a
     # writer thread up to this many batches behind the consumer, so
@@ -605,6 +624,16 @@ class CorrectorConfig:
                 f"writer_depth must be >= 0 batches (0 = synchronous "
                 f"writes), got {self.writer_depth}"
             )
+        if self.io_workers < 0:
+            raise ValueError(
+                f"io_workers must be >= 0 workers (0 = auto), got "
+                f"{self.io_workers}"
+            )
+        if self.io_prefetch < 0:
+            raise ValueError(
+                f"io_prefetch must be >= 0 chunks (0 = auto: derived "
+                f"from the dispatch window), got {self.io_prefetch}"
+            )
         # Normalize the bucket ladder eagerly (ints/lists/pairs ->
         # canonical sorted tuple of (H, W) pairs) so the frozen config
         # hashes and digests on one spelling; a typo'd spec fails at
@@ -693,6 +722,8 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "failover_backend",
         "degrade_mark_failed",
         "writer_depth",
+        "io_workers",
+        "io_prefetch",
         "mesh_devices",
         "trace_path",
         "frame_records_path",

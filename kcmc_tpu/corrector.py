@@ -307,11 +307,16 @@ def apply_correction_file(
     progress: bool = False,
     reader_options: dict | None = None,
     writer_depth: int = 2,
+    io_prefetch: int = 0,
 ) -> None:
     """Streaming `apply_correction`: TIFF in, corrected TIFF out,
     constant host memory. `writer_depth` bounds the background
     writeback queue (encode+write overlaps the resample of the next
-    chunk; 0 = synchronous writes).
+    chunk; 0 = synchronous writes). `n_threads` follows
+    `CorrectorConfig.io_workers` semantics (0 = auto): native decoder
+    threads, parallel output encode, and — for GIL-bound pure-Python
+    codec sources — the sharded decode pool (io/feeder.py);
+    `io_prefetch` bounds the feeder's chunk prefetch (0 = auto).
 
     Completes the file-scale versions of the two-pass workflows:
 
@@ -327,12 +332,13 @@ def apply_correction_file(
     (see the CLI's rigid3d handling). Output dtype semantics match
     `apply_correction`; BigTIFF engages automatically past 4 GiB.
     """
-    from kcmc_tpu.io import ChunkedStackLoader, open_stack
+    from kcmc_tpu.io import ChunkedStackLoader, feeder, open_stack
     from kcmc_tpu.io.formats import make_writer
 
     if (transforms is None) == (fields is None):
         raise ValueError("pass exactly one of transforms= or fields=")
     ref = transforms if transforms is not None else fields
+    workers = feeder.resolve_workers(n_threads)
     with open_stack(
         path, n_threads=n_threads, **(reader_options or {})
     ) as ts:
@@ -352,8 +358,17 @@ def apply_correction_file(
             from kcmc_tpu.io.async_writer import AsyncBatchWriter
 
             writer = AsyncBatchWriter(writer, depth=writer_depth)
-        loader = ChunkedStackLoader(ts, chunk_size=chunk_size)
-        chunks = iter(loader)  # background-threaded decode prefetch
+        loader = ChunkedStackLoader(
+            ts,
+            chunk_size=chunk_size,
+            prefetch=feeder.derive_prefetch(
+                io_prefetch, chunk_size, chunk_size, depth=1
+            ),
+            io_workers=workers,
+            source_path=path if isinstance(path, (str, os.PathLike)) else None,
+            reader_options=reader_options,
+        )
+        chunks = iter(loader)  # pooled (or background-threaded) prefetch
         try:
             for lo, hi, chunk in chunks:
                 got = apply_correction(
@@ -1898,11 +1913,18 @@ class MotionCorrector:
         through the same prefetch / checkpoint-resume / watchdog
         machinery (io/formats.py). Output stays TIFF.
 
-        Chunks are decoded by a background prefetch thread (the native
-        threaded TIFF decoder when available) while the device registers
+        Chunks decode ahead of the device — by the native threaded
+        TIFF decoder when available, by a sharded process/thread
+        decode pool (`io/feeder.py`) when `io_workers >= 2` and the
+        source's codec is GIL-bound pure-Python, else by the legacy
+        single-producer prefetch thread — while the device registers
         the previous chunk, and — when `output` is given — corrected
         frames stream to a new TIFF incrementally, so stacks far larger
-        than host memory process at steady state. Returns the transforms
+        than host memory process at steady state. `n_threads` (0 =
+        defer to `config.io_workers`, whose 0 = auto) sets the decode/
+        encode worker budget; the feeder's chunk prefetch depth comes
+        from `config.io_prefetch` (0 = auto: dispatch-window derived).
+        Returns the transforms
         and diagnostics; `corrected` is empty when writing to `output`
         (the frames are on disk).
 
@@ -1957,7 +1979,7 @@ class MotionCorrector:
         shape; across shapes the agreement is float32-registration
         tight).
         """
-        from kcmc_tpu.io import ChunkedStackLoader, open_stack
+        from kcmc_tpu.io import ChunkedStackLoader, feeder, open_stack
 
         self._begin_robust_run()
         timer = StageTimer()
@@ -1966,6 +1988,15 @@ class MotionCorrector:
         B = cfg.batch_size
         chunk = chunk_size or max(B, 64)
         chunk = ((chunk + B - 1) // B) * B  # multiple of the batch size
+        # Feeder plan (io/feeder.py): decode worker budget (an explicit
+        # n_threads= wins over config), and a prefetch depth derived
+        # from the dispatch window — enough chunks in flight to keep
+        # depth x batch decoded frames ahead of the consumer.
+        io_workers = feeder.resolve_workers(
+            n_threads if n_threads else cfg.io_workers
+        )
+        feed_prefetch = feeder.derive_prefetch(cfg.io_prefetch, B, chunk)
+        feed_stats: dict = {}
         if checkpoint is not None and output is None:
             raise ValueError(
                 "checkpoint requires output= (corrected frames are "
@@ -1989,7 +2020,9 @@ class MotionCorrector:
             )
 
         with open_stack(
-            path, n_threads=n_threads, **(reader_options or {})
+            path,
+            n_threads=n_threads if n_threads else cfg.io_workers,
+            **(reader_options or {}),
         ) as ts:
             if telemetry is not None:
                 telemetry.set_total(len(ts))
@@ -2262,7 +2295,7 @@ class MotionCorrector:
                     # batch append: deflate pages compress in parallel
                     # through the native encoder when available,
                     # honoring the caller's IO thread budget
-                    writer.append_batch(corrected, n_threads=n_threads)
+                    writer.append_batch(corrected, n_threads=io_workers)
                 elif corrected is not None and emit_frames:
                     host["corrected"] = corrected
                 # else: window-only frames (registration-only rolling
@@ -2357,12 +2390,31 @@ class MotionCorrector:
                         for spi, (lo2, hi2, emit2) in enumerate(spans):
                             loader = ChunkedStackLoader(
                                 ts, chunk_size=chunk, start=lo2, stop=hi2,
+                                prefetch=feed_prefetch,
                                 fault_plan=self._fault_plan,
                                 retry=self._io_retry_policy,
                                 report=self._robustness,
                                 on_wait=lambda s: timer.add_stall(
                                     "prefetch_wait", s
                                 ),
+                                # sharded decode-pool ingest when the
+                                # source's codec is pool-friendly; the
+                                # pool is process-shared, so serve
+                                # sessions and repeated runs reuse one
+                                # warm worker set (io/feeder.py)
+                                io_workers=io_workers,
+                                source_path=(
+                                    path
+                                    if isinstance(path, (str, os.PathLike))
+                                    else None
+                                ),
+                                reader_options=reader_options,
+                                tracer=(
+                                    telemetry.tracer
+                                    if telemetry is not None
+                                    else None
+                                ),
+                                stats=feed_stats,
                             )
                             batch_gen = batches(loader)
                             try:
@@ -2487,6 +2539,13 @@ class MotionCorrector:
             "template_updates": n_updates,
             "device_templates": bool(dev_tmpl),
         }
+        if feed_stats.get("chunks"):
+            # pooled-ingest accounting (io/feeder.py): rendered by the
+            # CLI summary, `kcmc_tpu report`, and bench --hostfed
+            feed_stats.pop("single_core_advised", None)
+            timing["feeder"] = dict(
+                feed_stats, prefetch_chunks=feed_prefetch
+            )
         if checkpoint is not None:
             timing["restored_frames"] = restored
         transforms = merged.pop("transform", None)

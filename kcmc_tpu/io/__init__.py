@@ -1,8 +1,10 @@
 """Stack I/O: TIFF read/write (native threaded decoder), pluggable
 streaming ingest (Zarr/HDF5/npy/raw/array via one reader protocol),
-and chunked prefetch loading."""
+chunked prefetch loading, and the sharded decode-pool feeder."""
 
+from kcmc_tpu.io import feeder
 from kcmc_tpu.io.async_writer import AsyncBatchWriter
+from kcmc_tpu.io.feeder import DecodePool
 from kcmc_tpu.io.formats import (
     ArrayStack,
     HDF5Stack,
@@ -18,11 +20,13 @@ __all__ = [
     "ArrayStack",
     "AsyncBatchWriter",
     "ChunkedStackLoader",
+    "DecodePool",
     "HDF5Stack",
     "NpyStack",
     "RawStack",
     "TiffStack",
     "ZarrStack",
+    "feeder",
     "open_stack",
     "read_stack",
     "write_stack",

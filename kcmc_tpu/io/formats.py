@@ -241,6 +241,7 @@ class ZarrStack(ArrayStack):
 
     def __init__(self, path):
         path = os.fspath(path)
+        self.path = path
         try:
             import zarr  # optional
 
@@ -581,8 +582,16 @@ def open_stack(source, n_threads: int = 0, **reader_options):
     if ext in (".tif", ".tiff"):
         from kcmc_tpu.io.tiff import TiffStack
 
-        no_options("TIFF")
-        return TiffStack(path, n_threads=n_threads)
+        opts = dict(reader_options)
+        force_python = bool(opts.pop("force_python", False))
+        if opts:
+            raise ValueError(
+                f"TIFF sources take no reader_options beyond "
+                f"'force_python', got {sorted(opts)}"
+            )
+        return TiffStack(
+            path, n_threads=n_threads, force_python=force_python
+        )
     if ext == ".zarr" or os.path.isdir(path) and os.path.exists(
         os.path.join(path, ".zarray")
     ):

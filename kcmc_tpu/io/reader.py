@@ -44,6 +44,19 @@ class ChunkedStackLoader:
     on_wait: optional callback(seconds) invoked whenever the CONSUMER
     blocks waiting for the prefetch thread — the pipeline-stall
     telemetry hook (a well-fed pipeline never calls it).
+
+    io_workers / pool / source_path / reader_options: the feeder-pool
+    seam (io/feeder.py; docs/PERFORMANCE.md "Streaming pipeline
+    anatomy"). With `io_workers >= 2` and a source whose decode is
+    pool-friendly (`feeder.classify_source`), chunks are sharded into
+    per-worker page spans and decoded by a process pool (GIL-bound
+    pure-Python codecs) or a thread pool (GIL-releasing decode) with
+    ordered reassembly — pass an explicit `pool` (e.g. from
+    `feeder.shared_pool`) to reuse warm workers across loaders, and
+    `source_path`/`reader_options` when `source` is an already-open
+    reader so workers can reopen it (paths passed AS `source` respec
+    themselves). `tracer` records one `feeder.decode` span per pooled
+    chunk; `stats` (a dict) accumulates feeder counters in place.
     """
 
     def __init__(
@@ -58,11 +71,18 @@ class ChunkedStackLoader:
         retry=None,
         report=None,
         on_wait=None,
+        io_workers: int = 0,
+        pool=None,
+        source_path=None,
+        reader_options: dict | None = None,
+        tracer=None,
+        stats: dict | None = None,
     ):
         self._own = False
         if isinstance(source, (str, os.PathLike)):
             from kcmc_tpu.io.formats import open_stack
 
+            source_path = source if source_path is None else source_path
             source = open_stack(source, n_threads=n_threads)
             self._own = True
         self.source = source
@@ -75,6 +95,56 @@ class ChunkedStackLoader:
         self._retry = retry
         self._report = report
         self._on_wait = on_wait
+        self._tracer = tracer
+        self.stats = stats if stats is not None else {}
+        self._pool = None
+        self._spec = None
+        if pool is not None or io_workers >= 2:
+            from kcmc_tpu.io import feeder
+
+            kind = feeder.classify_source(self.source)
+            spec = feeder.source_spec(self.source, source_path, reader_options)
+            if kind is not None and spec is not None:
+                self._pool = (
+                    pool
+                    if pool is not None
+                    else feeder.shared_pool(kind, io_workers)
+                )
+                self._spec = spec
+                self.stats.setdefault("mode", self._pool.kind)
+                self.stats.setdefault("workers", self._pool.workers)
+            elif kind == "process":
+                # Pool requested but unusable (no reopenable path): the
+                # GIL serializes this source's pure-Python codec.
+                self._advise_single_core()
+        elif self._gil_bound():
+            # No pool requested on a GIL-bound source: the run decodes
+            # single-core (satellite of ROADMAP item 3 — make the cliff
+            # visible instead of silently eating a many-x slowdown).
+            self._advise_single_core()
+
+    def _gil_bound(self) -> bool:
+        from kcmc_tpu.io import feeder
+
+        return feeder.classify_source(self.source) == "process"
+
+    def _advise_single_core(self) -> None:
+        # once per run: segmented runs build one loader per span but
+        # share a stats dict, so the advisory does not repeat
+        if self.stats.get("single_core_advised"):
+            return
+        self.stats["single_core_advised"] = True
+        from kcmc_tpu.obs.log import advise
+
+        name = getattr(self.source, "path", type(self.source).__name__)
+        advise(
+            f"kcmc: {name}: compressed pages decode through the "
+            "pure-Python fallback codec on a single core (GIL-bound, "
+            "~233 fps for deflate); set io_workers >= 2 (CLI "
+            "--io-threads) to decode in a process pool, or install a "
+            "C++ toolchain so the native threaded decoder builds",
+            stacklevel=3,
+        )
 
     def _read_raw(self, lo: int, hi: int) -> np.ndarray:
         if hasattr(self.source, "read"):  # io.formats protocol readers
@@ -113,6 +183,24 @@ class ChunkedStackLoader:
         return self.stop - self.start
 
     def __iter__(self):
+        if self._pool is not None:
+            from kcmc_tpu.io import feeder
+
+            yield from feeder.pooled_chunks(
+                self._pool,
+                self._spec,
+                self.start,
+                self.stop,
+                self.chunk_size,
+                self.prefetch,
+                fault_plan=self._fault_plan,
+                retry=self._retry,
+                report=self._report,
+                on_wait=self._on_wait,
+                tracer=self._tracer,
+                stats=self.stats,
+            )
+            return
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop_flag = threading.Event()
 

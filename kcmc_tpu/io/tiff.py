@@ -385,12 +385,27 @@ class TiffStack:
     otherwise. Context-manager friendly.
     """
 
-    def __init__(self, path: str | os.PathLike, n_threads: int = 0):
+    def __init__(
+        self,
+        path: str | os.PathLike,
+        n_threads: int = 0,
+        force_python: bool = False,
+    ):
+        # force_python (also the KCMC_FORCE_PY_TIFF env var) pins the
+        # pure-NumPy decoder: decode-pool workers (io/feeder.py) respec
+        # python-path sources with it so no worker races to build — or
+        # silently switches to — the native library mid-run, and tests/
+        # benchmarks use it to measure the GIL-bound fallback
+        # deterministically on toolchain-equipped hosts.
         self.path = os.fspath(path)
         self.n_threads = n_threads
         self._handle = None
         self._py = None
-        lib = _get_native()
+        env = os.environ.get("KCMC_FORCE_PY_TIFF", "").strip().lower()
+        if force_python or env not in ("", "0", "false", "no"):
+            lib = None
+        else:
+            lib = _get_native()
         if lib is not None:
             handle = ctypes.c_void_p()
             info = _StackInfo()
@@ -469,6 +484,17 @@ class TiffStack:
     @property
     def backend(self) -> str:
         return "native" if self._handle is not None else "python"
+
+    @property
+    def compression(self) -> int | None:
+        """TIFF compression tag of the stack's pages (1 = none, 5 =
+        LZW, 8/32946 = deflate, 32773 = packbits), or None when only
+        the native decoder parsed the file (it does not surface the
+        tag). The feeder uses this to route GIL-bound pure-Python
+        codecs through the process pool."""
+        if self._py is not None:
+            return int(self._py.meta[3])
+        return None
 
 
 def read_stack(path: str | os.PathLike, lo: int = 0, hi: int | None = None,

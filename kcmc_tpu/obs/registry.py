@@ -58,6 +58,11 @@ WRITER_SPANS = frozenset(
 # lazily triggered inline build.
 PLAN_SPANS = frozenset({"plan_build", "jit_compile"})
 
+# Feeder (io/feeder.py): one span per pooled chunk, submit -> fully
+# decoded+reassembled (args carry lo/hi/span count). The consumer-side
+# wait on an undecoded head chunk still lands in `prefetch_wait`.
+FEEDER_SPANS = frozenset({"feeder.decode"})
+
 # Zero-duration instants.
 INSTANT_NAMES = frozenset(
     {
@@ -77,6 +82,7 @@ SPAN_NAMES = (
     | DISPATCH_SPANS
     | WRITER_SPANS
     | PLAN_SPANS
+    | FEEDER_SPANS
     | INSTANT_NAMES
     | COUNTER_NAMES
 )
@@ -103,6 +109,8 @@ TIMING_KEYS = frozenset(
         "restored_frames",
         # plans/runtime.py snapshot
         "plan_cache",
+        # pooled-ingest accounting (io/feeder.py via correct_file)
+        "feeder",
         # serve session result timing (serve/session.py; the transport
         # reads n_frames back in serve/server.py close_session)
         "n_frames",

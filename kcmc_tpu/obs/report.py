@@ -178,6 +178,22 @@ def _stage_table(timing: dict) -> list[str]:
             lines.append(
                 f"  {name:<20} {s:>10.3f} {sc.get(name, 0):>7d} {frac:>7}"
             )
+    feed = timing.get("feeder")
+    if feed:
+        # pooled-ingest accounting (io/feeder.py): which pool flavor
+        # decoded, how wide, and how much it retried
+        lines.append(
+            "Feeder (decode pool): "
+            f"mode={feed.get('mode', '?')} workers={feed.get('workers', '?')}"
+            f" prefetch={feed.get('prefetch_chunks', '?')} chunks"
+            f"  decoded {feed.get('frames', 0)} frames in"
+            f" {feed.get('chunks', 0)} chunks / {feed.get('spans', 0)} spans"
+            + (
+                f"  (io_retries {feed['io_retries']})"
+                if feed.get("io_retries")
+                else ""
+            )
+        )
     fps = timing.get("frames_per_sec")
     if fps:
         lines.append(f"Throughput: {fps:.1f} frames/sec")

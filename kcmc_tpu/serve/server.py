@@ -287,5 +287,11 @@ def serve_main(args) -> int:
     finally:
         stats = server.scheduler.stats()
         server.stop()
+        # every session shared the process-wide decode/encode pools
+        # (io/feeder.py shared_pool registry); tear them down with the
+        # serve plane so no spawn worker outlives the server
+        from kcmc_tpu.io import feeder
+
+        feeder.shutdown_shared_pools()
         print(json.dumps({"served": True, "stats": stats}), flush=True)
     return 0

@@ -321,7 +321,12 @@ class Session:
                     else inner
                 )
             if self.writer is not None:
-                self.writer.append_batch(corrected)
+                # encode-thread budget from the shared config: serve
+                # callers tune ingest/egress via io_workers without the
+                # CLI (docs/API.md "IO")
+                self.writer.append_batch(
+                    corrected, n_threads=self.mc.config.io_workers
+                )
             if self.emit_frames:
                 host["corrected"] = corrected
         with self._cond:

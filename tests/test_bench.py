@@ -153,3 +153,55 @@ def test_coldstart_judged_json_line_contract():
     assert rec["speedup"] == 13.0
     assert rec["vs_baseline"] == round(13.0 / 5.0, 3)
     assert rec["configs"]["piecewise"]["run2_stamp_misses"] == 0
+
+
+def test_bench_cli_has_hostfed_flags():
+    out = subprocess.run(
+        [sys.executable, _BENCH, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--hostfed" in out.stdout
+    assert "--io-workers" in out.stdout
+
+
+def test_hostfed_judged_json_line_contract():
+    """The --hostfed judged line: one parseable JSON line with host-fed
+    streaming fps as the value, the device-resident ratio, the
+    GIL-bound-fallback single-vs-pooled speedup, and byte identity."""
+    sys.path.insert(0, os.path.dirname(_BENCH))
+    import bench
+
+    rows = {
+        "device": {"fps": 4000.0, "rmse_px": 0.013},
+        "hostfed": {
+            "fps": 3600.0, "ingest_fps": 5200.0, "rmse_px": 0.013,
+            "stall_fractions": {"prefetch_wait": 0.02},
+            "feeder": {"mode": "process", "workers": 8},
+        },
+        "pyfallback_single": {
+            "fps": 230.0, "ingest_fps": 233.0, "rmse_px": 0.013,
+            "stall_fractions": {"prefetch_wait": 0.9}, "feeder": None,
+        },
+        "pyfallback_pooled": {
+            "fps": 1500.0, "ingest_fps": 1700.0, "rmse_px": 0.013,
+            "stall_fractions": {"prefetch_wait": 0.2},
+            "feeder": {"mode": "process", "workers": 8},
+        },
+        "byte_identical": True,
+        "speedup_vs_single": 6.522,
+        "ingest_speedup_vs_single": 7.296,
+        "pool": {"workers": 8, "mesh_devices": 0},
+    }
+    line = bench.hostfed_judged_json_line(512, rows)
+    assert "\n" not in line
+    rec = json.loads(line)
+    assert rec["metric"] == "hostfed_streaming_translation_512x512"
+    assert rec["value"] == 3600.0
+    assert rec["unit"] == "frames/sec"
+    assert rec["vs_baseline"] == round(3600.0 / 200.0, 3)
+    assert rec["hostfed_vs_device"] == 0.9
+    assert rec["speedup_vs_single"] == 6.522
+    assert rec["byte_identical"] is True
+    assert rec["configs"]["pyfallback_pooled"]["feeder"]["workers"] == 8
+    assert "byte_identical" not in rec["configs"]

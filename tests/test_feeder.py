@@ -1,0 +1,421 @@
+"""Feeder suite (io/feeder.py): decode pools, sharded ordered ingest,
+fault/crash/interrupt propagation, backpressure bounds, and the
+correct_file byte-identity contract across feeder paths."""
+
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from kcmc_tpu.io import ChunkedStackLoader, feeder
+from kcmc_tpu.io.feeder import DecodePool
+from kcmc_tpu.io.tiff import TiffStack, _PyTiffParser, write_stack
+
+
+@pytest.fixture
+def py_tiff(monkeypatch):
+    """Pin the pure-Python TIFF decoder — the GIL-bound regime the
+    process pool exists for — regardless of the host's toolchain."""
+    monkeypatch.setenv("KCMC_FORCE_PY_TIFF", "1")
+
+
+@pytest.fixture
+def deflate_stack(tmp_path):
+    rng = np.random.default_rng(0)
+    stack = (rng.random((40, 32, 48)) * 60000).astype(np.uint16)
+    p = tmp_path / "s.tif"
+    write_stack(p, stack, compression="deflate")
+    return p, stack
+
+
+# -- pure helpers -----------------------------------------------------------
+
+
+def test_resolve_workers_and_derive_prefetch():
+    assert feeder.resolve_workers(3) == 3
+    assert feeder.resolve_workers(1) == 1
+    assert feeder.resolve_workers(0) >= 1
+    # auto: depth x batch frames ahead, in chunks, plus one draining
+    assert feeder.derive_prefetch(0, 32, 64) == max(2, -(-3 * 32 // 64) + 1)
+    assert feeder.derive_prefetch(5, 32, 64) == 5
+    assert feeder.derive_prefetch(0, 64, 64, depth=1) == 2
+
+
+@pytest.mark.parametrize("n,procs", [(10, 3), (7, 8), (0, 2), (100, 1), (16, 4)])
+def test_host_local_range_partitions(n, procs):
+    ranges = [feeder.host_local_range(n, i, procs) for i in range(procs)]
+    got = []
+    for lo, hi in ranges:
+        assert 0 <= lo <= hi <= n
+        got.extend(range(lo, hi))
+    assert got == list(range(n))  # disjoint, ordered, complete
+    # ceil partition: every non-tail host carries the same load
+    sizes = [hi - lo for lo, hi in ranges if hi > lo]
+    assert all(s == sizes[0] for s in sizes[:-1])
+
+
+def test_host_local_range_validates():
+    with pytest.raises(ValueError):
+        feeder.host_local_range(10, 3, 3)
+
+
+# -- classification + spec --------------------------------------------------
+
+
+def test_classify_and_spec(py_tiff, tmp_path, deflate_stack):
+    p, _ = deflate_stack
+    with TiffStack(p) as ts:
+        assert ts.backend == "python"
+        assert feeder.classify_source(ts) == "process"
+        spec = feeder.source_spec(ts, p, None)
+        # workers must never race to build/switch to the native decoder
+        assert ("force_python", True) in spec[2]
+    raw = tmp_path / "raw.tif"
+    write_stack(raw, np.zeros((3, 8, 8), np.uint16))
+    with TiffStack(raw) as ts:
+        assert feeder.classify_source(ts) == "thread"
+    with TiffStack(raw) as ts:
+        assert feeder.source_spec(ts, None, None) is None
+
+
+def test_force_py_env_zero_means_off(monkeypatch, deflate_stack):
+    """KCMC_FORCE_PY_TIFF=0/false must NOT pin the pure-Python decoder
+    (an explicit disable in a CI matrix or shell must win)."""
+    from kcmc_tpu.io.tiff import _get_native
+
+    if _get_native() is None:
+        pytest.skip("no native toolchain")
+    p, _ = deflate_stack
+    monkeypatch.setenv("KCMC_FORCE_PY_TIFF", "0")
+    with TiffStack(p) as ts:
+        assert ts.backend == "native"
+    monkeypatch.setenv("KCMC_FORCE_PY_TIFF", "false")
+    with TiffStack(p) as ts:
+        assert ts.backend == "native"
+
+
+def test_classify_native_stays_legacy(deflate_stack):
+    from kcmc_tpu.io.tiff import _get_native
+
+    if _get_native() is None:
+        pytest.skip("no native toolchain")
+    p, _ = deflate_stack
+    with TiffStack(p) as ts:
+        assert ts.backend == "native"
+        assert feeder.classify_source(ts) is None
+
+
+# -- pooled ingest: content, ordering, bounds -------------------------------
+
+
+def test_pooled_matches_legacy(py_tiff, deflate_stack):
+    p, stack = deflate_stack
+    stats = {}
+    with ChunkedStackLoader(
+        p, chunk_size=7, io_workers=2, prefetch=2, stats=stats
+    ) as loader:
+        got = list(loader)
+    assert [(lo, hi) for lo, hi, _ in got] == [
+        (i, min(i + 7, 40)) for i in range(0, 40, 7)
+    ]
+    np.testing.assert_array_equal(
+        np.concatenate([f for _, _, f in got]), stack
+    )
+    assert stats["mode"] == "process" and stats["workers"] == 2
+    assert stats["frames"] == 40 and stats["chunks"] == 6
+    assert stats["max_inflight_chunks"] <= 2  # backpressure bound
+
+
+def test_pooled_start_stop_window(py_tiff, deflate_stack):
+    p, stack = deflate_stack
+    with ChunkedStackLoader(
+        p, chunk_size=4, start=5, stop=17, io_workers=2
+    ) as loader:
+        got = list(loader)
+    assert [(lo, hi) for lo, hi, _ in got] == [(5, 9), (9, 13), (13, 17)]
+    np.testing.assert_array_equal(
+        np.concatenate([f for _, _, f in got]), stack[5:17]
+    )
+
+
+def test_out_of_order_completion_reassembles(py_tiff, tmp_path, monkeypatch):
+    """Spans finishing in scrambled order must still yield chunks in
+    order — exercised deterministically on the thread flavor (same
+    process, so the decode fn can be patched with inverse delays)."""
+    rng = np.random.default_rng(1)
+    stack = (rng.random((24, 16, 16)) * 60000).astype(np.uint16)
+    p = tmp_path / "u.tif"
+    write_stack(p, stack)  # uncompressed python path -> "thread" kind
+
+    real = feeder._decode_span
+
+    def slow_head(spec, lo, hi):
+        time.sleep(0.15 if lo < 8 else 0.0)  # head chunks finish LAST
+        return real(spec, lo, hi)
+
+    monkeypatch.setattr(feeder, "_decode_span", slow_head)
+    pool = DecodePool(3, kind="thread")
+    try:
+        with ChunkedStackLoader(
+            p, chunk_size=4, io_workers=3, pool=pool, prefetch=6
+        ) as loader:
+            got = list(loader)
+    finally:
+        pool.shutdown()
+    assert [lo for lo, _, _ in got] == [0, 4, 8, 12, 16, 20]
+    np.testing.assert_array_equal(
+        np.concatenate([f for _, _, f in got]), stack
+    )
+
+
+# -- fault paths ------------------------------------------------------------
+
+
+def test_worker_exception_carries_original_traceback(
+    py_tiff, tmp_path, deflate_stack
+):
+    """A decode error inside a pool WORKER surfaces on the consumer as
+    the original exception type with the worker-side traceback chained
+    — not a hang, not a truncated-but-clean end of stream."""
+    p, stack = deflate_stack
+    # corrupt one mid-stack page's compressed strip in place (same
+    # length, garbage bytes) so only the worker-side decode fails
+    parser = _PyTiffParser(str(p))
+    off, cnt, _rows = parser.pages[20][0]
+    parser.close()
+    with open(p, "r+b") as f:
+        f.seek(off)
+        f.write(b"\xde\xad" * (cnt // 2 + 1))
+    with ChunkedStackLoader(p, chunk_size=8, io_workers=2) as loader:
+        with pytest.raises(zlib.error) as ei:
+            for lo, hi, frames in loader:
+                np.testing.assert_array_equal(frames, stack[lo:hi])
+    assert lo == 8  # pages before the corrupt chunk decoded fine
+    # the worker traceback rides along (concurrent.futures chains it)
+    assert "_decode_span" in "".join(str(c) for c in (ei.value.__cause__,))
+
+
+def test_worker_crash_surfaces_not_hangs(py_tiff, deflate_stack):
+    p, stack = deflate_stack
+    pool = DecodePool(2, kind="process")
+    try:
+        with ChunkedStackLoader(
+            p, chunk_size=8, io_workers=2, pool=pool, prefetch=1
+        ) as loader:
+            it = iter(loader)
+            lo, hi, frames = next(it)  # workers are live now
+            np.testing.assert_array_equal(frames, stack[lo:hi])
+            for proc in list(pool._ex._processes.values()):
+                proc.kill()
+            with pytest.raises(RuntimeError, match="worker died"):
+                for _ in it:
+                    pass
+        assert pool.broken
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_broken_shared_pool_is_replaced(py_tiff, deflate_stack):
+    p, stack = deflate_stack
+    pool = feeder.shared_pool("process", 2)
+    pool.broken = True  # as flagged after a crash
+    fresh = feeder.shared_pool("process", 2)
+    assert fresh is not pool and not fresh.broken
+    with ChunkedStackLoader(p, chunk_size=16, io_workers=2) as loader:
+        got = np.concatenate([f for _, _, f in loader])
+    np.testing.assert_array_equal(got, stack)
+
+
+def test_keyboard_interrupt_propagates(py_tiff, tmp_path, monkeypatch):
+    """The PR-2 contract: an interrupt must never be swallowed into a
+    clean-looking end of stream or a misattributed decode error."""
+    stack = np.zeros((12, 8, 8), np.uint16)
+    p = tmp_path / "k.tif"
+    write_stack(p, stack)
+
+    real = feeder._decode_span
+
+    def interrupt_late(spec, lo, hi):
+        if lo >= 8:
+            raise KeyboardInterrupt
+        return real(spec, lo, hi)
+
+    monkeypatch.setattr(feeder, "_decode_span", interrupt_late)
+    pool = DecodePool(2, kind="thread")
+    try:
+        with ChunkedStackLoader(
+            p, chunk_size=4, io_workers=2, pool=pool
+        ) as loader:
+            with pytest.raises(KeyboardInterrupt):
+                list(loader)
+    finally:
+        pool.shutdown(wait=False)
+
+
+def test_injected_transient_fault_retries(py_tiff, deflate_stack):
+    from kcmc_tpu.utils.faults import FaultPlan, RetryPolicy
+    from kcmc_tpu.utils.metrics import RobustnessReport
+
+    p, stack = deflate_stack
+    plan = FaultPlan.from_spec("io_read:step=2:transient", seed=0)
+    report = RobustnessReport()
+    with ChunkedStackLoader(
+        p,
+        chunk_size=8,
+        io_workers=2,
+        fault_plan=plan,
+        retry=RetryPolicy(
+            attempts=3, backoff_s=0.01, backoff_max_s=0.02, jitter=0.0,
+            seed=0,
+        ),
+        report=report,
+    ) as loader:
+        got = np.concatenate([f for _, _, f in loader])
+    np.testing.assert_array_equal(got, stack)
+    assert report.io_retries >= 1
+
+
+def test_injected_fatal_fault_raises(py_tiff, deflate_stack):
+    from kcmc_tpu.utils.faults import FatalFaultError, FaultPlan, RetryPolicy
+
+    p, _ = deflate_stack
+    plan = FaultPlan.from_spec("io_read:step=1:fatal", seed=0)
+    with ChunkedStackLoader(
+        p,
+        chunk_size=8,
+        io_workers=2,
+        fault_plan=plan,
+        retry=RetryPolicy(
+            attempts=3, backoff_s=0.01, backoff_max_s=0.02, jitter=0.0,
+            seed=0,
+        ),
+    ) as loader:
+        with pytest.raises(FatalFaultError):
+            list(loader)
+
+
+# -- advisory ---------------------------------------------------------------
+
+
+def test_single_core_advisory(py_tiff, deflate_stack):
+    p, _ = deflate_stack
+    with pytest.warns(RuntimeWarning, match="single core"):
+        with ChunkedStackLoader(p, chunk_size=8, io_workers=1) as loader:
+            list(loader)
+
+
+def test_no_advisory_when_pool_engaged(py_tiff, deflate_stack, recwarn):
+    p, _ = deflate_stack
+    with ChunkedStackLoader(p, chunk_size=8, io_workers=2) as loader:
+        list(loader)
+    assert not [
+        w for w in recwarn.list if "single core" in str(w.message)
+    ]
+
+
+# -- end-to-end byte identity -----------------------------------------------
+
+
+def test_correct_file_pooled_byte_identical(py_tiff, tmp_path):
+    """The acceptance contract: the pooled feeder changes WHEN pages
+    decode, never what a run computes — corrected output files are
+    byte-identical across feeder paths."""
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    d = make_drift_stack(
+        n_frames=20, shape=(40, 40), model="translation", max_drift=3.0,
+        seed=0,
+    )
+    stack = np.clip(d.stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, stack, compression="deflate")
+    mc = MotionCorrector(model="translation", backend="numpy", batch_size=8)
+    r1 = mc.correct_file(
+        src, output=str(tmp_path / "o1.tif"), n_threads=1,
+        output_dtype="input",
+    )
+    r2 = mc.correct_file(
+        src, output=str(tmp_path / "o2.tif"), n_threads=3,
+        output_dtype="input",
+    )
+    assert (tmp_path / "o1.tif").read_bytes() == (
+        tmp_path / "o2.tif"
+    ).read_bytes()
+    np.testing.assert_array_equal(r1.transforms, r2.transforms)
+    assert r1.timing.get("feeder") is None  # legacy single-producer
+    feed = r2.timing["feeder"]
+    assert feed["mode"] == "process" and feed["workers"] == 3
+    assert feed["frames"] == 20
+
+
+def test_config_io_workers_drives_the_pool(py_tiff, tmp_path):
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.utils.synthetic import make_drift_stack
+
+    d = make_drift_stack(
+        n_frames=12, shape=(32, 32), model="translation", max_drift=2.0,
+        seed=1,
+    )
+    stack = np.clip(d.stack * 40000, 0, 65535).astype(np.uint16)
+    src = tmp_path / "in.tif"
+    write_stack(src, stack, compression="deflate")
+    mc = MotionCorrector(
+        model="translation", backend="numpy", batch_size=4, io_workers=2,
+        io_prefetch=2,
+    )
+    res = mc.correct_file(src, emit_frames=False)
+    feed = res.timing["feeder"]
+    assert feed["workers"] == 2 and feed["prefetch_chunks"] == 2
+
+
+# -- config validation ------------------------------------------------------
+
+
+def test_config_fields_validated_and_neutral():
+    from kcmc_tpu import config as cfg_mod
+    from kcmc_tpu.config import CorrectorConfig
+
+    with pytest.raises(ValueError, match="io_workers"):
+        CorrectorConfig(io_workers=-1)
+    with pytest.raises(ValueError, match="io_prefetch"):
+        CorrectorConfig(io_prefetch=-2)
+    assert "io_workers" in cfg_mod.SIG_NEUTRAL_FIELDS
+    assert "io_prefetch" in cfg_mod.SIG_NEUTRAL_FIELDS
+
+
+# -- shared pool registry ---------------------------------------------------
+
+
+def test_shared_pool_reuse_and_shutdown():
+    a = feeder.shared_pool("thread", 2)
+    assert feeder.shared_pool("thread", 2) is a
+    assert feeder.shared_pool("thread", 3) is not a
+    feeder.shutdown_shared_pools()
+    assert feeder.shared_pool("thread", 2) is not a
+    feeder.shutdown_shared_pools()
+
+
+def test_minizarr_zlib_classifies_process(tmp_path):
+    try:
+        import zarr  # noqa: F401
+
+        pytest.skip("zarr package present: ZarrStack bypasses _MiniZarr")
+    except ImportError:
+        pass
+    from kcmc_tpu.io.formats import ZarrStack, ZarrWriter
+
+    rng = np.random.default_rng(2)
+    stack = (rng.random((6, 16, 16)) * 60000).astype(np.uint16)
+    store = tmp_path / "s.zarr"
+    w = ZarrWriter(store, 6, (16, 16), np.uint16, compression="deflate")
+    w.append_batch(stack)
+    w.close()
+    zs = ZarrStack(store)
+    assert feeder.classify_source(zs) == "process"
+    with ChunkedStackLoader(
+        zs, chunk_size=2, io_workers=2, source_path=store
+    ) as loader:
+        got = np.concatenate([f for _, _, f in loader])
+    np.testing.assert_array_equal(got, stack)
