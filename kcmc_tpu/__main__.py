@@ -69,7 +69,7 @@ def _parse_buckets(spec: str) -> tuple:
         raise SystemExit(
             f"--buckets: cannot parse {spec!r} — expected comma-"
             "separated sides or HxW pairs, e.g. '512,1024' or '480x640'"
-        )
+        ) from None
     return tuple(out)
 
 
@@ -476,7 +476,7 @@ def _cmd_warmup(args) -> int:
     try:
         stats = mc.warmup(dtypes=dtypes, progress=args.progress)
     except ValueError as e:
-        raise SystemExit(f"warmup: {e}")
+        raise SystemExit(f"warmup: {e}") from None
     # drop the verbose backend snapshot; the build summary (programs,
     # stamp hits/misses, seconds) is the contract surface
     stats.pop("plan_cache", None)
@@ -500,7 +500,28 @@ def _cmd_check(args) -> int:
         argv.append("--json")
     if args.write_baseline:
         argv.append("--write-baseline")
+    if args.prune_baseline:
+        argv.append("--prune-baseline")
+    if args.sarif:
+        argv += ["--sarif", args.sarif]
     return check_main(argv)
+
+
+def _cmd_sanitize(args) -> int:
+    """Run a command under the runtime concurrency sanitizer
+    (docs/ANALYSIS.md): instrumented locks with lock-order validation
+    against the static graph, a deadlock watchdog that dumps every
+    thread's stack, and leak checking."""
+    from kcmc_tpu.analysis.sanitize import main as sanitize_main
+
+    argv = []
+    if args.watchdog != 10.0:
+        argv += ["--watchdog", str(args.watchdog)]
+    if args.no_static:
+        argv.append("--no-static")
+    if args.strict:
+        argv.append("--strict")
+    return sanitize_main(argv + args.cmd)
 
 
 def _cmd_report(args) -> int:
@@ -846,9 +867,10 @@ def main(argv=None) -> int:
     p = sub.add_parser(
         "check",
         help="static repo invariant checker: config-signature "
-        "registry, jit purity, lock/thread discipline, span registry "
-        "— exit 0 unless a NEW (non-baselined) finding appears "
-        "(docs/ANALYSIS.md)",
+        "registry, jit purity, lock/thread discipline, span registry, "
+        "thread-root inventory, whole-program race detection, "
+        "resource lifecycle — exit 0 unless a NEW (non-baselined) "
+        "finding appears (docs/ANALYSIS.md)",
     )
     p.add_argument(
         "--root", default="",
@@ -869,7 +891,45 @@ def main(argv=None) -> int:
         help="rewrite the baseline from current findings (new entries "
         "get FILL-ME-IN reasons; justify each before committing)",
     )
+    p.add_argument(
+        "--prune-baseline", action="store_true",
+        help="drop stale baseline entries (ones whose finding no "
+        "longer fires) and rewrite the file",
+    )
+    p.add_argument(
+        "--sarif", default="", metavar="PATH",
+        help="also write new findings as a SARIF 2.1.0 log for GitHub "
+        "code-scanning PR annotations ('-' = stdout)",
+    )
     p.set_defaults(fn=_cmd_check)
+
+    p = sub.add_parser(
+        "sanitize",
+        help="run a command under the runtime concurrency sanitizer: "
+        "instrumented locks validated against the static lock-order "
+        "graph, deadlock watchdog with all-thread stack dumps, and "
+        "leak checking (docs/ANALYSIS.md)",
+    )
+    p.add_argument(
+        "--watchdog", type=float, default=10.0, metavar="SECS",
+        help="deadlock-watchdog threshold: a lock held this long with "
+        "waiters dumps every thread's stack (default 10)",
+    )
+    p.add_argument(
+        "--no-static", action="store_true",
+        help="skip merging the static lock-order graph into the "
+        "runtime order check",
+    )
+    p.add_argument(
+        "--strict", action="store_true",
+        help="raise at the acquisition closing a lock-order cycle "
+        "instead of recording it",
+    )
+    p.add_argument(
+        "cmd", nargs=argparse.REMAINDER,
+        help="command to run, e.g. `pytest tests/test_serve.py -q`",
+    )
+    p.set_defaults(fn=_cmd_sanitize)
 
     p = sub.add_parser(
         "report",
@@ -962,6 +1022,11 @@ def main(argv=None) -> int:
     p.set_defaults(fn=_cmd_stabilize)
 
     args = ap.parse_args(argv)
+    # KCMC_SANITIZE=1 arms the runtime concurrency sanitizer for this
+    # process (kcmc sanitize re-execs with it set; docs/ANALYSIS.md)
+    from kcmc_tpu.analysis.sanitize import maybe_enable_from_env
+
+    maybe_enable_from_env()
     # CLI processes route library advisories through the kcmc_tpu
     # logger on stderr; stdout carries only machine-readable output.
     from kcmc_tpu.obs.log import setup_cli_logging

@@ -1,17 +1,29 @@
-"""AST-based invariant checking for the kcmc_tpu repo itself
-(`kcmc check`; docs/ANALYSIS.md).
+"""Static + runtime analysis for the kcmc_tpu repo itself
+(`kcmc check` / `kcmc sanitize`; docs/ANALYSIS.md).
 
-Four repo-specific passes over a shared module index enforce the
+Seven repo-specific passes over a shared module index enforce the
 contracts that previously lived only in comments:
 
 * ``config-registry`` — every `CorrectorConfig` field classified as
   resume-signature neutral or affecting, validated and documented;
 * ``jit-purity`` — no host sync / side effects / nondeterminism
   reachable inside jitted programs;
-* ``lock-discipline`` — lock-order cycles, unlocked cross-thread
-  writes, and the "XLA work only on non-daemon threads" rule;
+* ``lock-discipline`` — lock-order cycles and the "XLA work only on
+  non-daemon threads" rule;
 * ``span-registry`` — every trace-span and `timing` key literal drawn
-  from the canonical `obs/registry.py` vocabulary.
+  from the canonical `obs/registry.py` vocabulary;
+* ``thread-roots`` — the concurrent-entry-point inventory (named,
+  statically-resolvable threads) feeding the cross-module call graph;
+* ``race`` — whole-program happens-before race detection: shared
+  accesses from concurrent roots with disjoint lock sets, with
+  program-wide lock identity (Condition/constructor-param aliasing);
+* ``resource-lifecycle`` — every acquired thread/pool/socket/file/
+  telemetry resource reaches its release on all paths.
+
+The runtime half (`analysis/sanitize.py`, behind `kcmc sanitize` /
+`KCMC_SANITIZE=1` / `pytest --sanitize`) instruments real locks,
+validates executed acquisition order against the static lock-order
+graph, watches for deadlocks, and leak-checks each test.
 
 Stdlib-only on purpose: the checker runs before (and without) jax.
 """

@@ -21,8 +21,10 @@ import sys
 
 
 def default_passes():
+    from kcmc_tpu.analysis.concurrency import RacePass, ThreadRootsPass
     from kcmc_tpu.analysis.config_registry import ConfigRegistryPass
     from kcmc_tpu.analysis.jit_purity import JitPurityPass
+    from kcmc_tpu.analysis.lifecycle import ResourceLifecyclePass
     from kcmc_tpu.analysis.lock_discipline import LockDisciplinePass
     from kcmc_tpu.analysis.span_registry import SpanRegistryPass
 
@@ -31,6 +33,9 @@ def default_passes():
         JitPurityPass(),
         LockDisciplinePass(),
         SpanRegistryPass(),
+        ThreadRootsPass(),
+        RacePass(),
+        ResourceLifecyclePass(),
     ]
 
 
@@ -74,7 +79,8 @@ def main(argv=None) -> int:
         description=(
             "AST-based repo invariant checker: config-signature "
             "registry, jit purity, lock/thread discipline, span "
-            "registry (docs/ANALYSIS.md)"
+            "registry, thread-root inventory, whole-program race "
+            "detection, resource lifecycle (docs/ANALYSIS.md)"
         ),
     )
     ap.add_argument(
@@ -105,7 +111,33 @@ def main(argv=None) -> int:
             "justifying each)"
         ),
     )
+    ap.add_argument(
+        "--prune-baseline",
+        action="store_true",
+        help=(
+            "drop STALE baseline entries (entries whose finding no "
+            "longer fires) and rewrite the file — the explicit cleanup "
+            "mode behind the stale-entry warning"
+        ),
+    )
+    ap.add_argument(
+        "--sarif",
+        default="",
+        metavar="PATH",
+        help=(
+            "also write the NEW findings as a SARIF 2.1.0 log (GitHub "
+            "code-scanning upload renders them as inline PR "
+            "annotations); '-' for stdout"
+        ),
+    )
     args = ap.parse_args(argv)
+    if args.json and args.sarif == "-":
+        print(
+            "kcmc check: --json and --sarif - both claim stdout; "
+            "write the SARIF log to a file",
+            file=sys.stderr,
+        )
+        return 2
 
     root = os.path.abspath(args.root) if args.root else find_repo_root()
     if not os.path.isdir(os.path.join(root, "kcmc_tpu")):
@@ -163,8 +195,56 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
 
+    if args.prune_baseline:
+        from kcmc_tpu.analysis.core import Baseline
+
+        if not os.path.exists(bl_path):
+            print(
+                f"kcmc check: no baseline at {bl_path!r} to prune",
+                file=sys.stderr,
+            )
+            return 2
+        bl = Baseline.load(bl_path)
+        bl.split(result.findings)  # marks still-firing entries used
+        live = [e for e in bl.entries if e.used]
+        pruned = len(bl.entries) - len(live)
+        if pruned:
+            Baseline(live).save(bl_path)
+        print(
+            f"kcmc check: pruned {pruned} stale baseline entr"
+            f"{'y' if pruned == 1 else 'ies'} "
+            f"({len(live)} live) in {bl_path}",
+            file=sys.stderr,
+        )
+        if pruned:
+            # the pruned file is the new truth: re-evaluate the gate so
+            # a prune run reports the same exit the next plain run would
+            result = run_check(root, baseline_path=bl_path)
+
+    if args.sarif:
+        from kcmc_tpu.analysis.sarif import to_sarif
+
+        payload = json.dumps(to_sarif(result), indent=2)
+        if args.sarif == "-":
+            print(payload)
+        else:
+            with open(args.sarif, "w", encoding="utf-8") as f:
+                f.write(payload + "\n")
+            print(
+                f"kcmc check: wrote SARIF log to {args.sarif}",
+                file=sys.stderr,
+            )
+
     if args.json:
         print(json.dumps(result.as_dict()))
+    elif args.sarif == "-":
+        # stdout is the SARIF document; the summary goes to stderr
+        s = result.summary()
+        print(
+            f"kcmc check: {s['findings']} findings ({s['new']} new) -> "
+            f"{'OK' if s['ok'] else 'FAIL'}",
+            file=sys.stderr,
+        )
     else:
         for f in result.new:
             print(f.format())

@@ -172,7 +172,7 @@ class JitPurityPass:
         out: list[Finding] = []
         for mod in self._modules(index):
             table = FunctionTable(mod.tree)
-            for root, how, site_line in _jit_roots(mod, table):
+            for root, how, _site_line in _jit_roots(mod, table):
                 cls = enclosing_class(mod.tree, root)
                 for fn in reachable_functions(table, root, cls):
                     out.extend(

@@ -1,9 +1,8 @@
-"""Pass 3 — lock/thread discipline (`lock-order`, `shared-write`,
-`daemon-xla`).
+"""Pass 3 — lock/thread discipline (`lock-order`, `daemon-xla`).
 
 The streaming pipeline, the async writer, the serving scheduler, and
 the plan runtime together hold ~34 `threading` sites whose contracts
-live in comments. Three of them are machine-checkable:
+live in comments. Two of them are machine-checkable here:
 
 * **lock-order** — per class, build the lock-acquisition graph: an
   edge a→b when a `with self._b:` executes (directly, or via a
@@ -13,17 +12,18 @@ live in comments. Three of them are machine-checkable:
   IS holding `_lock`), and self-edges are ignored (RLock reentrancy
   is this repo's documented pattern).
 
-* **shared-write** — an attribute assigned both from a thread-entry
-  function (a `threading.Thread(target=...)` body or anything it
-  reaches) and from consumer-side methods, where at least one write
-  takes no declared lock, is a data race candidate. `__init__` writes
-  are construction-time and exempt.
-
 * **daemon-xla** — the PR-7 rule, learned the hard way: a daemon
   thread killed mid-XLA-compile aborts interpreter teardown, so
   threads whose targets reach jax compile/export/dispatch must be
   non-daemon (and joined on stop). The `serve/scheduler.py`
   degraded-budget warm-up threads were the motivating catch.
+
+The AST-local `shared-write` warning this pass used to carry was
+SUPERSEDED by the whole-program `race` pass (`concurrency.py`), which
+does the same reasoning cross-module with real lock sets and
+happens-before propagation; the per-class acquisition machinery here
+(`_ClassModel`) stays because the order/daemon rules and the runtime
+sanitizer's static-graph merge build on it.
 """
 
 from __future__ import annotations
@@ -168,7 +168,7 @@ class _ClassModel:
     def order_edges(self) -> dict[tuple[str, str], tuple[int, str]]:
         """{(outer, inner): (line, via)} across all methods."""
         edges: dict[tuple[str, str], tuple[int, str]] = {}
-        for m, fn in self.methods.items():
+        for m, _fn in self.methods.items():
             for outer, with_node, _m in self.acquires.get(m, []):
                 for node in ast.walk(with_node):
                     if node is with_node:
@@ -401,30 +401,13 @@ class LockDisciplinePass:
             )
         return out
 
-    # -- threads: shared writes + daemon XLA -------------------------------
+    # -- threads: daemon XLA -----------------------------------------------
 
     def _check_threads(self, mod, cls, model, table) -> list[Finding]:
         out = []
         threads = model.threads()
         if not threads:
             return out
-
-        # worker side: thread targets plus their self-call closure
-        worker_methods: set[str] = set()
-
-        def absorb(m: str) -> None:
-            if m in worker_methods or m not in model.methods:
-                return
-            worker_methods.add(m)
-            for node in ast.walk(model.methods[m]):
-                if isinstance(node, ast.Call):
-                    callee = _self_attr(node.func)
-                    if callee is not None:
-                        absorb(callee)
-
-        for t in threads:
-            if t["target"] and t["target"][0] == "self":
-                absorb(t["target"][1])
 
         # daemon-xla rule
         for t in threads:
@@ -461,75 +444,4 @@ class LockDisciplinePass:
                     )
                 )
 
-        # shared-write rule
-        out.extend(self._check_shared_writes(mod, cls, model, worker_methods))
-        return out
-
-    def _check_shared_writes(
-        self, mod, cls, model, worker_methods: set[str]
-    ) -> list[Finding]:
-        if not worker_methods:
-            return []
-        # attr -> {"worker"/"consumer" -> [(line, locked)]}
-        writes: dict[str, dict[str, list[tuple[int, bool]]]] = {}
-        for m, fn in model.methods.items():
-            if m == "__init__":
-                continue
-            side = "worker" if m in worker_methods else "consumer"
-            lock_spans = [
-                w for _a, w, _m in model.acquires.get(m, [])
-            ]
-
-            def under_lock(node) -> bool:
-                return any(
-                    any(sub is node for sub in ast.walk(w))
-                    for w in lock_spans
-                )
-
-            for node in ast.walk(fn):
-                targets: list[ast.AST] = []
-                if isinstance(node, ast.Assign):
-                    targets = node.targets
-                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-                    targets = [node.target]
-                for t in targets:
-                    # self._x = ... and self._x[...] = ... both count
-                    base = t.value if isinstance(t, ast.Subscript) else t
-                    attr = _self_attr(base)
-                    if attr is None or model.is_lock(attr):
-                        continue
-                    writes.setdefault(attr, {}).setdefault(
-                        side, []
-                    ).append((node.lineno, under_lock(node)))
-        out = []
-        for attr, sides in sorted(writes.items()):
-            if "worker" not in sides or "consumer" not in sides:
-                continue
-            unlocked = [
-                (line, side)
-                for side in ("worker", "consumer")
-                for line, locked in sides[side]
-                if not locked
-            ]
-            if unlocked:
-                line = min(ln for ln, _ in unlocked)
-                out.append(
-                    Finding(
-                        rule="shared-write",
-                        path=mod.path,
-                        line=line,
-                        severity="warning",
-                        message=(
-                            f"attribute 'self.{attr}' of {cls.name} is "
-                            "written from both thread-entry and "
-                            "consumer methods without a declared lock"
-                        ),
-                        detail=(
-                            "unlocked write sites: "
-                            + ", ".join(
-                                f"{side}@{ln}" for ln, side in unlocked
-                            )
-                        ),
-                    )
-                )
         return out

@@ -126,7 +126,9 @@ class _StallWatchdog:
         self._get_done = get_done
         self._total = total
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, name="kcmc-stall-watchdog", daemon=True
+        )
         self._thread.start()
 
     def _run(self):

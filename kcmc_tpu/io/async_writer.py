@@ -43,6 +43,12 @@ class AsyncBatchWriter:
         self._exc: BaseException | None = None
         self._exc_lock = threading.Lock()
         self._close_lock = threading.Lock()
+        # Guards the advisory counters: the worker and the consumer
+        # both accumulate into _stats (write_s/batches vs
+        # backpressure_s/flush_s), and stats() snapshots from whatever
+        # thread asks — the race pass (`kcmc check`) holds all three
+        # sides to one lock.
+        self._stats_lock = threading.Lock()
         self._closed = False
         self._stats = {
             "backpressure_s": 0.0,  # consumer blocked on a full queue
@@ -50,7 +56,9 @@ class AsyncBatchWriter:
             "write_s": 0.0,  # worker time actually encoding+writing
             "batches": 0,
         }
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(
+            target=self._run, name="kcmc-writer", daemon=True
+        )
         self._thread.start()
 
     # -- worker ------------------------------------------------------------
@@ -61,20 +69,26 @@ class AsyncBatchWriter:
             try:
                 if item is None:
                     return
-                if self._exc is None:  # after a failure: drain, don't write
-                    frames, n_threads = item
-                    t0 = time.perf_counter()
-                    try:
-                        self.writer.append_batch(frames, n_threads=n_threads)
-                        dt = time.perf_counter() - t0
+                with self._exc_lock:
+                    failed = self._exc is not None
+                if failed:  # after a failure: drain, don't write
+                    continue
+                frames, n_threads = item
+                t0 = time.perf_counter()
+                try:
+                    self.writer.append_batch(frames, n_threads=n_threads)
+                    dt = time.perf_counter() - t0
+                    with self._stats_lock:
                         self._stats["write_s"] += dt
                         self._stats["batches"] += 1
-                        if self._tracer is not None:
-                            self._tracer.complete(
-                                "writer.append_batch", t0, dt, cat="writer",
-                                args={"batch": self._stats["batches"]},
-                            )
-                    except BaseException as e:  # surfaced on the consumer
+                        batches = self._stats["batches"]
+                    if self._tracer is not None:
+                        self._tracer.complete(
+                            "writer.append_batch", t0, dt, cat="writer",
+                            args={"batch": batches},
+                        )
+                except BaseException as e:  # surfaced on the consumer
+                    with self._exc_lock:
                         self._exc = e
             finally:
                 self._q.task_done()
@@ -109,7 +123,8 @@ class AsyncBatchWriter:
                 t0 = time.perf_counter()
                 self._q.put(item)
                 dt = time.perf_counter() - t0
-                self._stats["backpressure_s"] += dt
+                with self._stats_lock:
+                    self._stats["backpressure_s"] += dt
                 if self._tracer is not None:
                     self._tracer.complete(
                         "writer.backpressure", t0, dt, cat="stall"
@@ -125,7 +140,8 @@ class AsyncBatchWriter:
         t0 = time.perf_counter()
         self._q.join()
         dt = time.perf_counter() - t0
-        self._stats["flush_s"] += dt
+        with self._stats_lock:
+            self._stats["flush_s"] += dt
         if self._tracer is not None and dt > 0:
             self._tracer.complete("writer.flush", t0, dt, cat="stall")
         self._check()
@@ -141,7 +157,8 @@ class AsyncBatchWriter:
         return self.writer.n_pages
 
     def stats(self) -> dict:
-        return dict(self._stats)
+        with self._stats_lock:
+            return dict(self._stats)
 
     def close(self) -> None:
         """Flush, stop the worker, close the inner writer; re-raises a

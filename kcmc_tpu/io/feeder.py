@@ -334,7 +334,19 @@ def pooled_chunks(
         nxt = hi
         step = fault_plan.op_index("io_read") if fault_plan is not None else None
         spans = _spans(lo, hi, pool.workers)
-        futs = [pool.submit(spec, a, b) for a, b in spans]
+        try:
+            futs = [pool.submit(spec, a, b) for a, b in spans]
+        except BrokenProcessPool as e:
+            # A dead worker can surface at SUBMIT time (the executor
+            # noticed before our next collect): same contract as the
+            # collect-side path — mark the pool broken and raise with
+            # attribution, never leak the raw executor error.
+            pool.broken = True
+            raise RuntimeError(
+                f"decode pool worker died while submitting pages "
+                f"[{lo}, {hi}) of {spec[1]!r} (the pool is torn "
+                "down; a rerun builds a fresh one)"
+            ) from e
         pending.append((lo, hi, spans, futs, time.perf_counter(), step))
         if stats is not None:
             stats["chunks"] += 1

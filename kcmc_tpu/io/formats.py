@@ -220,7 +220,7 @@ class _MiniZarr:
                 dst = [slice(s_lo - lo, s_hi - lo)]
                 src = [slice(s_lo - t0, s_hi - t0)]
                 ok = True
-                for d, (ri, c, s) in enumerate(
+                for _d, (ri, c, s) in enumerate(
                     zip(rest, self.chunks[1:], self.shape[1:])
                 ):
                     a, b = ri * c, min((ri + 1) * c, s)
@@ -376,7 +376,9 @@ class ZarrWriter:
             # torn/corrupt metadata must surface as OSError — the
             # corrector's resume handler restarts from scratch on
             # OSError, exactly like a torn TIFF
-            raise OSError(f"{path}: unreadable .zarray at resume: {e}")
+            raise OSError(
+                f"{path}: unreadable .zarray at resume: {e}"
+            ) from e
         self = object.__new__(cls)
         self.path = path
         self.compression = compression
@@ -392,7 +394,9 @@ class ZarrWriter:
         try:
             n = int(state["n_pages"])
         except (KeyError, TypeError, ValueError) as e:
-            raise OSError(f"{path}: malformed zarr writer state: {e}")
+            raise OSError(
+                f"{path}: malformed zarr writer state: {e}"
+            ) from e
         # all checkpointed chunks must exist (the output is the
         # persistence layer, exactly like the TIFF resume contract)
         if n > 0 and not os.path.exists(self._chunk_path(n - 1)):
@@ -500,13 +504,17 @@ class HDF5Writer:
             self._f = h5py.File(path, "r+")
             self._d = self._f[cls.dataset_name]
         except (OSError, KeyError) as e:
-            raise OSError(f"{path}: unreadable HDF5 output at resume: {e}")
+            raise OSError(
+                f"{path}: unreadable HDF5 output at resume: {e}"
+            ) from e
         self.shape = tuple(self._d.shape)
         self.dtype = np.dtype(self._d.dtype)
         try:
             n = int(state["n_pages"])
         except (KeyError, TypeError, ValueError) as e:
-            raise OSError(f"{path}: malformed hdf5 writer state: {e}")
+            raise OSError(
+                f"{path}: malformed hdf5 writer state: {e}"
+            ) from e
         if n > self.shape[0]:
             raise OSError(
                 f"{path}: checkpoint cursor {n} beyond dataset "

@@ -228,7 +228,9 @@ class ChunkedStackLoader:
                 raise
             q.put(None)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(
+            target=producer, name="kcmc-prefetch", daemon=True
+        )
         t.start()
         try:
             while True:

@@ -25,7 +25,7 @@ translation, rigid/euclidean, affine 6-DoF, homography 8-DoF, 3D rigid.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable
+from collections.abc import Callable
 
 import jax
 import jax.numpy as jnp

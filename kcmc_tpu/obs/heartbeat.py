@@ -52,17 +52,23 @@ class Heartbeat:
         self._sample = sample
         self._emit = emit if emit is not None else _default_emit
         self._stop = threading.Event()
+        # start/stop are a cross-thread handoff in serving: a session's
+        # heartbeat starts on the client thread (session construction)
+        # and stops on the scheduler thread (finalize) — the handle
+        # swap is guarded so the joiner always sees the started thread.
+        self._lifecycle = threading.Lock()
         self._thread: threading.Thread | None = None
         self.beats = 0  # emitted lines (lifecycle tests)
 
     def start(self) -> "Heartbeat":
-        if self._thread is not None:
-            return self  # already running (idempotent)
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="kcmc-heartbeat", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle:
+            if self._thread is not None:
+                return self  # already running (idempotent)
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="kcmc-heartbeat", daemon=True
+            )
+            self._thread.start()
         return self
 
     def _run(self) -> None:
@@ -81,14 +87,19 @@ class Heartbeat:
 
     def stop(self) -> None:
         """Signal and join the thread (idempotent; bounded wait)."""
-        self._stop.set()
-        t, self._thread = self._thread, None
+        with self._lifecycle:
+            # set INSIDE the lock: a stop racing a start must not have
+            # its signal cleared by the start it lost the race to
+            self._stop.set()
+            t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=self.interval_s + 5.0)
 
     @property
     def running(self) -> bool:
-        return self._thread is not None and self._thread.is_alive()
+        with self._lifecycle:
+            t = self._thread
+        return t is not None and t.is_alive()
 
     def __enter__(self) -> "Heartbeat":
         return self.start()

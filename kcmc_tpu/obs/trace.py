@@ -40,18 +40,17 @@ class Tracer:
 
     # -- recording ---------------------------------------------------------
 
-    def _note_thread(self, tid: int) -> None:
-        # dict membership is atomic under the GIL; worst case two
-        # threads race to record the same name, which is idempotent
-        if tid not in self._thread_names:
-            self._thread_names[tid] = threading.current_thread().name
-
     def _append(self, ev: dict) -> None:
         tid = threading.get_ident()
-        self._note_thread(tid)
+        tname = threading.current_thread().name
         ev["pid"] = self._pid
         ev["tid"] = tid
         with self._lock:
+            # thread-name registration shares the event lock — it is
+            # already being taken, and the export-side iteration must
+            # not race a first-sighting insert
+            if tid not in self._thread_names:
+                self._thread_names[tid] = tname
             self._events.append(ev)
 
     def complete(
@@ -119,7 +118,8 @@ class Tracer:
         """Snapshot of recorded events plus per-thread name metadata."""
         with self._lock:
             evs = list(self._events)
-        for tid, tname in sorted(self._thread_names.items()):
+            names = sorted(self._thread_names.items())
+        for tid, tname in names:
             evs.append(
                 {
                     "name": "thread_name",

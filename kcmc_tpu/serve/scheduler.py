@@ -251,9 +251,13 @@ class StreamScheduler:
                 expected_frames=expected_frames, output_dtype=output_dtype,
                 compression=compression, telemetry=telemetry,
             )
-            if ref_arr is not None:
-                sess.set_reference(ref_arr)
             with self._wake:
+                # Reference staging happens under the plane lock with
+                # the registration: the scheduler thread reads the
+                # staged source under the same lock, and ref_arr is
+                # already float32 so this is pointer work, not a copy.
+                if ref_arr is not None:
+                    sess.set_reference(ref_arr)
                 self._sessions[sid] = sess
                 self._rebuild_order()
                 self._wake.notify_all()
@@ -408,10 +412,17 @@ class StreamScheduler:
             sessions = list(self._sessions.values())
             st = dict(self._stats)
             inflight = len(self._window)
+            # backlog() walks session queues the scheduler mutates —
+            # snapshot it under the plane lock, not after it
+            queues = {s.sid: s.backlog() for s in sessions}
+            degraded_active = sorted(
+                s.sid for s in sessions if s.degraded
+            )
+            db = self._degraded_backend
         batches = max(st["batches"], 1)
         out = {
             "sessions_open": len(sessions),
-            "queues": {s.sid: s.backlog() for s in sessions},
+            "queues": queues,
             "inflight_batches": inflight,
             "batch_size": self.B,
             "batch_occupancy": round(
@@ -424,9 +435,7 @@ class StreamScheduler:
                 "rejected_frames": st["rejected_frames"],
                 "degrade_events": st["degrade_events"],
                 "degraded_batches": st["degraded_batches"],
-                "degraded_active": sorted(
-                    s.sid for s in sessions if s.degraded
-                ),
+                "degraded_active": degraded_active,
             },
         }
         # Execution-plan / compile-cache accounting (kcmc_tpu/plans):
@@ -442,7 +451,6 @@ class StreamScheduler:
                     out["plan_cache"] = ps
             except Exception:
                 pass
-        db = self._degraded_backend
         dstats_fn = getattr(db, "plan_cache_stats", None) if db else None
         if dstats_fn is not None:
             try:
@@ -459,10 +467,12 @@ class StreamScheduler:
             sessions = list(self._sessions.values())
             st = dict(self._stats)
             inflight = len(self._window)
+            queues = {s.sid: s.backlog() for s in sessions}
+            snaps = [s.snapshot() for s in sessions]
         batches = max(st["batches"], 1)
         return {
-            "sessions": [s.snapshot() for s in sessions],
-            "queues": {s.sid: s.backlog() for s in sessions},
+            "sessions": snaps,
+            "queues": queues,
             "admission": {
                 "rejected": st["rejected_frames"],
                 "degraded": st["degraded_batches"],
@@ -505,7 +515,12 @@ class StreamScheduler:
                 plan = getattr(backend, "_plan", None)
                 if plan is not None:
                     plan.rung = "degraded"
-                self._degraded_backend = backend
+                # Publish under the PLANE lock: stats() reads the
+                # handle there without ever waiting behind this
+                # build (seconds of XLA compile when overload first
+                # engages); _degraded_build keeps builders serialized.
+                with self._lock:
+                    self._degraded_backend = backend
             return self._degraded_backend
 
     def _warm_degraded(self) -> None:
@@ -572,7 +587,10 @@ class StreamScheduler:
     # -- the scheduler loop --------------------------------------------------
 
     def _loop(self) -> None:
-        while self._running:
+        while True:
+            with self._lock:
+                if not self._running:
+                    break
             try:
                 self._loop_once()
             except Exception as e:
@@ -623,7 +641,11 @@ class StreamScheduler:
                 sess, backend, n, batch, idx, ref, degraded
             )
             if entry is not None:
-                self._window.append(entry)
+                with self._lock:
+                    # stats()/snapshot() read the window depth under
+                    # the plane lock; mutations take it too (drains
+                    # still materialize OUTSIDE it)
+                    self._window.append(entry)
                 while len(self._window) >= self.inflight_depth:
                     self._drain_one()
             self._finalize_ready()
@@ -722,10 +744,13 @@ class StreamScheduler:
         ):
             batch = batch.astype(np.float32)
         dispatch = getattr(backend, "process_batch_async", None)
-        self._stats["batches"] += 1
-        self._stats["occupied_frames"] += int(n)
-        if degraded:
-            self._stats["degraded_batches"] += 1
+        with self._lock:
+            # scheduler-thread QoS counters share the plane lock with
+            # the stats()/snapshot() readers
+            self._stats["batches"] += 1
+            self._stats["occupied_frames"] += int(n)
+            if degraded:
+                self._stats["degraded_batches"] += 1
         kept = batch if sess.wants_pixels() else None
         try:
             if dispatch is not None:
@@ -743,9 +768,12 @@ class StreamScheduler:
         """Drain the oldest in-flight entry: materialize to host (where
         a deferred async device error surfaces — it walks the ladder),
         then hand the batch to its session."""
-        if not self._window:
-            return
-        sess, n, out, kept, batch, idx, ref, backend = self._window.popleft()
+        with self._lock:
+            if not self._window:
+                return
+            sess, n, out, kept, batch, idx, ref, backend = (
+                self._window.popleft()
+            )
         try:
             # Registration-only sessions (no emit, no server-side file,
             # no rolling template) never touch pixels: leave `corrected`
@@ -790,6 +818,6 @@ class StreamScheduler:
             sess.fail(e)
         finally:
             sess.entry_done()
-        self._stats["frames_done"] += int(n)
         with self._lock:
+            self._stats["frames_done"] += int(n)
             self._maybe_restore_locked(sess)

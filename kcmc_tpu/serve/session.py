@@ -223,8 +223,10 @@ class Session:
     def prepare_reference_now(self) -> None:
         """Prepare the staged reference. SCHEDULER thread only, lock
         NOT held — this is device compute (and a possible JIT compile)
-        that must never stall other tenants' submits."""
-        src = self._ref_src
+        that must never stall other tenants' submits. Only the staged-
+        source read takes the lock; the compute does not."""
+        with self._cond:
+            src = self._ref_src
         ref = self.mc.backend.prepare_reference(src)
         with self._cond:
             self.ref_frame = src
@@ -233,8 +235,12 @@ class Session:
 
     def begin_close(self) -> None:
         """Mark the stream complete: remaining pending frames still
-        process; the scheduler finalizes once everything drains."""
-        self.closing = True
+        process; the scheduler finalizes once everything drains.
+        Takes the plane lock itself (reentrant) — the shutdown path
+        calls it with no lock held."""
+        with self._cond:
+            self.closing = True
+            self._cond.notify_all()
 
     # -- dispatch side (scheduler thread, scheduler lock held) ------------
 
@@ -280,6 +286,11 @@ class Session:
         rolling-template tail collection, writer append, telemetry."""
         if self.error is not None:
             return  # failed stream: entries drain without accounting
+        with self._cond:
+            # out_dt is pinned by the first admitted submit (a client
+            # thread, under this same lock) — snapshot it rather than
+            # reading it unlocked mid-drain
+            out_dt = self.out_dt
         cfg = self.mc.config
         if cfg.rescue_warp and kept is not None:
             self.mc._rescue_flagged(host, kept, n, ref_used)
@@ -301,7 +312,7 @@ class Session:
             while have - len(self._tail[0]["corrected"]) >= self.W_roll:
                 have -= len(self._tail.pop(0)["corrected"])
         if corrected is not None:
-            corrected = _cast_output(corrected, self.out_dt)
+            corrected = _cast_output(corrected, out_dt)
             if self.writer is None and self.output is not None:
                 # Lazy writer construction on the scheduler thread at
                 # the first drained batch — file I/O stays off the
@@ -311,7 +322,7 @@ class Session:
 
                 inner = make_writer(
                     self.output, int(self.expected_frames),
-                    tuple(corrected.shape[1:]), self.out_dt,
+                    tuple(corrected.shape[1:]), out_dt,
                     compression=self.compression,
                 )
                 depth = self.mc.config.writer_depth
@@ -345,16 +356,20 @@ class Session:
             # frame-exact window slicing inside _rolled_template). Runs
             # on the scheduler thread, after every pre-boundary frame
             # of THIS session drained — other sessions' batches keep
-            # the window busy meanwhile.
-            self.ref_frame = self.mc._rolled_template(
+            # the window busy meanwhile. The blend + re-preparation
+            # compute outside the lock; only the handle swap takes it
+            # (client-side set_reference probes `self.ref` under it).
+            rolled = self.mc._rolled_template(
                 self.ref_frame,
                 [t["corrected"] for t in self._tail],
                 [t["warp_ok"] for t in self._tail],
                 self.W_roll,
             )
             self._tail.clear()
-            self.ref = self.mc.backend.prepare_reference(self.ref_frame)
+            new_ref = self.mc.backend.prepare_reference(rolled)
             with self._cond:
+                self.ref_frame = rolled
+                self.ref = new_ref
                 self._next_boundary += self.E
                 self._cond.notify_all()
 
@@ -370,13 +385,15 @@ class Session:
     def drained_out(self) -> bool:
         """True when every admitted frame has drained (finalize gate).
         A failed stream only waits for its in-flight entries — its
-        pending frames were dropped by `fail`."""
-        if self.error is not None:
-            return self.inflight == 0
-        return (
-            not self.pending and self.inflight == 0
-            and self.dispatched == self.done
-        )
+        pending frames were dropped by `fail`. Takes the plane lock
+        itself (reentrant) — the shutdown path polls it lock-free."""
+        with self._cond:
+            if self.error is not None:
+                return self.inflight == 0
+            return (
+                not self.pending and self.inflight == 0
+                and self.dispatched == self.done
+            )
 
     def fail(self, exc: BaseException) -> None:
         """Fatal stream error (ladder exhausted with mark-failed off, or
@@ -400,9 +417,12 @@ class Session:
             self._finalizing = True
             # Shallow-copy each batch dict: the merge below runs
             # OUTSIDE the lock, and a concurrent fetch() pops delivered
-            # pixels from the shared dicts mid-merge otherwise.
+            # pixels from the shared dicts mid-merge otherwise. The
+            # stream clock (_t0: first-submit time, a client-thread
+            # write) snapshots under the lock for the same reason.
             outs = [dict(o) for o in self._outs]
             done = self.done
+            t0 = self._t0
         err: BaseException | None = None
         try:
             if self.writer is not None:
@@ -410,8 +430,8 @@ class Session:
         except BaseException as e:  # surfaced on result()
             err = e
         elapsed = (
-            max(time.perf_counter() - self._t0, 1e-9)
-            if self._t0 is not None
+            max(time.perf_counter() - t0, 1e-9)
+            if t0 is not None
             else 0.0
         )
         timing: dict = {
@@ -509,13 +529,16 @@ class Session:
     # -- telemetry snapshot (heartbeat thread) -----------------------------
 
     def snapshot(self) -> dict:
+        with self._cond:  # reentrant: the scheduler snapshots under it
+            t0 = self._t0
+            done = self.done
         elapsed = (
-            max(time.perf_counter() - self._t0, 1e-9)
-            if self._t0 is not None
+            max(time.perf_counter() - t0, 1e-9)
+            if t0 is not None
             else None
         )
         return {
             "name": f"{self.tenant}/{self.sid}",
-            "frames": self.done,
-            "fps": (self.done / elapsed) if elapsed else 0.0,
+            "frames": done,
+            "fps": (done / elapsed) if elapsed else 0.0,
         }

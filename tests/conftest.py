@@ -38,6 +38,53 @@ import numpy as np
 import pytest
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--sanitize",
+        action="store_true",
+        default=False,
+        help="run under the kcmc runtime concurrency sanitizer: "
+        "instrumented locks validated against the static lock-order "
+        "graph, a deadlock watchdog, and a per-test leak check "
+        "(threads/sockets/telemetry claims); also via KCMC_SANITIZE=1 "
+        "(docs/ANALYSIS.md)",
+    )
+
+
+def pytest_configure(config):
+    from kcmc_tpu.analysis import sanitize
+
+    # env first: `kcmc sanitize --strict --watchdog 3 pytest …` carries
+    # its options through KCMC_SANITIZE_*; a bare --sanitize falls back
+    # to defaults (enable is idempotent, so the order is safe)
+    if not sanitize.maybe_enable_from_env() and config.getoption(
+        "--sanitize"
+    ):
+        sanitize.enable()
+
+
+@pytest.fixture(autouse=True)
+def _sanitize_guard(request):
+    """Per-test sanitizer gate (no-op unless --sanitize/KCMC_SANITIZE):
+    any lock-order violation or deadlock suspect recorded during the
+    test, and any leaked thread/socket/telemetry-path-claim still live
+    after it, fails the test that caused it."""
+    from kcmc_tpu.analysis import sanitize
+
+    if not sanitize.active():
+        yield
+        return
+    sanitize.take_violations()  # a prior test's report must not bleed in
+    before = sanitize.leak_snapshot()
+    yield
+    problems = sanitize.take_violations() + sanitize.check_leaks(before)
+    if problems:
+        pytest.fail(
+            "sanitizer caught:\n" + "\n".join(f"- {p}" for p in problems),
+            pytrace=False,
+        )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -23,7 +23,9 @@ from kcmc_tpu.analysis.core import (
     ModuleIndex,
     run_passes,
 )
+from kcmc_tpu.analysis.concurrency import RacePass, ThreadRootsPass
 from kcmc_tpu.analysis.jit_purity import JitPurityPass
+from kcmc_tpu.analysis.lifecycle import ResourceLifecyclePass
 from kcmc_tpu.analysis.lock_discipline import LockDisciplinePass
 from kcmc_tpu.analysis.span_registry import SpanRegistryPass
 
@@ -269,20 +271,25 @@ class Warmer:
         print("alive")
 """
 
-SHARED_WRITE = """
+RACE_BAD = """
 import threading
 
 class Counter:
     def __init__(self):
         self._lock = threading.Lock()
         self._n = 0
-        self._t = threading.Thread(target=self._run, daemon=False)
+        self._t = threading.Thread(
+            target=self._run, name="w", daemon=False
+        )
 
     def _run(self):
         self._n = self._n + 1      # worker write, no lock
 
+    def stop(self):
+        self._t.join()
+
     def reset(self):
-        self._n = 0                # consumer write, no lock
+        self._n = 0                # client write, no lock
 """
 
 
@@ -327,25 +334,276 @@ def test_daemon_xla_quiet_on_non_daemon_and_non_xla_threads():
     ]
 
 
-def test_shared_write_without_lock_fires():
-    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": SHARED_WRITE})
-    findings = LockDisciplinePass().run(idx)
-    hits = [f for f in findings if f.rule == "shared-write"]
-    assert hits and "self._n" in hits[0].message, findings
+# -- pass 5+6: whole-program concurrency (thread-roots, race) --------------
 
 
-def test_shared_write_quiet_when_locked():
-    src = SHARED_WRITE.replace(
+def test_race_fires_on_unsynchronized_cross_thread_write():
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": RACE_BAD})
+    findings = RacePass().run(idx)
+    hits = [f for f in findings if f.rule == "race"]
+    assert hits and "Counter._n" in hits[0].message, findings
+    assert hits[0].severity == "error"
+
+
+def test_race_quiet_when_both_sides_locked():
+    src = RACE_BAD.replace(
         "self._n = self._n + 1      # worker write, no lock",
         "with self._lock:\n            self._n = self._n + 1",
     ).replace(
-        "self._n = 0                # consumer write, no lock",
+        "self._n = 0                # client write, no lock",
         "with self._lock:\n            self._n = 0",
     )
     idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": src})
-    assert not [
-        f for f in LockDisciplinePass().run(idx) if f.rule == "shared-write"
-    ]
+    assert not [f for f in RacePass().run(idx) if f.rule == "race"]
+
+
+CALLER_HELD = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._t = threading.Thread(
+            target=self._run, name="w", daemon=False
+        )
+
+    def _run(self):
+        with self._lock:
+            self._n = self._n + 1
+
+    def _write(self):
+        self._n = 0    # unlocked HERE — the caller holds the lock
+
+    def stop(self):
+        self._t.join()
+
+    def reset(self):
+        with self._lock:
+            self._write()
+"""
+
+
+def test_race_sees_caller_held_locks_across_calls():
+    """Happens-before propagation: a callee invoked under the caller's
+    lock inherits it — the serving plane's convention."""
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": CALLER_HELD})
+    assert not [f for f in RacePass().run(idx) if f.rule == "race"]
+    # drop the caller's lock and the same write becomes a race
+    bad = CALLER_HELD.replace(
+        "with self._lock:\n            self._write()", "self._write()"
+    )
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": bad})
+    assert [f for f in RacePass().run(idx) if f.rule == "race"]
+
+
+RACE_CROSS_MODULE = {
+    "kcmc_tpu/serve/plane.py": """
+import threading
+
+from kcmc_tpu.serve.stream import Stream
+
+class Plane:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._streams = {}
+        self._thread = threading.Thread(
+            target=self._loop, name="plane", daemon=False
+        )
+
+    def _loop(self):
+        for s in list(self._streams.values()):
+            s.account(1)
+
+    def stop(self):
+        self._thread.join()
+
+    def submit(self, sid, n):
+        with self._lock:
+            s = self._streams.get(sid)
+            s.enqueue(n)
+
+    def open(self, sid):
+        with self._lock:
+            self._streams[sid] = Stream(self._lock, sid)
+""",
+    "kcmc_tpu/serve/stream.py": """
+import threading
+
+class Stream:
+    def __init__(self, lock, sid):
+        self._cond = threading.Condition(lock)
+        self.sid = sid
+        self.queued = 0
+        self.done = 0
+
+    def enqueue(self, n):
+        self.queued = self.queued + n
+
+    def account(self, n):
+        self.done = self.done + n
+""",
+}
+
+
+def test_race_resolves_constructor_lock_aliasing_cross_module():
+    """Stream._cond IS Plane._lock (constructor-parameter aliasing
+    through the call site): enqueue under the plane lock is quiet;
+    the scheduler-thread account() with no lock fires."""
+    from kcmc_tpu.analysis.concurrency import RacePass as RP
+
+    findings = RP().run(ModuleIndex.from_sources(RACE_CROSS_MODULE))
+    msgs = messages_of(findings)
+    # 'done' is written by the loop thread with no lock and read by
+    # nobody else -> no pair; 'queued' is written under the plane lock
+    # from submit (client) but the loop thread reads nothing of it...
+    # make the conflict explicit: account() also touches queued
+    assert not any("Stream.queued" in m for m in msgs), findings
+    bad = dict(RACE_CROSS_MODULE)
+    bad["kcmc_tpu/serve/stream.py"] = bad["kcmc_tpu/serve/stream.py"].replace(
+        "self.done = self.done + n",
+        "self.done = self.done + n\n        self.queued = self.queued - n",
+    )
+    findings = RP().run(ModuleIndex.from_sources(bad))
+    assert any(
+        "Stream.queued" in m for m in messages_of(findings)
+    ), findings
+
+
+def test_race_exempts_construction_context():
+    """Writes reached through a constructor (including methods the
+    ctor calls) are building an unpublished object — exempt."""
+    src = RACE_BAD.replace(
+        "def reset(self):\n        self._n = 0                # client write, no lock",
+        "def reset(self):\n        pass",
+    )
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": src})
+    # the only unlocked client-side write was in __init__ -> no pair
+    assert not [f for f in RacePass().run(idx) if f.rule == "race"]
+
+
+def test_thread_roots_flags_unnamed_and_lambda_threads():
+    src = """
+import threading
+
+def work():
+    pass
+
+def spawn():
+    threading.Thread(target=work, daemon=True).start()
+    threading.Thread(target=lambda: None, name="x", daemon=True).start()
+"""
+    findings = ThreadRootsPass().run(
+        ModuleIndex.from_sources({"kcmc_tpu/io/spawner.py": src})
+    )
+    msgs = messages_of(findings)
+    assert any("without a name=" in m for m in msgs), findings
+    assert any("lambda target" in m for m in msgs), findings
+
+
+def test_thread_roots_quiet_on_named_resolvable_threads():
+    src = """
+import threading
+
+def work():
+    pass
+
+def spawn():
+    t = threading.Thread(target=work, name="kcmc-w", daemon=True)
+    t.start()
+    t.join()
+"""
+    assert ThreadRootsPass().run(
+        ModuleIndex.from_sources({"kcmc_tpu/io/spawner.py": src})
+    ) == []
+
+
+# -- pass 7: resource lifecycle --------------------------------------------
+
+
+def test_lifecycle_flags_unjoined_thread_and_unreleased_executor():
+    src = """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+def leak_thread():
+    t = threading.Thread(target=print, name="t", daemon=False)
+    t.start()
+
+def leak_pool():
+    ex = ThreadPoolExecutor(2)
+    ex.submit(print)
+"""
+    findings = ResourceLifecyclePass().run(
+        ModuleIndex.from_sources({"kcmc_tpu/io/leaky.py": src})
+    )
+    msgs = messages_of(findings)
+    assert any("'t' acquired from threading.Thread" in m for m in msgs)
+    assert any(
+        "'ex' acquired from ThreadPoolExecutor" in m for m in msgs
+    ), findings
+
+
+def test_lifecycle_quiet_on_finally_with_and_escape():
+    src = """
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+def joined():
+    t = threading.Thread(target=print, name="t", daemon=False)
+    t.start()
+    try:
+        pass
+    finally:
+        t.join()
+
+def managed():
+    with ThreadPoolExecutor(2) as ex:
+        ex.submit(print)
+
+def transferred():
+    t = threading.Thread(target=print, name="t", daemon=False)
+    return t
+"""
+    assert ResourceLifecyclePass().run(
+        ModuleIndex.from_sources({"kcmc_tpu/io/clean.py": src})
+    ) == []
+
+
+def test_lifecycle_happy_path_release_is_a_warning():
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+def risky():
+    ex = ThreadPoolExecutor(2)
+    ex.submit(print)
+    ex.shutdown()
+"""
+    findings = ResourceLifecyclePass().run(
+        ModuleIndex.from_sources({"kcmc_tpu/io/risky.py": src})
+    )
+    assert [f.severity for f in findings] == ["warning"], findings
+    assert "happy path" in findings[0].message
+
+
+def test_lifecycle_self_attr_needs_a_releasing_method():
+    src = """
+from concurrent.futures import ThreadPoolExecutor
+
+class Owner:
+    def start(self):
+        self._ex = ThreadPoolExecutor(2)
+"""
+    findings = ResourceLifecyclePass().run(
+        ModuleIndex.from_sources({"kcmc_tpu/io/owner.py": src})
+    )
+    assert any(
+        "never released by Owner" in f.message for f in findings
+    ), findings
+    fixed = src + "\n    def close(self):\n        self._ex.shutdown()\n"
+    assert ResourceLifecyclePass().run(
+        ModuleIndex.from_sources({"kcmc_tpu/io/owner.py": fixed})
+    ) == []
 
 
 # -- pass 4: span-registry -------------------------------------------------
@@ -483,12 +741,15 @@ def test_repo_is_clean_against_baseline():
     ]
     assert blocking == [], "\n".join(f.format() for f in blocking)
     assert res.exit_code == 0
-    # the four passes all ran
+    # the seven passes all ran
     assert set(res.passes) == {
         "config-registry",
         "jit-purity",
         "lock-discipline",
         "span-registry",
+        "thread-roots",
+        "race",
+        "resource-lifecycle",
     }
 
 
@@ -533,6 +794,218 @@ def test_cli_fails_on_injected_bad_snippet(tmp_path, capsys):
     bad.write_text(bad.read_text() + "\n\n" + DAEMON_XLA)
     assert check_main(["--root", str(root)]) == 1
     assert "daemon-xla" in capsys.readouterr().out
+
+
+# -- SARIF export -----------------------------------------------------------
+
+# The load-bearing subset of the SARIF 2.1.0 schema: required
+# top-level shape, run/tool/driver/rules, and result anatomy. The full
+# OASIS schema is ~400 KB; this subset pins every property GitHub's
+# code-scanning ingest requires.
+SARIF_SUBSET_SCHEMA = {
+    "type": "object",
+    "required": ["version", "runs"],
+    "properties": {
+        "version": {"const": "2.1.0"},
+        "$schema": {"type": "string"},
+        "runs": {
+            "type": "array",
+            "minItems": 1,
+            "items": {
+                "type": "object",
+                "required": ["tool", "results"],
+                "properties": {
+                    "tool": {
+                        "type": "object",
+                        "required": ["driver"],
+                        "properties": {
+                            "driver": {
+                                "type": "object",
+                                "required": ["name"],
+                                "properties": {
+                                    "name": {"type": "string"},
+                                    "rules": {
+                                        "type": "array",
+                                        "items": {
+                                            "type": "object",
+                                            "required": ["id"],
+                                        },
+                                    },
+                                },
+                            }
+                        },
+                    },
+                    "results": {
+                        "type": "array",
+                        "items": {
+                            "type": "object",
+                            "required": ["ruleId", "message"],
+                            "properties": {
+                                "level": {
+                                    "enum": [
+                                        "none", "note", "warning", "error",
+                                    ]
+                                },
+                                "message": {
+                                    "type": "object",
+                                    "required": ["text"],
+                                },
+                                "locations": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "object",
+                                        "required": ["physicalLocation"],
+                                        "properties": {
+                                            "physicalLocation": {
+                                                "type": "object",
+                                                "required": [
+                                                    "artifactLocation"
+                                                ],
+                                                "properties": {
+                                                    "artifactLocation": {
+                                                        "type": "object",
+                                                        "required": ["uri"],
+                                                    },
+                                                    "region": {
+                                                        "type": "object",
+                                                        "properties": {
+                                                            "startLine": {
+                                                                "type": "integer",
+                                                                "minimum": 1,
+                                                            }
+                                                        },
+                                                    },
+                                                },
+                                            }
+                                        },
+                                    },
+                                },
+                            },
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def _validate_sarif(payload: dict) -> None:
+    try:
+        import jsonschema
+    except ImportError:
+        # structural fallback: the same required-property walk by hand
+        assert payload["version"] == "2.1.0"
+        run = payload["runs"][0]
+        assert run["tool"]["driver"]["name"]
+        for r in run["results"]:
+            assert r["ruleId"] and r["message"]["text"]
+            loc = r["locations"][0]["physicalLocation"]
+            assert loc["artifactLocation"]["uri"]
+            assert loc["region"]["startLine"] >= 1
+        return
+    jsonschema.validate(payload, SARIF_SUBSET_SCHEMA)
+
+
+def test_sarif_export_validates_and_carries_findings():
+    from kcmc_tpu.analysis.sarif import to_sarif
+
+    idx = ModuleIndex.from_sources({"kcmc_tpu/io/counter.py": RACE_BAD})
+    res = run_passes(idx, [RacePass()])
+    payload = to_sarif(res)
+    _validate_sarif(payload)
+    results = payload["runs"][0]["results"]
+    assert any(r["ruleId"] == "race" for r in results)
+    race = next(r for r in results if r["ruleId"] == "race")
+    assert race["level"] == "error"
+    uri = race["locations"][0]["physicalLocation"]["artifactLocation"]["uri"]
+    assert uri == "kcmc_tpu/io/counter.py"
+    # baselined findings do NOT annotate PRs
+    bl = Baseline(
+        [
+            BaselineEntry(
+                "race", "kcmc_tpu/io/counter.py",
+                "possible data race on 'Counter._n'", "fixture",
+            )
+        ]
+    )
+    res2 = run_passes(idx, [RacePass()], bl)
+    assert to_sarif(res2)["runs"][0]["results"] == []
+
+
+def test_cli_sarif_of_repo_is_schema_valid(tmp_path, capsys):
+    from kcmc_tpu.analysis.cli import main as check_main
+
+    out = tmp_path / "check.sarif"
+    rc = check_main(["--root", REPO_ROOT, "--sarif", str(out)])
+    capsys.readouterr()
+    assert rc == 0
+    payload = json.loads(out.read_text())
+    _validate_sarif(payload)
+    # repo is clean vs baseline -> no PR annotations
+    assert payload["runs"][0]["results"] == []
+
+
+# -- --prune-baseline --------------------------------------------------------
+
+
+def test_prune_baseline_drops_stale_keeps_live(tmp_path, capsys):
+    from kcmc_tpu.analysis.cli import main as check_main
+
+    root = tmp_path / "repo"
+    (root / "kcmc_tpu").mkdir(parents=True)
+    (root / "kcmc_tpu" / "warm.py").write_text(DAEMON_XLA)
+    bl = tmp_path / "bl.json"
+    bl.write_text(
+        json.dumps(
+            {
+                "kind": "kcmc_check_baseline",
+                "entries": [
+                    {
+                        "rule": "daemon-xla",
+                        "path": "kcmc_tpu/warm.py",
+                        "match": "daemon thread 'warm' reaches jax "
+                        "compile/dispatch",
+                        "reason": "fixture",
+                    },
+                    {
+                        "rule": "config-registry",
+                        "path": "kcmc_tpu/config.py",
+                        "match": "config module not found",
+                        "reason": "fixture package has no config module",
+                    },
+                    {
+                        "rule": "span-registry",
+                        "path": "kcmc_tpu/obs/registry.py",
+                        "match": "canonical registry not found",
+                        "reason": "fixture package has no registry",
+                    },
+                    {
+                        "rule": "race",
+                        "path": "kcmc_tpu/gone.py",
+                        "match": "possible data race on 'Gone.x'",
+                        "reason": "was fixed long ago",
+                    },
+                ],
+            }
+        )
+    )
+    rc = check_main(
+        ["--root", str(root), "--baseline", str(bl), "--prune-baseline"]
+    )
+    err = capsys.readouterr().err
+    assert "pruned 1 stale baseline entry" in err
+    assert rc == 0
+    data = json.loads(bl.read_text())
+    assert [e["rule"] for e in data["entries"]] == [
+        "daemon-xla", "config-registry", "span-registry"
+    ]
+    # pruning is idempotent
+    rc = check_main(
+        ["--root", str(root), "--baseline", str(bl), "--prune-baseline"]
+    )
+    assert "pruned 0 stale baseline entries" in capsys.readouterr().err
+    assert rc == 0
 
 
 def test_write_baseline_roundtrip(tmp_path, capsys):
