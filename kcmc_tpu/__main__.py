@@ -504,6 +504,8 @@ def _cmd_check(args) -> int:
         argv.append("--prune-baseline")
     if args.sarif:
         argv += ["--sarif", args.sarif]
+    if args.no_cache:
+        argv.append("--no-cache")
     return check_main(argv)
 
 
@@ -869,8 +871,10 @@ def main(argv=None) -> int:
         help="static repo invariant checker: config-signature "
         "registry, jit purity, lock/thread discipline, span registry, "
         "thread-root inventory, whole-program race detection, "
-        "resource lifecycle — exit 0 unless a NEW (non-baselined) "
-        "finding appears (docs/ANALYSIS.md)",
+        "resource lifecycle, trace-contract flow (retrace/dtype/"
+        "transfer/bucket-escape), buffer-donation audit — exit 0 "
+        "unless a NEW (non-baselined) finding appears "
+        "(docs/ANALYSIS.md)",
     )
     p.add_argument(
         "--root", default="",
@@ -900,6 +904,11 @@ def main(argv=None) -> int:
         "--sarif", default="", metavar="PATH",
         help="also write new findings as a SARIF 2.1.0 log for GitHub "
         "code-scanning PR annotations ('-' = stdout)",
+    )
+    p.add_argument(
+        "--no-cache", action="store_true",
+        help="bypass the content-hash result cache "
+        "(.kcmc_check_cache/) and re-run every pass",
     )
     p.set_defaults(fn=_cmd_check)
 

@@ -1,7 +1,7 @@
 """Static + runtime analysis for the kcmc_tpu repo itself
 (`kcmc check` / `kcmc sanitize`; docs/ANALYSIS.md).
 
-Seven repo-specific passes over a shared module index enforce the
+Nine repo-specific passes over a shared module index enforce the
 contracts that previously lived only in comments:
 
 * ``config-registry`` — every `CorrectorConfig` field classified as
@@ -18,12 +18,27 @@ contracts that previously lived only in comments:
   accesses from concurrent roots with disjoint lock sets, with
   program-wide lock identity (Condition/constructor-param aliasing);
 * ``resource-lifecycle`` — every acquired thread/pool/socket/file/
-  telemetry resource reaches its release on all paths.
+  telemetry resource reaches its release on all paths;
+* ``traceflow`` (rule families ``retrace`` / ``dtype-flow`` /
+  ``transfer`` / ``bucket-escape``) — whole-program shape/dtype/
+  placement flow from every jit entry: trace-time branching and
+  per-call captures, silent wide-dtype promotion, dispatch-window
+  host transfers with bytes estimates, and jit dispatches whose
+  shapes escape the `plan_buckets` ladder;
+* ``donation`` — jitted programs whose input buffer dies at the call
+  site and matches an output's shape get donation-candidate findings
+  (`donate_argnums`); the register/apply frame programs carry the
+  contract as a checked keyword.
 
 The runtime half (`analysis/sanitize.py`, behind `kcmc sanitize` /
 `KCMC_SANITIZE=1` / `pytest --sanitize`) instruments real locks,
 validates executed acquisition order against the static lock-order
-graph, watches for deadlocks, and leak-checks each test.
+graph, watches for deadlocks, leak-checks each test, and hosts the
+RETRACE SENTINEL: per-program compile counts from plans/runtime.py
+validated against the static bucket-ladder prediction — a warmed
+process compiling a covered program again fails the gate.
+Results are content-hash cached under `.kcmc_check_cache/`
+(`analysis/cache.py`; `kcmc check --no-cache` bypasses).
 
 Stdlib-only on purpose: the checker runs before (and without) jax.
 """

@@ -23,10 +23,12 @@ import sys
 def default_passes():
     from kcmc_tpu.analysis.concurrency import RacePass, ThreadRootsPass
     from kcmc_tpu.analysis.config_registry import ConfigRegistryPass
+    from kcmc_tpu.analysis.donation import DonationPass
     from kcmc_tpu.analysis.jit_purity import JitPurityPass
     from kcmc_tpu.analysis.lifecycle import ResourceLifecyclePass
     from kcmc_tpu.analysis.lock_discipline import LockDisciplinePass
     from kcmc_tpu.analysis.span_registry import SpanRegistryPass
+    from kcmc_tpu.analysis.traceflow import TraceFlowPass
 
     return [
         ConfigRegistryPass(),
@@ -36,6 +38,8 @@ def default_passes():
         ThreadRootsPass(),
         RacePass(),
         ResourceLifecyclePass(),
+        TraceFlowPass(),
+        DonationPass(),
     ]
 
 
@@ -62,14 +66,19 @@ def run_check(
     root: str,
     baseline_path: str | None = None,
     passes=None,
+    use_cache: bool = True,
 ):
+    from kcmc_tpu.analysis.cache import CheckCache
     from kcmc_tpu.analysis.core import Baseline, ModuleIndex, run_passes
 
     index = ModuleIndex.from_package(root)
     bl_path = baseline_path or default_baseline_path()
     baseline = Baseline.load(bl_path) if os.path.exists(bl_path) else None
     return run_passes(
-        index, passes if passes is not None else default_passes(), baseline
+        index,
+        passes if passes is not None else default_passes(),
+        baseline,
+        cache=CheckCache(root) if use_cache else None,
     )
 
 
@@ -80,7 +89,8 @@ def main(argv=None) -> int:
             "AST-based repo invariant checker: config-signature "
             "registry, jit purity, lock/thread discipline, span "
             "registry, thread-root inventory, whole-program race "
-            "detection, resource lifecycle (docs/ANALYSIS.md)"
+            "detection, resource lifecycle, trace-contract flow, and "
+            "the buffer-donation audit (docs/ANALYSIS.md)"
         ),
     )
     ap.add_argument(
@@ -101,6 +111,14 @@ def main(argv=None) -> int:
         "--json",
         action="store_true",
         help="machine-readable report on stdout (kind: kcmc_check)",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help=(
+            "bypass the content-hash result cache "
+            "(.kcmc_check_cache/) and re-run every pass"
+        ),
     )
     ap.add_argument(
         "--write-baseline",
@@ -155,7 +173,9 @@ def main(argv=None) -> int:
         return 2
 
     try:
-        result = run_check(root, baseline_path=bl_path)
+        result = run_check(
+            root, baseline_path=bl_path, use_cache=not args.no_cache
+        )
     except (ValueError, KeyError, OSError) as e:
         # a hand-edited baseline with bad JSON / wrong kind / missing
         # entry fields is a usage error (exit 2), not "new findings"
@@ -219,7 +239,9 @@ def main(argv=None) -> int:
         if pruned:
             # the pruned file is the new truth: re-evaluate the gate so
             # a prune run reports the same exit the next plain run would
-            result = run_check(root, baseline_path=bl_path)
+            result = run_check(
+            root, baseline_path=bl_path, use_cache=not args.no_cache
+        )
 
     if args.sarif:
         from kcmc_tpu.analysis.sarif import to_sarif

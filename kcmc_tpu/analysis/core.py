@@ -463,15 +463,24 @@ class CheckResult:
 
 
 def run_passes(
-    index: ModuleIndex, passes: list, baseline: Baseline | None = None
+    index: ModuleIndex,
+    passes: list,
+    baseline: Baseline | None = None,
+    cache=None,
 ) -> CheckResult:
     """Run every pass over the shared index and gate against the
-    baseline. Findings sort by (path, line, rule) for stable output."""
+    baseline. Findings sort by (path, line, rule) for stable output.
+    `cache` (analysis/cache.CheckCache) replays content-hash-matched
+    results instead of re-running a pass; baseline splitting always
+    happens fresh."""
     findings = list(index.parse_errors)
     names = []
     for p in passes:
         names.append(p.name)
-        findings.extend(p.run(index))
+        if cache is not None:
+            findings.extend(cache.findings_for(p, index))
+        else:
+            findings.extend(p.run(index))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     bl = baseline or Baseline()
     new, accepted = bl.split(findings)

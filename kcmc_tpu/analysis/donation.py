@@ -1,0 +1,481 @@
+"""Pass 9 — buffer-donation audit (`donation`; docs/ANALYSIS.md).
+
+XLA can write a jitted program's output into the buffer of a donated
+input (`donate_argnums`) when shape/dtype/layout match — for this
+repo's frame programs that is the difference between holding ONE frame
+batch in device memory per in-flight dispatch and holding two. Before
+this pass, `donate_argnums` appeared nowhere in the repo: every warp /
+register / template-blend call double-allocated its frame batch.
+
+Two rules, both emitting `donation` findings:
+
+* **generic candidates** — a jitted function with no
+  `donate_argnums`/`donate_argnames` whose RETURN provably shares an
+  input parameter's shape (the proof walks elementwise chains:
+  `jnp.where`/`clip`/`rint`/arithmetic, local helper calls; any
+  `.astype` breaks the chain because donation also needs the dtype to
+  match), called at a site where the argument DIES (a temporary
+  expression, or a local name never read after the call). That input
+  buffer is reusable and currently is not.
+* **frame-program contract** — the plan-accounted hot programs
+  ("register" via `_instrument_program`, "apply" via the
+  `maybe_timed("apply", …)` builders) return a same-shape corrected
+  frame batch by DOCUMENTED contract (the static proof cannot cross
+  the Pallas / `functools.partial` kernel seam — the parity suites pin
+  the contract instead). Their `jax.jit(...)` constructions must carry
+  a `donate_argnums` keyword; a conditional value
+  (`donate_argnums=(0,) if donate else ()`) satisfies the rule — the
+  decision is then visible and owned by the call site.
+
+A candidate is an invitation, not an order: donation is only safe when
+the caller OWNS the buffer (nothing else reads it afterwards). The
+`update_reference` template blend is the worked rejection example — the
+old template buffer stays readable by in-flight dispatch entries and
+the checkpoint template history, so its finding is baselined with that
+justification rather than fixed (docs/PERFORMANCE.md "Retracing &
+transfer anatomy").
+"""
+
+from __future__ import annotations
+
+import ast
+
+from kcmc_tpu.analysis.callgraph import ProgramGraph
+from kcmc_tpu.analysis.core import Finding, Module, ModuleIndex, attr_chain
+from kcmc_tpu.analysis.traceflow import find_jit_roots
+
+DEFAULT_PREFIXES = (
+    "kcmc_tpu/backends/jax_backend.py",
+    "kcmc_tpu/plans/",
+    "kcmc_tpu/parallel/",
+    "kcmc_tpu/ops/",
+)
+
+# Shape-preserving elementwise vocabulary for the same-shape proof.
+ELEMENTWISE = frozenset(
+    {
+        "where", "clip", "rint", "abs", "minimum", "maximum", "add",
+        "subtract", "multiply", "divide", "exp", "log", "sqrt",
+        "negative", "floor", "ceil", "round", "nan_to_num", "sign",
+        "tanh", "square", "positive",
+    }
+)
+
+DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+# Plan programs whose leading array argument is a frame batch that an
+# output matches by documented contract (module docstring).
+FRAME_PROGRAMS = frozenset({"register", "apply"})
+
+
+def _has_donate_kwarg(call: ast.Call) -> bool:
+    return any(kw.arg in DONATE_KWARGS for kw in call.keywords)
+
+
+def _donated_decorator(fn: ast.FunctionDef) -> bool:
+    for dec in fn.decorator_list:
+        if isinstance(dec, ast.Call) and _has_donate_kwarg(dec):
+            return True
+    return False
+
+
+# -- generic same-shape proof ------------------------------------------------
+
+
+class _ShapeTokens:
+    """Which parameters' shapes a function's return provably shares."""
+
+    def __init__(self, graph: ProgramGraph, path: str):
+        self.graph = graph
+        self.path = path
+
+    def donatable_params(self, fn: ast.FunctionDef, depth: int = 0) -> set:
+        params = [a.arg for a in fn.args.args if a.arg != "self"]
+        env: dict[str, set] = {p: {p} for p in params}
+        nested: set[int] = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            ):
+                nested.update(id(sub) for sub in ast.walk(n))
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if id(node) in nested or not isinstance(node, ast.Assign):
+                    continue
+                toks = self._tokens(node.value, env, depth)
+                if not toks:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        env[t.id] = env.get(t.id, set()) | toks
+        out: set = set()
+        for node in ast.walk(fn):
+            if id(node) in nested or not isinstance(node, ast.Return):
+                continue
+            if node.value is not None:
+                out |= self._return_tokens(node.value, env, depth)
+        return out & set(params)
+
+    def _return_tokens(self, node, env, depth) -> set:
+        if isinstance(node, ast.Dict):
+            out: set = set()
+            for v in node.values:
+                out |= self._tokens(v, env, depth)
+            return out
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = set()
+            for e in node.elts:
+                out |= self._tokens(e, env, depth)
+            return out
+        return self._tokens(node, env, depth)
+
+    def _tokens(self, node, env, depth) -> set:
+        if isinstance(node, ast.Name):
+            return set(env.get(node.id, ()))
+        if isinstance(node, ast.BinOp):
+            return self._tokens(node.left, env, depth) | self._tokens(
+                node.right, env, depth
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._tokens(node.operand, env, depth)
+        if isinstance(node, ast.IfExp):
+            return self._tokens(node.body, env, depth) | self._tokens(
+                node.orelse, env, depth
+            )
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1]
+            if tail == "astype":  # dtype may change: donation needs both
+                return set()
+            if tail in ELEMENTWISE:
+                args = node.args[1:] if tail == "where" else node.args
+                out: set = set()
+                for a in args:
+                    out |= self._tokens(a, env, depth)
+                return out
+            if depth < 3:
+                ref = self.graph.resolve_in_module(self.path, chain)
+                if ref is not None and ref.cls is None:
+                    target = self.graph.function(ref)
+                    if target is not None:
+                        inner = _ShapeTokens(
+                            self.graph, ref.path
+                        ).donatable_params(target, depth + 1)
+                        if inner:
+                            # map callee param tokens back to our args
+                            params = [
+                                a.arg
+                                for a in target.args.args
+                                if a.arg != "self"
+                            ]
+                            out = set()
+                            for i, a in enumerate(node.args):
+                                if i < len(params) and params[i] in inner:
+                                    out |= self._tokens(a, env, depth)
+                            for kw in node.keywords:
+                                if kw.arg in inner:
+                                    out |= self._tokens(kw.value, env, depth)
+                            return out
+            return set()
+        return set()
+
+
+# Dtype-scalar constructors: donating a 0-d scalar saves nothing, so
+# call-site arguments built through these never make a candidate.
+SCALAR_CTORS = frozenset(
+    {
+        "float32", "float64", "bfloat16", "float16", "int8", "int16",
+        "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+        "bool_", "float", "int", "bool",
+    }
+)
+
+
+def _arg_liveness(call_arg: ast.AST, call: ast.Call, host_fn) -> str | None:
+    """None = the argument may be read after the call (no finding);
+    otherwise a short description of why the buffer dies here."""
+    node = call_arg
+    alias = ""
+    if isinstance(node, ast.Constant):
+        return None
+    if (
+        isinstance(node, ast.Call)
+        and attr_chain(node.func).rsplit(".", 1)[-1] in SCALAR_CTORS
+    ):
+        return None
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        if chain.rsplit(".", 1)[-1] in ("asarray", "array") and node.args:
+            base = node.args[0]
+            if isinstance(base, ast.Name):
+                node = base
+                alias = " (through jnp.asarray)"
+            else:
+                return (
+                    "a temporary that may alias a live container entry - "
+                    "donation requires ownership of the buffer"
+                )
+        else:
+            return "a temporary expression"
+    if isinstance(node, ast.Subscript):
+        return None  # container entry stays reachable
+    if not isinstance(node, ast.Name):
+        return None
+    name = node.id
+    # A call inside a loop makes every read in that loop "after" it on
+    # the next iteration, regardless of line order — count the whole
+    # loop body as live territory.
+    loop_scopes: list[ast.AST] = []
+    for n in ast.walk(host_fn):
+        if isinstance(n, (ast.For, ast.While)) and any(
+            sub is call for sub in ast.walk(n)
+        ):
+            loop_scopes.append(n)
+
+    def _reads(scope, after_line: int) -> bool:
+        for n in ast.walk(scope):
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id == name
+                and n.lineno > after_line
+            ):
+                return True
+        return False
+
+    if _reads(host_fn, call.lineno):
+        return None
+    if any(_reads(scope, 0) for scope in loop_scopes):
+        return None
+    return f"local '{name}' is never read after the call{alias}"
+
+
+# -- the pass ----------------------------------------------------------------
+
+
+class DonationPass:
+    name = "donation"
+
+    def __init__(self, module_prefixes: tuple[str, ...] = DEFAULT_PREFIXES):
+        self.module_prefixes = module_prefixes
+
+    def run(self, index: ModuleIndex) -> list[Finding]:
+        graph = ProgramGraph.for_index(index)
+        out: list[Finding] = []
+
+        def emit(path, line, message, detail, severity="warning"):
+            out.append(
+                Finding(
+                    rule=self.name,
+                    path=path,
+                    line=line,
+                    severity=severity,
+                    message=message,
+                    detail=detail,
+                )
+            )
+
+        mods = [
+            m
+            for m in index
+            if any(m.path.startswith(p) for p in self.module_prefixes)
+        ]
+        for mod in mods:
+            self._generic(mod, graph, mods, emit)
+            self._contract(mod, graph, emit)
+        uniq: dict[tuple, Finding] = {}
+        for f in out:
+            uniq.setdefault((f.path, f.line, f.message), f)
+        return list(uniq.values())
+
+    # -- generic candidates -------------------------------------------
+
+    def _generic(self, mod: Module, graph, mods, emit) -> None:
+        for root in find_jit_roots(mod, graph):
+            fn = root.fn
+            if _donated_decorator(fn):
+                continue
+            # jax.jit(f, donate_argnums=...) call-site form
+            donated = False
+            for node in ast.walk(mod.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and attr_chain(node.func).rsplit(".", 1)[-1] == "jit"
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == fn.name
+                    and _has_donate_kwarg(node)
+                ):
+                    donated = True
+            if donated:
+                continue
+            cands = _ShapeTokens(graph, mod.path).donatable_params(fn)
+            if not cands:
+                continue
+            params = [a.arg for a in fn.args.args if a.arg != "self"]
+            for m in mods:
+                self._check_call_sites(
+                    m, graph, fn, mod.path, params, cands, emit
+                )
+
+    def _check_call_sites(
+        self, mod, graph, jit_fn, def_path, params, cands, emit
+    ) -> None:
+        table = graph.tables[mod.path]
+        host_fns = [
+            f
+            for fns in table.functions.values()
+            for f in fns
+            if f is not jit_fn
+        ]
+        for host in host_fns:
+            for node in ast.walk(host):
+                if not isinstance(node, ast.Call):
+                    continue
+                chain = attr_chain(node.func)
+                if chain.rsplit(".", 1)[-1] != jit_fn.name:
+                    continue
+                ref = graph.resolve_in_module(mod.path, chain)
+                if ref is not None and (
+                    ref.path != def_path or ref.name != jit_fn.name
+                ):
+                    continue
+                bound: dict[str, ast.AST] = {}
+                for i, a in enumerate(node.args):
+                    if i < len(params):
+                        bound[params[i]] = a
+                for kw in node.keywords:
+                    if kw.arg:
+                        bound[kw.arg] = kw.value
+                for p in sorted(cands):
+                    arg = bound.get(p)
+                    if arg is None:
+                        continue
+                    why = _arg_liveness(arg, node, host)
+                    if why is None:
+                        continue
+                    emit(
+                        def_path,
+                        jit_fn.lineno,
+                        f"donation candidate: jitted '{jit_fn.name}' "
+                        f"double-allocates '{p}'",
+                        f"an output shares '{p}' shape/dtype and the "
+                        f"{mod.path}:{node.lineno} call site's argument "
+                        f"dies ({why}) - donate_argnums would let XLA "
+                        "reuse the buffer",
+                    )
+
+    # -- frame-program contract ---------------------------------------
+
+    def _contract(self, mod: Module, graph, emit) -> None:
+        table = graph.tables[mod.path]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1]
+            if tail == "_instrument_program" and node.args:
+                pname = (
+                    node.args[0].value
+                    if isinstance(node.args[0], ast.Constant)
+                    else None
+                )
+                if pname in FRAME_PROGRAMS and len(node.args) >= 3:
+                    self._check_builder(
+                        mod, graph, table, pname, node.args[2], node, emit
+                    )
+            if tail in ("maybe_timed", "timed") and node.args:
+                pname = (
+                    node.args[0].value
+                    if isinstance(node.args[0], ast.Constant)
+                    else None
+                )
+                if pname in FRAME_PROGRAMS:
+                    self._check_timed_block(mod, graph, pname, node, emit)
+
+    def _check_builder(
+        self, mod, graph, table, pname, build_expr, site, emit
+    ) -> None:
+        """The build expression of an instrumented frame program: a
+        direct jax.jit(...) call, or a self-method whose body holds
+        one. Every jit construction found must donate."""
+        targets: list[ast.AST] = []
+        if isinstance(build_expr, ast.Call):
+            chain = attr_chain(build_expr.func)
+            if chain.rsplit(".", 1)[-1] == "jit":
+                targets = [build_expr]
+            elif chain.startswith("self."):
+                meth = chain.split(".")[-1]
+                for cls_methods in table.methods.values():
+                    fn = cls_methods.get(meth)
+                    if fn is not None:
+                        targets.extend(
+                            n
+                            for n in ast.walk(fn)
+                            if isinstance(n, ast.Call)
+                            and attr_chain(n.func).rsplit(".", 1)[-1]
+                            == "jit"
+                        )
+        for t in targets:
+            if not _has_donate_kwarg(t):
+                emit(
+                    mod.path,
+                    t.lineno,
+                    f"frame program '{pname}' compiles without "
+                    "donate_argnums",
+                    "its corrected-frame output matches the input "
+                    "batch by contract; the batch buffer is "
+                    "double-allocated per in-flight dispatch "
+                    "(docs/PERFORMANCE.md)",
+                )
+
+    def _check_timed_block(self, mod, graph, pname, timed_call, emit) -> None:
+        """Calls inside a maybe_timed(<frame program>) accounting block
+        resolve to their builders; jit constructions there must donate."""
+        with_node = None
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.With) and any(
+                item.context_expr is timed_call
+                or (
+                    isinstance(item.context_expr, ast.Call)
+                    and item.context_expr is timed_call
+                )
+                for item in node.items
+            ):
+                with_node = node
+        # maybe_timed may be assigned to a ctx variable instead of used
+        # inline; fall back to scanning the enclosing function
+        scope = with_node
+        if scope is None:
+            for fns in graph.tables[mod.path].functions.values():
+                for fn in fns:
+                    if any(sub is timed_call for sub in ast.walk(fn)):
+                        scope = fn
+        if scope is None:
+            return
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Call) or node is timed_call:
+                continue
+            ref = graph.resolve_in_module(mod.path, attr_chain(node.func))
+            if ref is None or ref.cls is not None:
+                continue
+            target = graph.function(ref)
+            if target is None:
+                continue
+            for jit_call in ast.walk(target):
+                if (
+                    isinstance(jit_call, ast.Call)
+                    and attr_chain(jit_call.func).rsplit(".", 1)[-1]
+                    == "jit"
+                    and not _has_donate_kwarg(jit_call)
+                ):
+                    emit(
+                        ref.path,
+                        jit_call.lineno,
+                        f"frame program '{pname}' compiles without "
+                        f"donate_argnums (via {ref.name})",
+                        "its resampled output matches the input batch "
+                        "by contract; the batch buffer is "
+                        "double-allocated (docs/PERFORMANCE.md)",
+                    )

@@ -150,6 +150,10 @@ def _is_const(node: ast.AST) -> bool:
 
 class JitPurityPass:
     name = "jit-purity"
+    # Each module's findings depend only on that module's source (the
+    # call graph is deliberately local), so the check cache can replay
+    # unchanged modules (analysis/cache.py).
+    cache_scope = "module"
 
     def __init__(
         self,

@@ -300,6 +300,9 @@ def _reaches_xla(
 
 class LockDisciplinePass:
     name = "lock-discipline"
+    # Per-class models over one module's AST: module-scoped for the
+    # check cache (analysis/cache.py).
+    cache_scope = "module"
 
     def run(self, index: ModuleIndex) -> list[Finding]:
         out: list[Finding] = []

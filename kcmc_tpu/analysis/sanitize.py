@@ -547,12 +547,13 @@ def disable() -> None:
 
 def take_violations() -> list[str]:
     """Drain the accumulated violations (lock-order cycles, deadlock
-    suspects) — the per-test gate."""
+    suspects, retrace-sentinel convictions) — the per-test gate."""
+    out = _drain_sentinel()
     st = _STATE
     if st is None:
-        return []
+        return out
     with st.mutex:
-        out, st.violations = st.violations, []
+        out, st.violations = out + st.violations, []
     return out
 
 
@@ -681,6 +682,149 @@ def check_leaks(before: dict, grace_s: float = 2.0) -> list[str]:
                     "never closed"
                 )
     return leaks
+
+
+# -- retrace sentinel --------------------------------------------------------
+#
+# The runtime half of the traceflow bucket-escape rule (docs/
+# ANALYSIS.md): plans/runtime.py notes every first program build
+# (jit_compile / plan_build) here. The static bucket ladder predicts
+# the full compile-key set of a warmed process (plans.runtime.
+# predict_compile_keys); arming the sentinel after warm-up turns any
+# further compile of a COVERED program into a violation — the exact
+# cross-validation the lock-order checker does for concurrency: one
+# static prediction plus one runtime observation convicts, no profiler
+# archaeology required. Violations drain through take_violations(), so
+# `pytest --sanitize` fails the test that retraced.
+
+
+class _RetraceSentinel:
+    def __init__(self, covered, predicted, label):
+        self.covered = frozenset(covered)
+        self.predicted = frozenset(predicted or ())
+        self.label = label
+        self.counts: dict[tuple, int] = {}
+        self.violations: list[str] = []
+        self.lock = _REAL_LOCK()
+
+
+_SENTINEL: "_RetraceSentinel | None" = None
+
+DEFAULT_COVERED_PROGRAMS = ("reference", "register", "apply")
+
+
+def note_compile(
+    program: str,
+    shape: tuple,
+    dtype: str,
+    rung: str = "full",
+    during_build: bool = False,
+) -> None:
+    """Compile observation hook (called by plans/runtime.PlanRuntime on
+    every first build of a program key). No-op unless a sentinel is
+    armed; builds driven by ExecutionPlan (`during_build`) are the
+    warm-up itself and never convict."""
+    st = _SENTINEL
+    if st is None:
+        return
+    key = (program, tuple(shape), str(dtype))
+    with st.lock:
+        st.counts[key] = st.counts.get(key, 0) + 1
+    if during_build or program not in st.covered:
+        return
+    shape_s = "x".join(str(s) for s in shape)
+    hint = ""
+    if st.predicted:
+        hint = (
+            " - the static bucket ladder predicted "
+            f"{len(st.predicted)} compile keys, all already warmed"
+            if key not in st.predicted
+            else " - a predicted key compiled AGAIN after warm-up"
+        )
+    msg = (
+        f"retrace sentinel{f' [{st.label}]' if st.label else ''}: "
+        f"program '{program}' compiled at {shape_s}/{dtype} (rung "
+        f"{rung}) after warm-up{hint}; the dispatched shape escaped "
+        "the plan_buckets ladder"
+    )
+    with st.lock:
+        st.violations.append(msg)
+    print(f"[kcmc sanitize] {msg}", file=sys.stderr)
+
+
+def arm_retrace_sentinel(
+    covered=DEFAULT_COVERED_PROGRAMS, predicted=None, label: str = ""
+) -> None:
+    """Arm after warm-up: from now on, any compile of a covered program
+    is a violation. `predicted` (a predict_compile_keys set) only
+    sharpens the message — armed-after-warm-up means the allowed count
+    is zero either way."""
+    global _SENTINEL
+    _SENTINEL = _RetraceSentinel(covered, predicted, label)
+
+
+def disarm_retrace_sentinel() -> None:
+    global _SENTINEL
+    _SENTINEL = None
+
+
+class retrace_sentinel:
+    """Context manager: `with sanitize.retrace_sentinel(...):` around
+    warmed traffic. Violations recorded inside the block stay pending
+    for take_violations() (the `pytest --sanitize` per-test gate), so
+    the with-block arms and disarms without swallowing the report."""
+
+    def __init__(
+        self,
+        covered=DEFAULT_COVERED_PROGRAMS,
+        predicted=None,
+        label: str = "",
+    ):
+        self._args = (covered, predicted, label)
+
+    def __enter__(self):
+        arm_retrace_sentinel(*self._args)
+        return _SENTINEL
+
+    def __exit__(self, *exc):
+        st = _SENTINEL
+        if st is not None and st.violations:
+            with st.lock:
+                pending, st.violations = list(st.violations), []
+            _pending_sentinel_violations.extend(pending)
+        disarm_retrace_sentinel()
+        return False
+
+
+# violations that outlive a disarmed sentinel, drained with the state's
+_pending_sentinel_violations: list[str] = []
+
+
+def sentinel_stats() -> dict:
+    st = _SENTINEL
+    if st is None:
+        return {"armed": False}
+    with st.lock:
+        return {
+            "armed": True,
+            "covered": sorted(st.covered),
+            "compiles": {
+                f"{p}|{'x'.join(str(s) for s in shape)}|{dt}": n
+                for (p, shape, dt), n in sorted(st.counts.items())
+            },
+            "violations": len(st.violations),
+        }
+
+
+def _drain_sentinel() -> list[str]:
+    out = list(_pending_sentinel_violations)
+    _pending_sentinel_violations.clear()
+    st = _SENTINEL
+    if st is not None:
+        with st.lock:
+            out += st.violations
+            st.violations = []
+    return out
 
 
 # -- env / CLI entry ---------------------------------------------------------

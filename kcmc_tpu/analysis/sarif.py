@@ -29,6 +29,11 @@ _RULE_HELP = {
     "thread-roots": "concurrent entry points named and resolvable",
     "race": "cross-root shared access needs intersecting lock sets",
     "resource-lifecycle": "acquired resources reach release on all paths",
+    "retrace": "no trace-time branching/capture of per-call values",
+    "dtype-flow": "no silent wide-dtype promotion or upload widening",
+    "transfer": "no host transfers inside the dispatch window",
+    "bucket-escape": "jit dispatch shapes stay on the plan_buckets ladder",
+    "donation": "dying same-shape jit inputs should donate their buffer",
     "baseline": "baseline entries stay justified and live",
     "parse": "sources must parse",
 }

@@ -1,0 +1,868 @@
+"""Pass 8 — trace-contract analysis (`retrace` / `dtype-flow` /
+`transfer` / `bucket-escape`; docs/ANALYSIS.md).
+
+PR 8's `jit-purity` answers "does traced code do host work?" for what a
+reader of ONE file can see. The latency spread ROADMAP item 4 chases
+lives one level up, in the *contract between host orchestration and the
+compiled programs*: a Python branch on a traced value retraces per
+call, a silent float64/bf16 promotion doubles (or corrupts) a hot
+buffer, a host transfer inside the dispatch window serializes the
+pipeline, and a jit entry whose argument shape escapes the
+`plan_buckets` ladder compiles per caller shape forever. None of those
+are visible module-locally.
+
+This pass propagates a symbolic (traced?, dtype, placement) lattice
+from every `jax.jit` / `pjit` / `shard_map` entry in the configured
+modules through CROSS-MODULE call edges (the PR-11 `ProgramGraph`),
+with per-call-site argument masks — a callee's parameter is traced
+only when a traced value actually flows into it, so `detect_keypoints
+(frame, threshold=cfg.detect_threshold)` keeps `threshold` static and
+its trace-time branches legal. Four rule families:
+
+* **retrace** — Python `if`/`while` on a value derived from traced
+  array CONTENTS (`is None` identity tests and `.shape`/`.dtype`/
+  `.ndim` reads are trace-time static and exempt), `range()` over a
+  traced value, closures that bake per-call host values (`time.*`,
+  unseeded `random.*`, `os.environ`) into the trace as constants, and
+  static-argnum candidates (parameters used only at trace time).
+* **dtype-flow** — explicit float64/complex128 inside traced code
+  (silently float32 without x64, silently 2x bytes with it), bf16
+  accumulation without an explicit accumulator dtype
+  (`preferred_element_type=` / `precision=`), and host-side widening
+  casts on the upload path (`jnp.asarray(frames, jnp.float32)` in the
+  dispatch window doubles the host->device bytes of an integer stack —
+  upload native, cast on device).
+* **transfer** — device->host crossings inside the DISPATCH WINDOW
+  (`np.asarray` / `np.array` / `jax.device_get` / `.item()` /
+  `jax.tree.map(np.asarray, …)` in the per-batch methods), each with a
+  bytes-per-frame estimate from the symbolic shape vocabulary.
+  `copy_to_host_async` is the declared overlap path and never flagged;
+  setup-scope methods (`prepare_reference`, `__init__`, warm-up) may
+  transfer freely — that cost is amortized.
+* **bucket-escape** — a jitted callable dispatched from the window
+  whose argument shape is the CALLER's shape, in a function that never
+  consults the bucket ladder (`plan.route` / `route_shape`) nor
+  accounts the dispatch (`maybe_timed` / `timed` / `note_route`):
+  every new caller shape is a fresh silent XLA compile. Cross-checked
+  against `plans/buckets.py` routing so the accounted fallback path in
+  `process_batch_async` stays quiet. The runtime retrace sentinel
+  (analysis/sanitize.py + plans/runtime.py) is this rule's dynamic
+  half: the static ladder predicts the compile-key set, the sentinel
+  convicts any post-warm-up compile the prediction does not cover.
+
+Resolution failures stay silent (an unresolvable call contributes no
+edges and no findings) — the pass must be demonstrable on known-bad
+fixtures and quiet on code it cannot see into.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from kcmc_tpu.analysis.callgraph import ProgramGraph
+from kcmc_tpu.analysis.core import (
+    Finding,
+    Module,
+    ModuleIndex,
+    attr_chain,
+)
+
+# Modules whose jit entries seed the traced-closure walk, and whose
+# dispatch-window methods the transfer/bucket rules scan.
+DEFAULT_PREFIXES = (
+    "kcmc_tpu/backends/jax_backend.py",
+    "kcmc_tpu/plans/",
+    "kcmc_tpu/parallel/",
+    "kcmc_tpu/ops/",
+)
+
+# Per-batch methods: everything reachable here runs once per dispatched
+# batch, so a host transfer or fresh compile is paid inside the
+# latency/throughput window (vs prepare_reference/__init__/warmup,
+# whose cost is amortized setup).
+WINDOW_METHODS = frozenset(
+    {
+        "process_batch",
+        "process_batch_async",
+        "update_reference",
+        "rescue_warp",
+    }
+)
+
+JIT_ENTRY_NAMES = frozenset({"jit", "pjit", "shard_map"})
+
+# Attribute reads on a traced value that are trace-time STATIC (shape
+# metadata, not array contents).
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding"})
+
+# Calls that erase tracedness: their result is a Python-level constant
+# of the trace even when an argument is traced.
+STATIC_FNS = frozenset({"len", "isinstance", "type", "hasattr", "getattr"})
+
+# Closure-captured call chains that bake a PER-CALL host value into the
+# trace as a constant (jax.random.key(seed) is seeded and deliberate).
+CAPTURE_HAZARDS = (
+    "time.",
+    "datetime.",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "os.environ",
+    "os.urandom",
+    "uuid.",
+)
+
+REDUCTIONS = frozenset(
+    {"sum", "mean", "matmul", "dot", "einsum", "cumsum", "prod", "tensordot"}
+)
+ACC_KWARGS = frozenset({"preferred_element_type", "precision"})
+
+# Bytes-per-frame vocabulary for the transfer estimates: symbolic
+# shapes of the values this repo moves across the link, keyed by the
+# names its dispatch-window code actually uses (jax_backend.py /
+# plans/). Best-effort — an unknown name still gets a finding, just
+# without the estimate.
+BYTES_HINTS = {
+    "frames": "H*W*itemsize(native dtype) per frame - the full batch",
+    "corrected": "H*W*4 (float32) per frame - the dominant transfer",
+    "out": "H*W*4 (float32) per frame plus per-frame diagnostics",
+    "transform": "~36-64 B per frame",
+    "transforms": "~36-64 B per frame",
+    "field": "gh*gw*8 B per frame",
+    "n_inliers": "4 B per frame",
+}
+
+
+def _is_jit_entry(chain: str) -> bool:
+    return chain.rsplit(".", 1)[-1] in JIT_ENTRY_NAMES
+
+
+def _jit_static_names(
+    dec_or_call: ast.AST, fn: ast.FunctionDef | None = None
+) -> set[str]:
+    """Statically-declared parameters of a jit decorator/call:
+    static_argnames string literals, plus static_argnums integer
+    literals resolved to parameter names through `fn`."""
+    out: set[str] = set()
+    node = dec_or_call
+    if not isinstance(node, ast.Call):
+        return out
+    params = (
+        [a.arg for a in fn.args.args if a.arg != "self"]
+        if fn is not None
+        else []
+    )
+    for kw in node.keywords:
+        if kw.arg == "static_argnames":
+            for elt in ast.walk(kw.value):
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    out.add(elt.value)
+        elif kw.arg == "static_argnums":
+            for elt in ast.walk(kw.value):
+                if (
+                    isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)
+                    and not isinstance(elt.value, bool)
+                    and 0 <= elt.value < len(params)
+                ):
+                    out.add(params[elt.value])
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class _JitRoot:
+    module: Module
+    fn: ast.FunctionDef
+    how: str
+    line: int
+    static_names: frozenset
+    cls: str | None
+
+
+def find_jit_roots(mod: Module, graph: ProgramGraph) -> list[_JitRoot]:
+    """Every traced entry of a module: @jax.jit / @partial(jax.jit, …)
+    decorated defs, and jit(fn) / shard_map(fn, …) call sites whose
+    function argument resolves locally."""
+    table = graph.tables[mod.path]
+    roots: list[_JitRoot] = []
+    seen: set[int] = set()
+
+    def cls_of(fn):
+        for cname, cnode in table.classes.items():
+            for sub in ast.walk(cnode):
+                if sub is fn:
+                    return cname
+        return None
+
+    def add(fn, how, line, statics):
+        if fn is not None and id(fn) not in seen:
+            seen.add(id(fn))
+            roots.append(
+                _JitRoot(
+                    module=mod,
+                    fn=fn,
+                    how=how,
+                    line=line,
+                    static_names=frozenset(statics),
+                    cls=cls_of(fn),
+                )
+            )
+
+    for fns in table.functions.values():
+        for fn in fns:
+            for dec in fn.decorator_list:
+                chain = attr_chain(
+                    dec.func if isinstance(dec, ast.Call) else dec
+                )
+                inner = ""
+                if (
+                    isinstance(dec, ast.Call)
+                    and chain.endswith("partial")
+                    and dec.args
+                ):
+                    inner = attr_chain(dec.args[0])
+                if _is_jit_entry(chain) or (inner and _is_jit_entry(inner)):
+                    add(
+                        fn, f"@{chain}", dec.lineno,
+                        _jit_static_names(dec, fn),
+                    )
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = attr_chain(node.func)
+        if not _is_jit_entry(chain):
+            continue
+        arg = node.args[0] if node.args else None
+        if isinstance(arg, ast.Name):
+            cands = table.functions.get(arg.id)
+            target = cands[0] if cands else None
+            add(
+                target,
+                chain,
+                node.lineno,
+                _jit_static_names(node, target),
+            )
+    return roots
+
+
+# -- traced-closure interpreter ---------------------------------------------
+
+
+class _ClosureScanner:
+    """Walks one jit root's cross-module traced closure, propagating a
+    per-function set of TRACED names (values derived from traced array
+    contents) and a set of BF16 names, emitting retrace/dtype findings.
+
+    Context-sensitive on the traced-parameter mask: each (function,
+    mask) pair is scanned once; unresolvable calls bind nothing."""
+
+    MAX_CONTEXTS = 4000
+
+    def __init__(self, graph: ProgramGraph, emit):
+        self.graph = graph
+        self.emit = emit
+        self._seen: set = set()
+
+    def scan_root(self, root: _JitRoot) -> None:
+        params = [a.arg for a in root.fn.args.args if a.arg != "self"]
+        traced = frozenset(p for p in params if p not in root.static_names)
+        self._scan(
+            root.module.path, root.cls, root.fn, traced, root.fn.name, root.how
+        )
+
+    # -- tracedness of an expression ----------------------------------
+
+    def _traced(self, node: ast.AST, env: set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in env
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self._traced(node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self._traced(node.value, env)
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1]
+            if tail in STATIC_FNS:
+                return False
+            # method on a traced receiver, or any traced argument
+            if isinstance(node.func, ast.Attribute) and self._traced(
+                node.func.value, env
+            ):
+                return True
+            return any(
+                self._traced(a, env)
+                for a in list(node.args)
+                + [kw.value for kw in node.keywords]
+            )
+        if isinstance(node, ast.BinOp):
+            return self._traced(node.left, env) or self._traced(
+                node.right, env
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._traced(node.operand, env)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are identity tests on the
+            # PYTHON value — static at trace time.
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self._traced(node.left, env) or any(
+                self._traced(c, env) for c in node.comparators
+            )
+        if isinstance(node, ast.BoolOp):
+            return any(self._traced(v, env) for v in node.values)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._traced(e, env) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self._traced(node.body, env) or self._traced(
+                node.orelse, env
+            )
+        if isinstance(node, ast.Starred):
+            return self._traced(node.value, env)
+        return False
+
+    @staticmethod
+    def _is_bf16_cast(node: ast.AST) -> bool:
+        """`x.astype(jnp.bfloat16)` / dtype=bfloat16 construction —
+        outermost cast only (a bf16->f32 round trip is float32)."""
+        if not isinstance(node, ast.Call):
+            return False
+        chain = attr_chain(node.func)
+        if chain.endswith(".astype") and node.args:
+            return attr_chain(node.args[0]).endswith("bfloat16")
+        for kw in node.keywords:
+            if kw.arg == "dtype" and attr_chain(kw.value).endswith(
+                "bfloat16"
+            ):
+                return True
+        if node.args and any(
+            attr_chain(a).endswith("bfloat16") for a in node.args[1:]
+        ):
+            return chain.rsplit(".", 1)[-1] in ("asarray", "array", "full")
+        return False
+
+    def _bf16(self, node: ast.AST, bf: set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in bf
+        if self._is_bf16_cast(node):
+            return True
+        if isinstance(node, ast.Attribute):
+            return self._bf16(node.value, bf)
+        if isinstance(node, ast.Subscript):
+            return self._bf16(node.value, bf)
+        if isinstance(node, ast.BinOp):
+            return self._bf16(node.left, bf) or self._bf16(node.right, bf)
+        return False
+
+    # -- one function body --------------------------------------------
+
+    def _scan(
+        self,
+        path: str,
+        cls: str | None,
+        fn: ast.FunctionDef,
+        traced_params: frozenset,
+        root_name: str,
+        how: str,
+    ) -> None:
+        key = (id(fn), traced_params)
+        if key in self._seen or len(self._seen) > self.MAX_CONTEXTS:
+            return
+        self._seen.add(key)
+        env: set[str] = set(traced_params)
+        bf16: set[str] = set()
+        mod = self.graph.index.get(path)
+        if mod is None:
+            return
+
+        nested_ids: set[int] = set()
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n is not fn
+            ):
+                nested_ids.update(id(sub) for sub in ast.walk(n))
+
+        # two passes: the second sees names bound later in the body
+        # (good enough for the loop-carried straggler without a fixpoint)
+        for _ in range(2):
+            for node in ast.walk(fn):
+                if id(node) in nested_ids:
+                    continue
+                if isinstance(node, ast.Assign) and self._traced(
+                    node.value, env
+                ):
+                    for t in node.targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Name):
+                                env.add(leaf.id)
+                if isinstance(node, ast.Assign) and self._bf16(
+                    node.value, bf16
+                ):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            bf16.add(t.id)
+
+        for node in ast.walk(fn):
+            if id(node) in nested_ids:
+                continue
+            if isinstance(node, (ast.If, ast.While)) and self._traced(
+                node.test, env
+            ):
+                self.emit(
+                    "retrace",
+                    path,
+                    node.lineno,
+                    "error",
+                    f"trace-time branch on a traced value inside "
+                    f"jit-traced '{root_name}' (via {fn.name})",
+                    "Python control flow on array contents re-traces "
+                    "per call (or fails outright under jit) - use "
+                    f"jnp.where / lax.cond; traced through {how}",
+                )
+            if isinstance(node, ast.IfExp) and self._traced(node.test, env):
+                self.emit(
+                    "retrace",
+                    path,
+                    node.lineno,
+                    "error",
+                    f"trace-time conditional expression on a traced "
+                    f"value inside jit-traced '{root_name}' (via "
+                    f"{fn.name})",
+                    f"use jnp.where; traced through {how}",
+                )
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1]
+            if tail == "range" and any(
+                self._traced(a, env) for a in node.args
+            ):
+                self.emit(
+                    "retrace",
+                    path,
+                    node.lineno,
+                    "error",
+                    f"range() over a traced value inside jit-traced "
+                    f"'{root_name}' (via {fn.name})",
+                    "the loop bound bakes into the trace as a "
+                    f"constant and re-traces per value; traced through {how}",
+                )
+            # Wide-dtype requests on DEVICE values only: jnp./jax.
+            # constructors and .astype on traced receivers. Host numpy
+            # float64 on static values (e.g. the polish window built in
+            # f64 numpy and cast) is a legitimate trace-time constant.
+            wide = None
+            wide_call = chain.split(".", 1)[0] in ("jnp", "jax") or (
+                tail == "astype"
+                and isinstance(node.func, ast.Attribute)
+                and self._traced(node.func.value, env)
+            )
+            if wide_call:
+                for a in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    c = attr_chain(a)
+                    s = (
+                        a.value
+                        if isinstance(a, ast.Constant)
+                        and isinstance(a.value, str)
+                        else ""
+                    )
+                    for w in ("float64", "complex128"):
+                        if c.endswith(w) or s == w:
+                            wide = w
+            if ("float64" in chain or "complex128" in chain) and chain.split(
+                ".", 1
+            )[0] in ("jnp", "jax"):
+                # jnp/jax-prefixed only: np.float64(...) on a static
+                # value is the exempt host-constant pattern above
+                wide = "float64" if "float64" in chain else "complex128"
+            if wide is not None:
+                self.emit(
+                    "dtype-flow",
+                    path,
+                    node.lineno,
+                    "error",
+                    f"explicit {wide} inside jit-traced "
+                    f"'{root_name}' (via {fn.name})",
+                    "silently float32 without jax_enable_x64, silently "
+                    f"2x bytes with it; traced through {how}",
+                )
+            if (
+                tail in REDUCTIONS
+                and node.args
+                and self._bf16(node.args[0], bf16)
+                and not any(kw.arg in ACC_KWARGS for kw in node.keywords)
+            ):
+                self.emit(
+                    "dtype-flow",
+                    path,
+                    node.lineno,
+                    "warning",
+                    f"bf16 accumulation without an explicit accumulator "
+                    f"dtype in '{fn.name}'",
+                    f"jnp.{tail} over bfloat16 accumulates in bf16 on "
+                    "TPU by default - pass preferred_element_type= (or "
+                    "precision=) or document exactness; traced through "
+                    + how,
+                )
+            # follow the call edge with the actual traced-arg mask
+            self._follow(node, path, cls, fn, env, root_name, how)
+
+        self._scan_captures(path, fn, mod, root_name, how, nested_ids)
+
+    def _follow(self, call, path, cls, fn, env, root_name, how):
+        chain = attr_chain(call.func)
+        args = list(call.args)
+        # jax.vmap(f)(…) / lax.map-style: resolve through the inner name
+        if (
+            isinstance(call.func, ast.Call)
+            and attr_chain(call.func.func).rsplit(".", 1)[-1]
+            in ("vmap", "checkpoint", "remat")
+            and call.func.args
+            and isinstance(call.func.args[0], ast.Name)
+        ):
+            chain = call.func.args[0].id
+        if not chain or chain.startswith("?"):
+            return
+        ref = self.graph.resolve_in_module(path, chain, cls=cls, fn=fn)
+        if ref is None or ref.name == "__init__":
+            return
+        target = self.graph.function(ref)
+        if target is None:
+            return
+        params = [a.arg for a in target.args.args if a.arg != "self"]
+        mask: set[str] = set()
+        for i, a in enumerate(args):
+            if i < len(params) and self._traced(a, env):
+                mask.add(params[i])
+        for kw in call.keywords:
+            if kw.arg in params and self._traced(kw.value, env):
+                mask.add(kw.arg)
+        self._scan(ref.path, ref.cls, target, frozenset(mask), root_name, how)
+
+    def _scan_captures(self, path, fn, mod, root_name, how, nested_ids):
+        """Free names of the traced root that the ENCLOSING builder
+        assigns from per-call host sources (time/random/environ):
+        those values bake into the trace as constants of THIS call."""
+        builder = None
+        for cand in ast.walk(mod.tree):
+            if isinstance(cand, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(sub is fn for sub in ast.walk(cand)) and cand is not fn:
+                    builder = cand  # innermost wins (walk order is outer-first)
+        if builder is None:
+            return
+        local = {a.arg for a in fn.args.args}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for t in ast.walk(n):
+                    if isinstance(t, ast.Name) and isinstance(
+                        t.ctx, ast.Store
+                    ):
+                        local.add(t.id)
+        free = {
+            n.id
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Name)
+            and isinstance(n.ctx, ast.Load)
+            and n.id not in local
+        }
+        for node in builder.body if hasattr(builder, "body") else ():
+            for stmt in ast.walk(node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                names = {
+                    t.id
+                    for t in stmt.targets
+                    if isinstance(t, ast.Name) and t.id in free
+                }
+                if not names:
+                    continue
+                for sub in ast.walk(stmt.value):
+                    if not isinstance(sub, (ast.Call, ast.Attribute)):
+                        continue
+                    c = attr_chain(
+                        sub.func if isinstance(sub, ast.Call) else sub
+                    )
+                    if c.startswith("jax.random."):
+                        continue  # seeded by construction
+                    if any(c.startswith(h) for h in CAPTURE_HAZARDS):
+                        self.emit(
+                            "retrace",
+                            path,
+                            stmt.lineno,
+                            "error",
+                            f"closure over a per-call host value "
+                            f"'{sorted(names)[0]}' baked into jit-traced "
+                            f"'{root_name}'",
+                            f"assigned from {c} in {builder.name}; every "
+                            "call traces a different constant - thread "
+                            "it through as an argument instead",
+                        )
+                        break
+
+
+# -- static-argnum candidates ------------------------------------------------
+
+
+def _static_argnum_candidates(root: _JitRoot, emit) -> None:
+    """Parameters of a jitted function used ONLY at trace time
+    (range()/if-tests/shape positions) and not declared static."""
+    fn = root.fn
+    params = [a.arg for a in fn.args.args if a.arg != "self"]
+    uses: dict[str, set[str]] = {p: set() for p in params}
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call):
+            tail = attr_chain(node.func).rsplit(".", 1)[-1]
+            for a in ast.walk(node):
+                if isinstance(a, ast.Name) and a.id in uses:
+                    uses[a.id].add(
+                        "static" if tail == "range" else "value"
+                    )
+            # don't double-count below
+            return
+
+        def visit_If(self, node: ast.If):
+            for a in ast.walk(node.test):
+                if isinstance(a, ast.Name) and a.id in uses:
+                    uses[a.id].add("static")
+            for stmt in node.body + node.orelse:
+                self.visit(stmt)
+
+        def generic_visit(self, node):
+            if isinstance(node, ast.Name) and node.id in uses:
+                uses[node.id].add("value")
+            super().generic_visit(node)
+
+    for stmt in fn.body:
+        V().visit(stmt)
+    for p in params:
+        if p in root.static_names:
+            continue
+        if uses[p] and uses[p] == {"static"}:
+            emit(
+                "retrace",
+                root.module.path,
+                fn.lineno,
+                "warning",
+                f"parameter '{p}' of jit-traced '{fn.name}' is used "
+                "only at trace time - static-argnum candidate",
+                "declaring it static_argnames avoids tracing a value "
+                "the program never reads at runtime",
+            )
+
+
+# -- dispatch-window analysis (transfer / bucket-escape / upload cast) -------
+
+
+def _with_contexts(fn: ast.FunctionDef) -> dict[int, bool]:
+    """node id -> True when lexically inside a `with *.maybe_timed(…)`
+    or `with *.timed(…)` block (plan compile accounting)."""
+    out: dict[int, bool] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.With):
+            continue
+        accounted = any(
+            isinstance(item.context_expr, ast.Call)
+            and attr_chain(item.context_expr.func).rsplit(".", 1)[-1]
+            in ("maybe_timed", "timed")
+            for item in node.items
+        )
+        if accounted:
+            for sub in ast.walk(node):
+                out[id(sub)] = True
+    return out
+
+
+def _bytes_hint(node: ast.AST) -> str:
+    names = [
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    ] + [
+        n.attr for n in ast.walk(node) if isinstance(n, ast.Attribute)
+    ] + [
+        c.value
+        for c in ast.walk(node)
+        if isinstance(c, ast.Constant) and isinstance(c.value, str)
+    ]
+    for n in names:
+        if n in BYTES_HINTS:
+            return f"~{BYTES_HINTS[n]}"
+    return "bytes-per-frame unknown (name outside the shape vocabulary)"
+
+
+class _WindowScanner:
+    """Transfer + bucket-escape + upload-widening rules over the
+    dispatch-window methods of backend classes in the scoped modules."""
+
+    def __init__(self, graph: ProgramGraph, emit):
+        self.graph = graph
+        self.emit = emit
+
+    def scan_module(self, mod: Module) -> None:
+        table = self.graph.tables[mod.path]
+        # module-level jit-decorated helpers (dispatchable per shape)
+        jit_helpers: set[str] = set()
+        for fname, fns in table.functions.items():
+            for fn in fns:
+                for dec in fn.decorator_list:
+                    chain = attr_chain(
+                        dec.func if isinstance(dec, ast.Call) else dec
+                    )
+                    inner = (
+                        attr_chain(dec.args[0])
+                        if isinstance(dec, ast.Call)
+                        and chain.endswith("partial")
+                        and dec.args
+                        else ""
+                    )
+                    if _is_jit_entry(chain) or (
+                        inner and _is_jit_entry(inner)
+                    ):
+                        jit_helpers.add(fname)
+        for cname, cnode in table.classes.items():
+            for mname, mfn in table.methods.get(cname, {}).items():
+                if mname in WINDOW_METHODS:
+                    self._scan_window_fn(mod, cname, mfn, jit_helpers)
+
+    def _scan_window_fn(self, mod, cls, fn, jit_helpers):
+        accounted = _with_contexts(fn)
+        src = mod.source
+        fn_src = ast.get_source_segment(src, fn) or ""
+        routes = (
+            ".route(" in fn_src
+            or "route_shape(" in fn_src
+            or ".routable(" in fn_src
+        )
+        accounts_fallback = "note_route(" in fn_src
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            tail = chain.rsplit(".", 1)[-1]
+
+            # -- transfer: device -> host inside the window -----------
+            d2h = None
+            if chain in ("np.asarray", "np.array", "numpy.asarray",
+                         "numpy.array"):
+                d2h = chain
+            elif chain == "jax.device_get" or tail == "device_get":
+                d2h = "jax.device_get"
+            elif tail in ("item", "tolist", "block_until_ready"):
+                d2h = f"*.{tail}()"
+            elif tail == "map" and chain.endswith("tree.map") and node.args:
+                inner = attr_chain(node.args[0])
+                if inner.endswith("asarray") or inner.endswith("array"):
+                    d2h = "jax.tree.map(np.asarray, ...)"
+            if d2h is not None:
+                target = (
+                    node.args[-1] if node.args else node.func
+                )
+                self.emit(
+                    "transfer",
+                    mod.path,
+                    node.lineno,
+                    "warning",
+                    f"device->host transfer inside the dispatch window "
+                    f"in '{fn.name}' ({d2h})",
+                    f"{_bytes_hint(target)}; a synchronous copy here "
+                    "serializes the dispatch window - prefer "
+                    "copy_to_host_async or move the copy out of the "
+                    "per-batch path",
+                )
+
+            # -- dtype-flow: host-side widening cast on the upload ----
+            if (
+                tail in ("asarray", "array")
+                and chain.split(".", 1)[0] in ("jnp", "jax")
+                and len(node.args) >= 2
+                and attr_chain(node.args[1]).endswith(
+                    ("float32", "float64")
+                )
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in ("frames", "stack", "batch")
+            ):
+                self.emit(
+                    "dtype-flow",
+                    mod.path,
+                    node.lineno,
+                    "warning",
+                    f"host-side widening cast before upload in "
+                    f"'{fn.name}'",
+                    "jnp.asarray(frames, float32) widens an integer "
+                    "stack on the host side of the link - upload the "
+                    "native dtype and .astype on device (halves "
+                    "host->device bytes for uint16)",
+                )
+
+            # -- bucket-escape: unaccounted jit dispatch --------------
+            is_dispatch = False
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in jit_helpers
+            ):
+                is_dispatch = True
+            if is_dispatch and not accounted.get(id(node)):
+                if not (routes and accounts_fallback):
+                    self.emit(
+                        "bucket-escape",
+                        mod.path,
+                        node.lineno,
+                        "error",
+                        f"jitted '{node.func.id}' dispatched from the "
+                        f"window in '{fn.name}' outside the bucket "
+                        "ladder and plan accounting",
+                        "every new caller shape is a silent fresh XLA "
+                        "compile - route through plan.route / wrap in "
+                        "maybe_timed so the retrace sentinel and plan "
+                        "stats see it",
+                    )
+
+
+# -- the pass ----------------------------------------------------------------
+
+
+class TraceFlowPass:
+    """Rule families `retrace` / `dtype-flow` / `transfer` /
+    `bucket-escape` (module docstring)."""
+
+    name = "traceflow"
+
+    def __init__(self, module_prefixes: tuple[str, ...] = DEFAULT_PREFIXES):
+        self.module_prefixes = module_prefixes
+
+    def run(self, index: ModuleIndex) -> list[Finding]:
+        graph = ProgramGraph.for_index(index)
+        out: list[Finding] = []
+
+        def emit(rule, path, line, severity, message, detail=""):
+            out.append(
+                Finding(
+                    rule=rule,
+                    path=path,
+                    line=line,
+                    severity=severity,
+                    message=message,
+                    detail=detail,
+                )
+            )
+
+        scanner = _ClosureScanner(graph, emit)
+        windows = _WindowScanner(graph, emit)
+        for mod in index:
+            if not any(mod.path.startswith(p) for p in self.module_prefixes):
+                continue
+            for root in find_jit_roots(mod, graph):
+                scanner.scan_root(root)
+                _static_argnum_candidates(root, emit)
+            windows.scan_module(mod)
+        uniq: dict[tuple, Finding] = {}
+        for f in out:
+            uniq.setdefault((f.rule, f.path, f.line, f.message), f)
+        return list(uniq.values())

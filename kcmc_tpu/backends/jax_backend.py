@@ -484,6 +484,19 @@ class JaxBackend:
         plan = self._plan
         bucket = plan.route(shape) if plan.active else None
         frames_j = jnp.asarray(frames)
+        if (
+            frames_j is frames
+            and self.mesh is None
+            and self.config.donate_buffers
+            and (bucket is None or bucket == shape)
+        ):
+            # The register program donates its frame buffer (arg 0).
+            # A host batch just uploaded is ours to give away; a
+            # caller-passed DEVICE array (asarray was the identity) is
+            # the caller's to keep — copy so donation eats the copy.
+            # Bucket-PADDED dispatches skip this: jnp.pad below already
+            # produces a fresh owned buffer.
+            frames_j = jnp.array(frames_j, copy=True)
         valid_hw = None
         if bucket is not None:
             # Execution-plan bucket routing: pad to the smallest
@@ -554,18 +567,25 @@ class JaxBackend:
             and not ref.get("_skip_quality")
         ):
             out = dict(out)
-            if "field" in out:
-                mask = _coverage_field(out["field"], shape)
-            elif out["transform"].shape[-1] == 4:
-                mask = _coverage_matrix3d(out["transform"], shape)
-            else:
-                mask = _coverage_matrix(out["transform"], shape)
-            out["template_corr"] = _template_corr(
-                out["corrected"], ref["frame"], mask
-            )
-            out["coverage"] = jnp.mean(
-                mask.astype(jnp.float32), axis=tuple(range(1, mask.ndim))
-            )
+            # Plan accounting for the quality helpers: they are their
+            # own jitted programs compiled per TRUE shape (not per
+            # bucket — they read the sliced-back frames), so their
+            # first build is timed/stamped like the register program's
+            # and the retrace sentinel can see it (small programs:
+            # one ~ms compile per new true shape).
+            with plan.maybe_timed("quality", shape, "float32"):
+                if "field" in out:
+                    mask = _coverage_field(out["field"], shape)
+                elif out["transform"].shape[-1] == 4:
+                    mask = _coverage_matrix3d(out["transform"], shape)
+                else:
+                    mask = _coverage_matrix(out["transform"], shape)
+                out["template_corr"] = _template_corr(
+                    out["corrected"], ref["frame"], mask
+                )
+                out["coverage"] = jnp.mean(
+                    mask.astype(jnp.float32), axis=tuple(range(1, mask.ndim))
+                )
         if not emit_frames and "corrected" in out:
             out = dict(out)  # quality metrics above already read it
             del out["corrected"]
@@ -573,7 +593,10 @@ class JaxBackend:
             dt = np.dtype(cast_dtype)
             if np.issubdtype(dt, np.integer):
                 out = dict(out)
-                out["corrected"] = _cast_corrected(out["corrected"], dt.name)
+                with plan.maybe_timed("cast", shape, dt.name):
+                    out["corrected"] = _cast_corrected(
+                        out["corrected"], dt.name
+                    )
         if to_host:
             for v in out.values():  # start D2H copies in the background
                 if hasattr(v, "copy_to_host_async"):
@@ -742,7 +765,14 @@ class JaxBackend:
             return make_sharded_batch_fn(
                 local, self.mesh, extra_replicated=1 if bucketed else 0
             )
-        return jax.jit(local)
+        # Buffer donation (the kcmc-check donation-audit contract): the
+        # corrected output matches the frame batch's shape/dtype only
+        # for float32 uploads (integer batches cast on device, so XLA
+        # simply skips the alias for them), and process_batch_async
+        # owns the uploaded buffer — a caller-held device array is
+        # defensively copied there before dispatch. Halves the frame
+        # memory held per in-flight batch (docs/PERFORMANCE.md).
+        return jax.jit(local, donate_argnums=self._donate_argnums())
 
     def _detect_describe_2d(
         self, frames, use_pallas: bool, multi_scale=True, valid_hw=None
@@ -1131,7 +1161,10 @@ class JaxBackend:
         transforms match the rescued pixels.
         """
         cfg = self.config
-        frames = jnp.asarray(frames, jnp.float32)
+        # Upload in the native dtype and widen ON DEVICE: a uint16
+        # rescue batch crosses the host->device link at half the bytes
+        # of a host-side float32 cast (the kcmc-check dtype-flow rule).
+        frames = jnp.asarray(frames).astype(jnp.float32)
         if cfg.sanitize_input:
             # The batch program sanitized its own input; the rescue
             # path re-warps the RAW host frames, so the fully-finite
@@ -1163,6 +1196,13 @@ class JaxBackend:
                 corrected = jax.vmap(warp_frame)(frames, transforms)
             out["transform"] = np.asarray(transforms)
         return np.asarray(corrected)
+
+    def _donate_argnums(self) -> tuple:
+        """Argnums the single-device register program donates: the
+        frame batch (arg 0), unless `donate_buffers` is off. The
+        reference arrays (args 1-4) are reused across every batch and
+        must never be donated."""
+        return (0,) if self.config.donate_buffers else ()
 
     @staticmethod
     def _on_accelerator() -> bool:

@@ -300,6 +300,18 @@ class CorrectorConfig:
     # signature neutral: caching only changes WHEN compiles happen,
     # never what a run computes.
     compile_cache_dir: str | None = None
+    # Donate the register batch program's frame buffer to XLA
+    # (`donate_argnums`): the corrected-frame output writes into the
+    # input batch's device allocation instead of a second one, halving
+    # the per-in-flight-batch frame memory (the donation-audit finding
+    # of `kcmc check`; docs/PERFORMANCE.md "Retracing & transfer
+    # anatomy"). Safe by construction: the backend only donates the
+    # buffer it created from the caller's host batch (a caller-owned
+    # device array is defensively copied first), and single-device
+    # paths only — shard_map programs keep their buffers. Resume-
+    # signature neutral: aliasing changes WHERE the output lives, never
+    # its values (asserted by the parity suites, which run donating).
+    donate_buffers: bool = True
 
     # -- input hygiene -----------------------------------------------------
     # Replace non-finite input pixels (dead/hot sensor pixels, NaN
@@ -732,6 +744,7 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "serve_inflight",
         "serve_degrade_watermark",
         "compile_cache_dir",
+        "donate_buffers",
     }
 )
 

@@ -267,8 +267,15 @@ def apply_correction(
             )
     if n == 0:
         return np.empty(stack.shape, _resolve_apply_dtype(output_dtype, stack))
+    # donate=True / donate_argnums: each chunk's device upload below is
+    # a temp this function owns, so the apply warp writes its output
+    # into that buffer instead of a second chunk-sized allocation (the
+    # kcmc-check donation audit; docs/PERFORMANCE.md).
     if transforms is not None and stack.ndim == 4:
-        vol = _apply_fn("volume", lambda: jax.jit(jax.vmap(warp_volume)))
+        vol = _apply_fn(
+            "volume",
+            lambda: jax.jit(jax.vmap(warp_volume), donate_argnums=(0,)),
+        )
         fn = lambda fr, lo, hi: np.asarray(
             vol(fr, jnp.asarray(transforms[lo:hi]))
         )
@@ -279,13 +286,13 @@ def apply_correction(
         from kcmc_tpu.ops.warp import fast_apply_matrix
 
         fn = lambda fr, lo, hi: fast_apply_matrix(
-            fr, jnp.asarray(transforms[lo:hi])
+            fr, jnp.asarray(transforms[lo:hi]), donate=True
         )
     else:
         from kcmc_tpu.ops.warp import fast_apply_fields
 
         fn = lambda fr, lo, hi: fast_apply_fields(
-            fr, jnp.asarray(fields[lo:hi], jnp.float32)
+            fr, jnp.asarray(fields[lo:hi], jnp.float32), donate=True
         )
 
     out_dt = _resolve_apply_dtype(output_dtype, stack)

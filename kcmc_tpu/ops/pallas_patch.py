@@ -755,12 +755,27 @@ def _blended_slab_kernel(*refs, P: int, KB: int, with_moments: bool):
     m01_ref[:, :] = acc_y
 
 
+# Element-indexed BlockSpecs (`pl.Element`) are how the slab layout
+# places per-keypoint 8-aligned blocks; older jaxlib pallas builds
+# (<= 0.4.37) predate the API. The slab route is the last-resort
+# fallback for frames beyond even the banded VMEM budget (and the
+# plane-flattened 3D route), so on such builds it reports cleanly and
+# the describe policy's XLA gather path covers those shapes instead.
+ELEMENT_INDEXING = hasattr(pl, "Element")
+
+
 def _extract_blended_planes_slab(
     padded, oy, ox, fx, fy, P: int, with_moments: bool, interpret: bool,
     out_dtype=jnp.float32,
 ):
     """Slab-blocked implementation behind extract_blended_planes for
     frames past the whole-frame VMEM budget. Identical outputs."""
+    if not ELEMENT_INDEXING:
+        raise NotImplementedError(
+            "this jax/pallas build lacks pl.Element (element-indexed "
+            "BlockSpecs), which the slab descriptor layout requires — "
+            "use the XLA gather describe path for frames this large"
+        )
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
     KB = 8  # slabs per program: KB * S * _WIN * 4 B ≈ 0.4-0.8 MB
@@ -910,6 +925,12 @@ def extract_blended_3d(
     selected by a lane roll), so VMEM holds only KB tiny slabs — not
     the volume.
     """
+    if not ELEMENT_INDEXING:
+        raise NotImplementedError(
+            "this jax/pallas build lacks pl.Element (element-indexed "
+            "BlockSpecs), which the 3D slab descriptor layout requires "
+            "— use the XLA gather describe path (use_pallas=False)"
+        )
     B, Dp, Hp, Wp0 = padded.shape
     K = xyz.shape[1]
     bc = _smem_batch_limit(4, K, 8)

@@ -215,7 +215,8 @@ def _cached_jit(key, build):
 
 
 def fast_apply_matrix(
-    frames: jnp.ndarray, Ms: jnp.ndarray, force_kernel: bool = False
+    frames: jnp.ndarray, Ms: jnp.ndarray, force_kernel: bool = False,
+    donate: bool = False,
 ):
     """Batched 2D matrix apply for the APPLY/STABILIZE workflows:
     gather-warp semantics at gather-free speed.
@@ -230,6 +231,14 @@ def fast_apply_matrix(
     still applies. Off-accelerator this is exactly `warp_batch`
     (bit-identical to the previous behavior; `force_kernel` exercises
     the kernel route in interpret mode for tests). Returns numpy.
+
+    `donate=True` (the kcmc-check donation-audit contract): the caller
+    RELINQUISHES `frames` — the gather route's jit donates the batch
+    buffer to XLA so the resampled output reuses its allocation
+    instead of a second batch-sized one. Only for callers that own the
+    buffer (apply_correction's per-chunk upload temp); the Pallas
+    kernel route keeps the batch readable for its per-frame fallback
+    and never donates.
     """
     import numpy as np
 
@@ -249,22 +258,33 @@ def fast_apply_matrix(
             okh = np.asarray(ok)
             res = np.asarray(out)
             if not okh.all():
-                wf = _cached_jit("frame", lambda: jax.jit(warp_frame))
+                wf = _cached_jit(
+                    "frame",
+                    lambda: jax.jit(warp_frame, donate_argnums=()),
+                )
                 res = np.array(res)
                 for i in np.where(~okh)[0]:
                     res[i] = np.asarray(wf(frames[i], Ms[i]))
             return res
-    wb = _cached_jit("batch", lambda: jax.jit(warp_batch))
+    wb = _cached_jit(
+        ("batch", donate),
+        lambda: jax.jit(
+            warp_batch, donate_argnums=(0,) if donate else ()
+        ),
+    )
     return np.asarray(wb(frames, Ms))
 
 
 def fast_apply_fields(
-    frames: jnp.ndarray, fields: jnp.ndarray, force_kernel: bool = False
+    frames: jnp.ndarray, fields: jnp.ndarray, force_kernel: bool = False,
+    donate: bool = False,
 ):
     """Batched piecewise-field apply, same policy as fast_apply_matrix:
     the fused field kernel (in-kernel upsample + bounded resample) on
     accelerators with exact per-frame gather fallback for flagged
-    frames; pure gather off-accelerator. Returns numpy."""
+    frames; pure gather off-accelerator. `donate=True`: the caller
+    relinquishes `frames` on the gather route (see fast_apply_matrix).
+    Returns numpy."""
     import numpy as np
 
     on_acc = jax.default_backend() in ("tpu", "axon")
@@ -287,7 +307,8 @@ def fast_apply_fields(
                     lambda: jax.jit(
                         lambda f, fl: warp_frame_flow(
                             f, upsample_field(fl, shape)
-                        )
+                        ),
+                        donate_argnums=(),
                     ),
                 )
                 res = np.array(res)
@@ -297,11 +318,12 @@ def fast_apply_fields(
     from kcmc_tpu.ops.piecewise import upsample_field
 
     fb = _cached_jit(
-        ("flow_batch", shape),
+        ("flow_batch", shape, donate),
         lambda: jax.jit(
             jax.vmap(
                 lambda f, fl: warp_frame_flow(f, upsample_field(fl, shape))
-            )
+            ),
+            donate_argnums=(0,) if donate else (),
         ),
     )
     return np.asarray(fb(frames, fields))

@@ -215,16 +215,21 @@ class ExecutionPlan:
             if plan is not None
             else contextlib.nullcontext()
         )
+        # donate=True matches apply_correction's runtime dispatch — the
+        # donating and non-donating wrappers are DIFFERENT cached jits,
+        # so warming the wrong one would leave the first real apply
+        # call to pay a fresh unaccounted compile. The zero-filled warm
+        # batch is owned here, so relinquishing it is free.
         if self.config.model == "piecewise":
             from kcmc_tpu.ops.warp import fast_apply_fields
 
             gh, gw = self.config.patch_grid
             fields = np.zeros((B, gh, gw, 2), np.float32)
             with ctx:
-                fast_apply_fields(frames, fields)
+                fast_apply_fields(frames, fields, donate=True)
             return
         from kcmc_tpu.ops.warp import fast_apply_matrix
 
         Ms = np.tile(np.eye(3, dtype=np.float32), (B, 1, 1))
         with ctx:
-            fast_apply_matrix(frames, Ms)
+            fast_apply_matrix(frames, Ms, donate=True)

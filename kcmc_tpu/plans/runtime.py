@@ -25,6 +25,7 @@ import os
 import threading
 import time
 
+from kcmc_tpu.analysis import sanitize as _sanitize
 from kcmc_tpu.plans.buckets import normalize_buckets, route_shape
 from kcmc_tpu.plans.cache import PlanCache, enable_compile_cache
 
@@ -98,6 +99,12 @@ class PlanRuntime:
             "bucket_fallback": 0,
         }
         self.events: list[dict] = []
+        # Per-program compile counts keyed by (program, shape, dtype,
+        # rung) — the retrace sentinel's observation side: the static
+        # bucket ladder predicts this key set (predict_compile_keys),
+        # and a warmed process growing it is a retrace (analysis/
+        # sanitize.py convicts when armed).
+        self.compile_counts: dict[tuple, int] = {}
 
     # -- routing -----------------------------------------------------------
 
@@ -262,6 +269,9 @@ class PlanRuntime:
             "seconds": round(dur, 4),
             "stamp_hit": bool(hit) if self.cache.persistent else None,
         }
+        count_key = (
+            program, tuple(int(s) for s in shape), str(dtype), self.rung
+        )
         with self._lock:
             self.counters["programs_compiled"] += 1
             self.counters["compile_s"] += dur
@@ -269,6 +279,20 @@ class PlanRuntime:
                 self.counters["stamp_hits" if hit else "stamp_misses"] += 1
             if len(self.events) < _EVENT_CAP:
                 self.events.append(event)
+            self.compile_counts[count_key] = (
+                self.compile_counts.get(count_key, 0) + 1
+            )
+        # Retrace sentinel (analysis/sanitize.py): a no-op attribute
+        # check when disarmed; when armed after warm-up, a compile of a
+        # covered program here is a conviction the static bucket-ladder
+        # prediction said could not happen.
+        _sanitize.note_compile(
+            program,
+            tuple(int(s) for s in shape),
+            str(dtype),
+            rung=self.rung,
+            during_build=self.building,
+        )
         span = "plan_build" if self.building else "jit_compile"
         for tracer in _live_tracers():
             try:
@@ -293,6 +317,12 @@ class PlanRuntime:
         with self._lock:
             counters = dict(self.counters)
             events = list(self.events)
+            compile_counts = {
+                f"{p}|{'x'.join(str(s) for s in shape)}|{dt}|{rung}": n
+                for (p, shape, dt, rung), n in sorted(
+                    self.compile_counts.items()
+                )
+            }
         return {
             "enabled": self.enabled,
             "persistent": self.cache.persistent,
@@ -303,5 +333,29 @@ class PlanRuntime:
                 k: (round(v, 4) if isinstance(v, float) else v)
                 for k, v in counters.items()
             },
+            "compile_counts": compile_counts,
             "events": events,
         }
+
+
+def predict_compile_keys(
+    config,
+    programs: tuple = ("reference", "register", "apply"),
+    dtypes: tuple = ("float32",),
+) -> set:
+    """The compile-key set the static bucket ladder predicts for a
+    warmed process: one (program, bucket, dtype) per declared bucket —
+    "register" per warmed dtype, "reference"/"apply" float32 (the
+    reference preps and the apply warp run float32 regardless of the
+    upload dtype). This is the SAME key family `PlanRuntime.
+    compile_counts` records and `ExecutionPlan.build` drives, so the
+    static prediction and the runtime retrace sentinel (analysis/
+    sanitize.py) cross-validate: a warmed run whose covered programs
+    compile outside this set escaped the ladder."""
+    buckets = normalize_buckets(getattr(config, "plan_buckets", config))
+    out: set = set()
+    for b in buckets:
+        for p in programs:
+            for dt in dtypes if p == "register" else ("float32",):
+                out.add((p, tuple(b), str(dt)))
+    return out

@@ -741,7 +741,7 @@ def test_repo_is_clean_against_baseline():
     ]
     assert blocking == [], "\n".join(f.format() for f in blocking)
     assert res.exit_code == 0
-    # the seven passes all ran
+    # the nine passes all ran
     assert set(res.passes) == {
         "config-registry",
         "jit-purity",
@@ -750,6 +750,8 @@ def test_repo_is_clean_against_baseline():
         "thread-roots",
         "race",
         "resource-lifecycle",
+        "traceflow",
+        "donation",
     }
 
 

@@ -205,3 +205,53 @@ def test_hostfed_judged_json_line_contract():
     assert rec["byte_identical"] is True
     assert rec["configs"]["pyfallback_pooled"]["feeder"]["workers"] == 8
     assert "byte_identical" not in rec["configs"]
+
+
+def test_bench_cli_has_regress_flags():
+    out = subprocess.run(
+        [sys.executable, _BENCH, "--help"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "--regress" in out.stdout
+    assert "--against" in out.stdout
+
+
+def test_regress_gate_passes_and_fails_on_doctored_reference(
+    tmp_path, monkeypatch, capsys
+):
+    """The regression gate's pass/fail logic, without real compute: a
+    stubbed run beats the reference (exit 0), then the reference is
+    doctored so the same numbers read as a >5% fps regression and as an
+    rmse regression (exit 1, failures named)."""
+    sys.path.insert(0, os.path.dirname(_BENCH))
+    import bench
+
+    monkeypatch.setattr(
+        bench, "run_bench_device",
+        lambda frames, size, model, batch, **kw: {
+            "fps": 100.0, "rmse_px": 0.10, "n_frames": frames,
+            "seconds": 1.0, "sweeps_fps": [100.0],
+        },
+    )
+    ref = tmp_path / "ref.json"
+    ref.write_text(json.dumps({"configs": {
+        "translation": {"fps": 98.0, "rmse_px": 0.10},
+        "homography": {"fps": 50.0, "rmse_px": 0.2},
+        "piecewise": {"fps": 100.0, "rmse_px": 0.102},
+    }}))
+    rc = bench.run_bench_regress(str(ref), True, 64, 64, 16)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and rec["value"] == 1 and rec["failures"] == []
+
+    ref.write_text(json.dumps({"configs": {
+        "translation": {"fps": 120.0, "rmse_px": 0.10},   # fps regression
+        "homography": {"fps": 50.0, "rmse_px": 0.08},     # rmse regression
+        "piecewise": {"fps": 100.0, "rmse_px": 0.10},     # exactly on ref: ok
+    }}))
+    rc = bench.run_bench_regress(str(ref), True, 64, 64, 16)
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 1 and rec["value"] == 0
+    assert any("translation: fps" in f for f in rec["failures"])
+    assert any("homography: rmse" in f for f in rec["failures"])
+    assert len(rec["failures"]) == 2, rec["failures"]

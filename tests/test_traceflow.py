@@ -1,0 +1,811 @@
+"""Trace-contract analysis (`traceflow` + `donation` passes), the
+incremental check cache, and the runtime retrace sentinel.
+
+Layers mirror test_analysis.py's contract:
+
+* known-bad fixture per rule id — `retrace`, `dtype-flow`, `transfer`,
+  `bucket-escape`, `donation` each FIRE on a minimal snippet and stay
+  quiet on the fixed variant;
+* the repo itself stays clean against the baseline (test_analysis.py's
+  integration test covers the new passes via default_passes);
+* the cache replays content-hash-matched results (module-scoped and
+  program-scoped) and a cache hit is measurably cheaper than cold;
+* the retrace sentinel: the static `predict_compile_keys` ladder and
+  the runtime compile observations cross-validate — a warmed corrector
+  records zero post-warm-up compiles on covered programs, an escaping
+  shape convicts.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from kcmc_tpu.analysis.core import Finding, ModuleIndex
+from kcmc_tpu.analysis.donation import DonationPass
+from kcmc_tpu.analysis.traceflow import TraceFlowPass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def messages_of(findings):
+    return [f.message for f in findings]
+
+
+def tf(sources):
+    return TraceFlowPass().run(ModuleIndex.from_sources(sources))
+
+
+def don(sources):
+    return DonationPass().run(ModuleIndex.from_sources(sources))
+
+
+# -- retrace -----------------------------------------------------------------
+
+
+def test_retrace_fires_on_branch_over_traced_value():
+    fs = tf({"kcmc_tpu/ops/bad.py": """
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+"""})
+    assert any(
+        "trace-time branch on a traced value" in m for m in messages_of(fs)
+    ), fs
+
+
+def test_retrace_fires_on_range_over_traced_value():
+    fs = tf({"kcmc_tpu/ops/bad.py": """
+import jax
+
+@jax.jit
+def f(x, n):
+    for _ in range(n):
+        x = x + 1
+    return x
+"""})
+    assert any("range() over a traced value" in m for m in messages_of(fs))
+
+
+def test_retrace_quiet_on_static_and_identity_tests():
+    fs = tf({"kcmc_tpu/ops/ok.py": """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x, y):
+    if x is None:
+        return y
+    if x.ndim == 3:
+        x = x[0]
+    H, W = x.shape
+    if H % 8:
+        x = x[: H - H % 8]
+    return jnp.where(x > 0, x, y)
+"""})
+    assert [f for f in fs if f.rule == "retrace"] == []
+
+
+def test_retrace_follows_cross_module_call_edges_with_arg_masks():
+    """A branch on a TRACED argument two modules away fires; a branch
+    on a static (config-derived) argument of the same callee stays
+    quiet — the mask is per call site, not per function."""
+    fs = tf({
+        "kcmc_tpu/ops/entry.py": """
+import jax
+from kcmc_tpu.ops.helper import detect
+
+@jax.jit
+def entry(frame):
+    return detect(frame, thresh=0.5)
+""",
+        "kcmc_tpu/ops/helper.py": """
+def detect(frame, thresh=0.0):
+    if thresh > 0:
+        frame = frame + thresh
+    if frame.mean() > 0:
+        return frame
+    return -frame
+""",
+    })
+    retrace = [f for f in fs if f.rule == "retrace"]
+    assert len(retrace) == 1, retrace
+    assert retrace[0].path == "kcmc_tpu/ops/helper.py"
+    assert retrace[0].line == 5  # the frame.mean() branch, not thresh
+
+
+def test_retrace_fires_on_per_call_closure_capture():
+    fs = tf({"kcmc_tpu/ops/bad.py": """
+import time
+import jax
+
+def make():
+    scale = time.time()
+
+    @jax.jit
+    def f(x):
+        return x * scale
+    return f
+"""})
+    assert any(
+        "closure over a per-call host value" in m for m in messages_of(fs)
+    )
+
+
+def test_retrace_quiet_on_seeded_jax_random_closure():
+    fs = tf({"kcmc_tpu/ops/ok.py": """
+import jax
+
+def make(seed):
+    key = jax.random.key(seed)
+
+    @jax.jit
+    def f(x):
+        return x * jax.random.uniform(key)
+    return f
+"""})
+    assert [f for f in fs if f.rule == "retrace"] == []
+
+
+def test_static_argnum_candidate_fires_and_declared_static_is_quiet():
+    fs = tf({"kcmc_tpu/ops/bad.py": """
+import functools
+import jax
+
+@jax.jit
+def f(x, flag):
+    if flag:
+        return x * 2
+    return x
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def g(x, flag):
+    if flag:
+        return x * 2
+    return x
+"""})
+    cands = [m for m in messages_of(fs) if "static-argnum candidate" in m]
+    assert len(cands) == 1 and "'flag' of jit-traced 'f'" in cands[0], fs
+
+
+# -- dtype-flow --------------------------------------------------------------
+
+
+def test_dtype_flow_fires_on_float64_inside_traced_code():
+    fs = tf({"kcmc_tpu/ops/bad.py": """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    return x.astype(jnp.float64)
+"""})
+    assert any("explicit float64" in m for m in messages_of(fs))
+
+
+def test_dtype_flow_quiet_on_host_numpy_float64_constants():
+    # the polish-window pattern: a float64 NUMPY constant built at
+    # trace time and cast — never a device-wide dtype
+    fs = tf({"kcmc_tpu/ops/ok.py": """
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+@jax.jit
+def f(x):
+    win = _np.arange(5, dtype=_np.float64)
+    return x * jnp.asarray(win, jnp.float32)
+"""})
+    assert [f for f in fs if f.rule == "dtype-flow"] == []
+
+
+def test_dtype_flow_fires_on_bf16_accumulation_without_acc_dtype():
+    fs = tf({"kcmc_tpu/ops/bad.py": """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    b = x.astype(jnp.bfloat16)
+    return jnp.sum(b)
+
+@jax.jit
+def g(x):
+    b = x.astype(jnp.bfloat16)
+    return jnp.matmul(b, b, preferred_element_type=jnp.float32)
+"""})
+    hits = [m for m in messages_of(fs) if "bf16 accumulation" in m]
+    assert len(hits) == 1 and "'f'" in hits[0], fs
+
+
+def test_dtype_flow_fires_on_widening_upload_cast_in_window():
+    fs = tf({"kcmc_tpu/backends/jax_backend.py": """
+import jax.numpy as jnp
+
+class B:
+    def process_batch_async(self, frames, ref, idx):
+        fj = jnp.asarray(frames, jnp.float32)
+        return self.fn(fj)
+"""})
+    assert any(
+        "host-side widening cast before upload" in m for m in messages_of(fs)
+    )
+
+
+def test_dtype_flow_quiet_on_native_upload_then_device_cast():
+    fs = tf({"kcmc_tpu/backends/jax_backend.py": """
+import jax.numpy as jnp
+
+class B:
+    def process_batch_async(self, frames, ref, idx):
+        fj = jnp.asarray(frames).astype(jnp.float32)
+        return self.fn(fj)
+"""})
+    assert [f for f in fs if f.rule == "dtype-flow"] == []
+
+
+# -- transfer ----------------------------------------------------------------
+
+WINDOW_SRC = """
+import numpy as np
+import jax
+
+class B:
+    def process_batch_async(self, frames, ref, idx):
+        out = self.fn(frames)
+        {window_line}
+        return out
+
+    def prepare_reference(self, frame):
+        return np.asarray(frame)  # setup scope: amortized, quiet
+"""
+
+
+def test_transfer_fires_inside_window_quiet_in_setup():
+    fs = tf({
+        "kcmc_tpu/backends/jax_backend.py": WINDOW_SRC.format(
+            window_line="host = np.asarray(out)"
+        )
+    })
+    hits = [f for f in fs if f.rule == "transfer"]
+    assert len(hits) == 1, fs
+    assert "process_batch_async" in hits[0].message
+    assert "per frame" in hits[0].detail or "unknown" in hits[0].detail
+
+
+def test_transfer_quiet_on_declared_async_copy():
+    fs = tf({
+        "kcmc_tpu/backends/jax_backend.py": WINDOW_SRC.format(
+            window_line="out.copy_to_host_async()"
+        )
+    })
+    assert [f for f in fs if f.rule == "transfer"] == []
+
+
+def test_transfer_fires_on_tree_map_asarray():
+    fs = tf({
+        "kcmc_tpu/backends/jax_backend.py": WINDOW_SRC.format(
+            window_line="host = jax.tree.map(np.asarray, out)"
+        )
+    })
+    assert any("jax.tree.map(np.asarray" in m for m in messages_of(fs))
+
+
+# -- bucket-escape -----------------------------------------------------------
+
+ESCAPE_SRC = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def _metric(x):
+    return x.mean()
+
+class B:
+    def process_batch_async(self, frames, ref, idx):
+        out = self.fn(frames)
+        {line}
+        return out
+"""
+
+
+def test_bucket_escape_fires_on_unaccounted_jit_dispatch():
+    fs = tf({
+        "kcmc_tpu/backends/jax_backend.py": ESCAPE_SRC.format(
+            line="m = _metric(out)"
+        )
+    })
+    hits = [f for f in fs if f.rule == "bucket-escape"]
+    assert len(hits) == 1 and hits[0].severity == "error", fs
+
+
+def test_bucket_escape_quiet_under_plan_accounting():
+    fs = tf({
+        "kcmc_tpu/backends/jax_backend.py": ESCAPE_SRC.format(
+            line="""with self._plan.maybe_timed("quality", (8, 8), "float32"):
+            m = _metric(out)"""
+        )
+    })
+    assert [f for f in fs if f.rule == "bucket-escape"] == []
+
+
+def test_bucket_escape_quiet_when_routed_and_fallback_accounted():
+    fs = tf({"kcmc_tpu/backends/jax_backend.py": """
+import jax
+
+@jax.jit
+def _metric(x):
+    return x.mean()
+
+class B:
+    def process_batch_async(self, frames, ref, idx):
+        bucket = self._plan.route(frames.shape[1:])
+        if bucket is None:
+            self._plan.note_route("bucket_fallback")
+        out = self.fn(frames)
+        m = _metric(out)
+        return out
+"""})
+    assert [f for f in fs if f.rule == "bucket-escape"] == []
+
+
+# -- donation ----------------------------------------------------------------
+
+
+def test_donation_candidate_fires_on_dying_same_shape_input():
+    fs = don({"kcmc_tpu/ops/bad.py": """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def scale(x, y):
+    return jnp.where(x > 0, x * 2.0, y)
+
+def run(data, keep):
+    tmp = jnp.asarray(data)
+    out = scale(tmp, keep)
+    return out, keep
+"""})
+    msgs = messages_of(fs)
+    assert any("double-allocates 'x'" in m for m in msgs), fs
+    # `keep` is returned after the call: live, never a candidate
+    assert not any("double-allocates 'y'" in m for m in msgs), fs
+
+
+def test_donation_quiet_on_astype_and_on_donated_jits():
+    fs = don({"kcmc_tpu/ops/ok.py": """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def casty(x):
+    return x.astype("uint16")
+
+@jax.jit
+def already(x):
+    return x + 1.0
+already_j = jax.jit(already, donate_argnums=(0,))
+
+def run(d):
+    t = jnp.asarray(d)
+    a = casty(t)
+    u = jnp.asarray(d)
+    b = already(u)
+    return a, b
+"""})
+    assert fs == [], fs
+
+
+def test_donation_contract_fires_on_undonated_register_builder():
+    fs = don({"kcmc_tpu/backends/jax_backend.py": """
+import jax
+
+class B:
+    def _get_batch_fn(self, shape):
+        fn = self._instrument_program(
+            "register", shape, self._build_batch_fn(shape)
+        )
+        return fn
+
+    def _build_batch_fn(self, shape):
+        def local(frames):
+            return frames
+        return jax.jit(local)
+"""})
+    assert any(
+        "frame program 'register' compiles without donate_argnums" in m
+        for m in messages_of(fs)
+    ), fs
+
+
+def test_donation_contract_satisfied_by_conditional_donate_kwarg():
+    fs = don({"kcmc_tpu/backends/jax_backend.py": """
+import jax
+
+class B:
+    def _get_batch_fn(self, shape):
+        return self._instrument_program(
+            "register", shape, self._build_batch_fn(shape)
+        )
+
+    def _build_batch_fn(self, shape):
+        def local(frames):
+            return frames
+        return jax.jit(local, donate_argnums=self._donate_argnums())
+"""})
+    assert [f for f in fs if "register" in f.message] == [], fs
+
+
+# -- repo integration --------------------------------------------------------
+
+
+def test_new_passes_run_in_default_suite():
+    from kcmc_tpu.analysis.cli import default_passes
+
+    names = {p.name for p in default_passes()}
+    assert {"traceflow", "donation"} <= names
+
+
+def test_repo_traceflow_findings_all_baselined():
+    """The two new passes over the working tree: every finding must be
+    covered by a justified baseline entry (same gate CI applies, but
+    scoped so a failure names the offending pass)."""
+    from kcmc_tpu.analysis.cli import default_baseline_path
+    from kcmc_tpu.analysis.core import Baseline, run_passes
+
+    index = ModuleIndex.from_package(REPO_ROOT)
+    baseline = Baseline.load(default_baseline_path())
+    result = run_passes(
+        index, [TraceFlowPass(), DonationPass()], baseline
+    )
+    assert result.new == [], [f.format() for f in result.new]
+    for e in baseline.entries:
+        assert e.reason.strip(), f"unjustified baseline entry: {e}"
+
+
+def test_sarif_rules_table_carries_new_rule_ids():
+    from kcmc_tpu.analysis.core import CheckResult
+    from kcmc_tpu.analysis.sarif import to_sarif
+
+    f = Finding(
+        rule="bucket-escape",
+        path="kcmc_tpu/backends/jax_backend.py",
+        line=3,
+        severity="error",
+        message="jitted '_metric' dispatched from the window",
+    )
+    log = to_sarif(
+        CheckResult(
+            findings=[f], new=[f], baselined=[], baseline_problems=[],
+            passes=["traceflow"],
+        )
+    )
+    rules = {
+        r["id"]
+        for r in log["runs"][0]["tool"]["driver"]["rules"]
+    }
+    assert {
+        "retrace", "dtype-flow", "transfer", "bucket-escape", "donation"
+    } <= rules
+    assert log["runs"][0]["results"][0]["ruleId"] == "bucket-escape"
+    # schema sanity when jsonschema is around (full validation lives in
+    # test_analysis.py)
+    try:
+        import jsonschema  # noqa: F401
+    except ImportError:
+        pass
+
+
+# -- incremental check cache -------------------------------------------------
+
+
+class _CountingPass:
+    """Program-scoped stub: counts run() invocations."""
+
+    name = "counting"
+
+    def __init__(self):
+        self.runs = 0
+
+    def run(self, index):
+        self.runs += 1
+        return [
+            Finding(
+                rule="counting", path=m.path, line=1,
+                severity="warning", message=f"saw {m.path}",
+            )
+            for m in index
+        ]
+
+
+class _ModulePass(_CountingPass):
+    """Module-scoped stub: records which module paths it analyzed."""
+
+    name = "permodule"
+    cache_scope = "module"
+
+    def __init__(self):
+        super().__init__()
+        self.paths: list[str] = []
+
+    def run(self, index):
+        self.paths.extend(m.path for m in index)
+        return super().run(index)
+
+
+def _fake_repo(tmp_path, extra=""):
+    pkg = tmp_path / "kcmc_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text("A = 1\n")
+    (pkg / "b.py").write_text(f"B = 2\n{extra}")
+    return str(tmp_path)
+
+
+def test_cache_replays_program_scoped_results(tmp_path):
+    from kcmc_tpu.analysis.cache import CheckCache
+    from kcmc_tpu.analysis.core import run_passes
+
+    root = _fake_repo(tmp_path)
+    p = _CountingPass()
+    idx = ModuleIndex.from_package(root)
+    r1 = run_passes(idx, [p], cache=CheckCache(root))
+    r2 = run_passes(idx, [p], cache=CheckCache(root))
+    assert p.runs == 1  # second run replayed from cache
+    assert [f.message for f in r1.findings] == [
+        f.message for f in r2.findings
+    ]
+    # an edit invalidates: the pass runs again
+    (tmp_path / "kcmc_tpu" / "b.py").write_text("B = 3\n")
+    idx2 = ModuleIndex.from_package(root)
+    run_passes(idx2, [p], cache=CheckCache(root))
+    assert p.runs == 2
+
+
+def test_cache_module_scope_reanalyzes_only_changed_modules(tmp_path):
+    from kcmc_tpu.analysis.cache import CheckCache
+    from kcmc_tpu.analysis.core import run_passes
+
+    root = _fake_repo(tmp_path)
+    p = _ModulePass()
+    run_passes(
+        ModuleIndex.from_package(root), [p], cache=CheckCache(root)
+    )
+    assert sorted(p.paths) == [
+        "kcmc_tpu/__init__.py", "kcmc_tpu/a.py", "kcmc_tpu/b.py",
+    ]
+    p.paths.clear()
+    (tmp_path / "kcmc_tpu" / "b.py").write_text("B = 4\n")
+    r = run_passes(
+        ModuleIndex.from_package(root), [p], cache=CheckCache(root)
+    )
+    assert p.paths == ["kcmc_tpu/b.py"]  # a.py replayed from cache
+    assert {f.path for f in r.findings if f.rule == "counting"} == {
+        "kcmc_tpu/__init__.py", "kcmc_tpu/a.py", "kcmc_tpu/b.py",
+    }
+
+
+def test_cache_hit_is_faster_than_cold_on_the_real_repo(tmp_path):
+    """The headline contract: a repeat `kcmc check` replays instead of
+    re-deriving. Cold runs the full nine-pass suite (seconds); the hit
+    is file IO (tens of ms). Asserted at a conservative 3x."""
+    import shutil
+
+    from kcmc_tpu.analysis.cli import default_passes, run_check
+
+    # isolate the cache: copy nothing, point the cache at a scratch
+    # root by running against the real repo but a scratch cache dir
+    cache_dir = os.path.join(REPO_ROOT, ".kcmc_check_cache")
+    had = os.path.isdir(cache_dir)
+    backup = None
+    if had:
+        backup = str(tmp_path / "cache_backup")
+        shutil.move(cache_dir, backup)
+    try:
+        t0 = time.perf_counter()
+        r1 = run_check(REPO_ROOT, passes=default_passes(), use_cache=True)
+        cold = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r2 = run_check(REPO_ROOT, passes=default_passes(), use_cache=True)
+        hit = time.perf_counter() - t0
+        assert len(r1.findings) == len(r2.findings)
+        assert hit * 3 < cold, (cold, hit)
+    finally:
+        if os.path.isdir(cache_dir):
+            shutil.rmtree(cache_dir)
+        if backup is not None:
+            shutil.move(backup, cache_dir)
+
+
+def test_cache_ignores_corrupt_files(tmp_path):
+    from kcmc_tpu.analysis.cache import CheckCache
+    from kcmc_tpu.analysis.core import run_passes
+
+    root = _fake_repo(tmp_path)
+    cache_dir = tmp_path / ".kcmc_check_cache"
+    cache_dir.mkdir()
+    (cache_dir / "results.json").write_text("{not json")
+    p = _CountingPass()
+    r = run_passes(
+        ModuleIndex.from_package(root), [p], cache=CheckCache(root)
+    )
+    assert p.runs == 1 and len(r.findings) == 3
+
+
+# -- retrace sentinel (unit: no jax) ----------------------------------------
+
+
+def test_sentinel_convicts_covered_compile_after_arm():
+    from kcmc_tpu.analysis import sanitize
+
+    with sanitize.retrace_sentinel(
+        covered=("register",), predicted={("register", (64, 64), "float32")}
+    ):
+        # warm-up builds never convict
+        sanitize.note_compile(
+            "register", (64, 64), "float32", during_build=True
+        )
+        # uncovered programs never convict
+        sanitize.note_compile("quality", (50, 70), "float32")
+        assert sanitize.take_violations() == []
+        sanitize.note_compile("register", (80, 80), "float32")
+    v = sanitize.take_violations()
+    assert len(v) == 1 and "escaped the plan_buckets ladder" in v[0], v
+    assert sanitize.take_violations() == []  # drained
+
+
+def test_sentinel_disarmed_is_free():
+    from kcmc_tpu.analysis import sanitize
+
+    sanitize.note_compile("register", (64, 64), "float32")
+    assert sanitize.take_violations() == []
+    assert sanitize.sentinel_stats() == {"armed": False}
+
+
+def test_predict_compile_keys_matches_ladder():
+    from kcmc_tpu.config import CorrectorConfig
+    from kcmc_tpu.plans.runtime import predict_compile_keys
+
+    cfg = CorrectorConfig(plan_buckets=(64, (96, 128)))
+    keys = predict_compile_keys(cfg, dtypes=("float32", "uint16"))
+    assert ("register", (64, 64), "uint16") in keys
+    assert ("register", (96, 128), "float32") in keys
+    assert ("reference", (64, 64), "float32") in keys
+    # reference/apply warm float32 only — uint16 batches cast on device
+    assert ("reference", (64, 64), "uint16") not in keys
+    assert ("apply", (96, 128), "float32") in keys
+
+
+# -- retrace sentinel (integration: warmed corrector) ------------------------
+
+
+@pytest.mark.slow
+def test_warmed_corrector_records_zero_postwarmup_compiles():
+    """The acceptance contract: static prediction == runtime
+    observation. A warmed corrector serving in-bucket traffic compiles
+    NOTHING after warm-up; an out-of-ladder shape convicts. Runs in
+    the CI sanitize job (which takes tests/test_sanitize.py and this
+    module without the tier-1 slow filter)."""
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.analysis import sanitize
+    from kcmc_tpu.plans.runtime import predict_compile_keys
+
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        max_keypoints=64, n_hypotheses=32, plan_buckets=(64,),
+    )
+    mc.warmup()
+    plan = mc.backend._plan
+    pred = predict_compile_keys(mc.config)
+    seen = {
+        (p, s, dt)
+        for (p, s, dt, _rung) in plan.compile_counts
+        if p in ("reference", "register", "apply")
+    }
+    assert seen == pred, (seen, pred)  # ladder == observation, exactly
+
+    rng = np.random.default_rng(0)
+    stack = (rng.random((16, 64, 64)) * 1000).astype(np.float32)
+    with sanitize.retrace_sentinel(predicted=pred, label="warmed"):
+        mc.correct(stack)
+    assert sanitize.take_violations() == []
+
+    off = (rng.random((16, 80, 80)) * 1000).astype(np.float32)
+    with sanitize.retrace_sentinel(predicted=pred, label="warmed"):
+        mc.correct(off)
+    v = sanitize.take_violations()
+    assert v and all("escaped the plan_buckets ladder" in m for m in v), v
+
+
+# -- donation runtime guard --------------------------------------------------
+
+
+@pytest.mark.slow
+def test_register_donation_preserves_caller_device_arrays():
+    """The donating register program must never invalidate a
+    caller-owned device array (the defensive-copy guard), and donating
+    vs non-donating configs agree bitwise."""
+    import jax.numpy as jnp
+
+    from kcmc_tpu import MotionCorrector
+
+    rng = np.random.default_rng(1)
+    stack = (rng.random((8, 48, 48)) * 1000).astype(np.float32)
+    kw = dict(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=32, n_hypotheses=16,
+    )
+    mc_d = MotionCorrector(**kw)
+    mc_n = MotionCorrector(donate_buffers=False, **kw)
+    ref = mc_d.backend.prepare_reference(stack[0])
+    idx = np.arange(4, dtype=np.uint32)
+
+    dev = jnp.asarray(stack[:4])
+    out_d = mc_d.backend.process_batch(dev, ref, idx)
+    np.asarray(dev)  # raises if the guard failed and dev was donated
+
+    ref_n = mc_n.backend.prepare_reference(stack[0])
+    out_n = mc_n.backend.process_batch(stack[:4], ref_n, idx)
+    np.testing.assert_allclose(
+        out_d["transform"], out_n["transform"], atol=1e-5
+    )
+
+
+def test_retrace_respects_static_argnums_integers():
+    fs = tf({"kcmc_tpu/ops/ok.py": """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def f(x, n):
+    if n:
+        return x[:n]
+    return x
+"""})
+    assert [f for f in fs if f.rule == "retrace"] == [], fs
+
+
+def test_dtype_flow_quiet_on_np_float64_scalar_constructor():
+    fs = tf({"kcmc_tpu/ops/ok.py": """
+import jax
+import numpy as np
+
+@jax.jit
+def f(x):
+    scale = np.float64(0.5)
+    return x * float(scale)
+"""})
+    assert [f for f in fs if f.rule == "dtype-flow"] == [], fs
+
+
+def test_donation_quiet_when_buffer_read_earlier_in_a_loop():
+    """A read at a LOWER line than the call, inside the same loop, is a
+    next-iteration read — never a donation candidate."""
+    fs = don({"kcmc_tpu/ops/ok.py": """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x + 1.0
+
+def run(data, k):
+    buf = jnp.asarray(data)
+    total = 0.0
+    for _ in range(k):
+        total = total + buf.sum()
+        out = step(buf)
+    return out, total
+"""})
+    assert fs == [], fs
