@@ -36,6 +36,43 @@ import time
 import numpy as np
 
 
+# Judged-sweep policy (PR 13): one discarded warm-up sweep, judged =
+# median of the remaining three. Rides inside every device-path result
+# so the artifact documents how its headline number was formed.
+SWEEPS_JUDGED = 3
+SWEEP_POLICY = {
+    "sweeps": 1 + SWEEPS_JUDGED,
+    "discard_warmup": 1,
+    "judged": f"median-of-{SWEEPS_JUDGED}",
+}
+
+# The judged per-config generator table (label -> (model, overrides));
+# shared by the default per-config rows, --profile, and the multichip
+# rows so a config means the same thing everywhere.
+CONFIG_ROWS = {
+    # Config 2 (BASELINE configs[1]): a true ~2k surviving
+    # matches/frame — dense sharp scene, K=4096 keypoints, finer
+    # Harris window + candidate tile (the detector's density
+    # ceiling), MXU Hamming matcher. Measured ~2.5k matches/frame.
+    # Batch 32 bounds the per-batch (B, K, K) distance matrix to
+    # ~2 GB of HBM.
+    "affine@2k": ("affine", {
+        "max_keypoints": 4096, "n_blobs": 12000,
+        "sigma_range": (0.7, 1.4), "nms_size": 3,
+        "harris_window_sigma": 1.2, "cand_tile": 4,
+        "batch": 32,
+    }),
+    "piecewise": ("piecewise", {}),
+    "homography": ("homography", {}),
+    # Scale-pyramid path (round-4 capability, benched since round 5
+    # per VERDICT r4 item 7): similarity drift with the generator's
+    # ±3% zoom walk through n_octaves=3 — records the pyramid +
+    # coarse-to-fine + polish path's fps and RMSE so a regression
+    # there is driver-visible round over round.
+    "pyramid": ("similarity", {"n_octaves": 3}),
+}
+
+
 def _build_stack(
     n_frames: int, size: int, model: str,
     n_blobs: int | None = None, sigma_range=None,
@@ -129,12 +166,15 @@ def run_bench_device(
     n_check = (base + batch - 1) // batch
     done = (n_frames // batch) * batch
     checks, sweeps = [], []
-    # Clock/tunnel noise makes single runs swing +-25%; the judged value
-    # is the MEDIAN of three timed sweeps (each is a full dispatch train
-    # with a forced completion barrier, so every sweep is real sustained
-    # work) — and ALL three sweep rates are recorded in the result so
-    # round-over-round drift is attributable to noise vs regression.
-    for rep in range(3):
+    # Sweep policy (PR 13, documented in the emitted JSON): FOUR full
+    # sweeps; sweep 0 is a WARM-UP DISCARD (the ~3 s warm loop above
+    # mostly covers clock ramp, but BENCH_r05's rigid3d still recorded
+    # a 275 vs 293 outlier sweep — one cold/preempted sweep must not be
+    # able to skew a judged line), and the judged value is the MEDIAN
+    # of the remaining three. Every sweep rate (including the
+    # discarded one) is recorded so round-over-round drift stays
+    # attributable to noise vs regression.
+    for rep in range(1 + SWEEPS_JUDGED):
         last = None
         t0 = time.perf_counter()
         for lo in range(0, n_frames - batch + 1, batch):
@@ -161,13 +201,15 @@ def run_bench_device(
         got if key == "field" else None,
     )
     return {
-        # Headline = MEDIAN sweep (sturdier than max against one lucky
-        # sweep); all sweep rates still land in sweeps_fps for audit.
-        "fps": float(np.median(sweeps)),
+        # Headline = MEDIAN of the post-discard sweeps (sturdier than
+        # max against one lucky sweep); all rates land in sweeps_fps
+        # for audit, discarded warm-up first.
+        "fps": float(np.median(sweeps[1:])),
         "seconds": dt,
         "rmse_px": rmse,
         "n_frames": done,
         "sweeps_fps": [round(s, 2) for s in sweeps],
+        "sweep_policy": SWEEP_POLICY,
     }
 
 
@@ -334,14 +376,8 @@ def run_bench_multichip(
     rows = [("translation", "translation", {})]
     if not smoke:
         rows += [
-            ("affine@2k", "affine", {
-                "max_keypoints": 4096, "n_blobs": 12000,
-                "sigma_range": (0.7, 1.4), "nms_size": 3,
-                "harris_window_sigma": 1.2, "cand_tile": 4,
-                "batch": 32,
-            }),
-            ("piecewise", "piecewise", {}),
-            ("homography", "homography", {}),
+            (label, CONFIG_ROWS[label][0], dict(CONFIG_ROWS[label][1]))
+            for label in ("affine@2k", "piecewise", "homography")
         ]
     configs = {}
     for label, model, kw in rows:
@@ -764,6 +800,97 @@ def coldstart_judged_json_line(
     return json.dumps(rec)
 
 
+def run_bench_profile(
+    label: str, n_frames: int, size: int, batch: int,
+) -> dict:
+    """`--profile <config>`: per-stage cost breakdown of one judged
+    config, so the next slow-config investigation starts from data
+    instead of re-instrumenting.
+
+    Two complementary views land in one record:
+
+    * ``stages`` — true incremental per-device-stage cost
+      (detect / +describe / +match / +consensus / +warp) from
+      `utils.profiling.stage_breakdown`'s cumulative-prefix protocol
+      (2D matrix models; None for piecewise/rigid3d, whose stages
+      don't decompose into that prefix chain).
+    * ``spans`` — the PR-4 trace spans of a short REAL run (host
+      stages, dispatch windows, stalls, compiles), aggregated as
+      total ms + share-of-wall per span name, from the same Chrome
+      trace a user would capture with ``--trace``.
+    """
+    import os
+    import tempfile
+
+    known = dict(CONFIG_ROWS)
+    known["translation"] = ("translation", {})
+    known["rigid3d"] = ("rigid3d", {"batch": min(batch, 8)})
+    if label not in known:
+        raise SystemExit(
+            f"--profile {label!r}: unknown config (choose from "
+            f"{sorted(known)})"
+        )
+    model, kw = known[label]
+    kw = dict(kw)
+    b = kw.pop("batch", batch)
+    gen_kw = {
+        k: kw.pop(k) for k in ("n_blobs", "sigma_range") if k in kw
+    }
+    rec: dict = {"metric": f"profile_{label}", "model": model, "batch": b}
+
+    if model not in ("piecewise", "rigid3d"):
+        from kcmc_tpu.utils.profiling import stage_breakdown
+
+        # The judged scene exactly (affine@2k's density knobs ride in
+        # gen_kw) — per-stage prices depend on match density.
+        rec["stages"] = stage_breakdown(
+            model=model, shape=(size, size), batch_size=b, **gen_kw, **kw
+        )
+    else:
+        rec["stages"] = None
+
+    # Short traced run for the span view (PR-4 obs machinery).
+    from kcmc_tpu import MotionCorrector
+
+    data = _build_stack(min(n_frames, 256), size, model, **gen_kw)
+    stack = np.asarray(data.stack, np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        trace_path = os.path.join(td, "trace.json")
+        mc = MotionCorrector(
+            model=model, backend="jax", batch_size=b,
+            trace_path=trace_path, **kw,
+        )
+        # Warm THE SAME corrector (compiled closures are per backend
+        # instance — warming a sibling leaves the traced run to pay
+        # the full jit compile and report compile-dominated spans);
+        # the second correct() rewrites the trace file with the warm
+        # run's spans.
+        mc.correct(stack)
+        t0 = time.perf_counter()
+        mc.correct(stack)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        with open(trace_path) as f:
+            trace = json.load(f)
+    spans: dict = {}
+    for ev in trace.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        s = spans.setdefault(
+            ev["name"], {"total_ms": 0.0, "count": 0, "cat": ev.get("cat")}
+        )
+        s["total_ms"] += ev.get("dur", 0) / 1e3
+        s["count"] += 1
+    for s in spans.values():
+        s["total_ms"] = round(s["total_ms"], 2)
+        s["share_of_wall"] = round(s["total_ms"] / max(wall_ms, 1e-9), 3)
+    rec["spans"] = dict(
+        sorted(spans.items(), key=lambda kv: -kv[1]["total_ms"])
+    )
+    rec["wall_ms"] = round(wall_ms, 1)
+    rec["fps"] = round(len(stack) / (wall_ms / 1e3), 1)
+    return rec
+
+
 # -- regression gate (ROADMAP item 4: the BENCH_r* trajectory only
 # moves forward) -------------------------------------------------------------
 
@@ -779,6 +906,11 @@ REGRESS_SMOKE_ROWS = (
     ("translation", "translation", {}),
     ("homography", "homography", {}),
     ("piecewise", "piecewise", {}),
+    # PR 13: an oriented matrix-model row so the smoke gate covers the
+    # fused match→consensus dispatch + budget ladder + int8 match path
+    # (translation runs unoriented; homography covers the projective
+    # solver — affine is the config-2 family the overhaul targets).
+    ("affine", "affine", {}),
 )
 REGRESS_TOL = 0.05
 
@@ -918,6 +1050,14 @@ def main() -> None:
         help="also print the per-stage incremental cost breakdown (stderr)",
     )
     ap.add_argument(
+        "--profile", default="", metavar="CONFIG",
+        help="per-stage fps/cost breakdown of ONE judged config "
+        "(translation | affine@2k | piecewise | homography | pyramid | "
+        "rigid3d): incremental device-stage costs (2D matrix models) "
+        "plus the aggregated PR-4 trace spans of a short real run — "
+        "one JSON record on stdout",
+    )
+    ap.add_argument(
         "--streaming", action="store_true",
         help="also time the zero-stall streaming config (correct_file, "
         "rolling template updates, background writeback) and report its "
@@ -1044,6 +1184,16 @@ def main() -> None:
 
     import jax
 
+    if args.profile:
+        print(
+            json.dumps(
+                run_bench_profile(
+                    args.profile, args.frames, args.size, args.batch
+                )
+            )
+        )
+        return
+
     if (args.multichip or args.hostfed) and args.smoke:
         # this image's TPU-tunnel plugin force-resets jax_platforms via
         # jax.config on import — pin the forced-CPU smoke back
@@ -1152,27 +1302,10 @@ def main() -> None:
         # keyed by the flagship's actual model — a --model override must
         # not mislabel its numbers as the translation contract row
         configs = {args.model: _config_row(r)}
+        # The shared judged generator table (CONFIG_ROWS — also the
+        # --profile vocabulary), copied because `batch` pops below.
         rows = [
-            # Config 2 (BASELINE configs[1]): a true ~2k surviving
-            # matches/frame — dense sharp scene, K=4096 keypoints,
-            # finer Harris window + candidate tile (the detector's
-            # density ceiling), MXU Hamming matcher. Measured ~2.5k
-            # matches/frame. Batch 32 bounds the per-batch
-            # (B, K, K) distance matrix to ~2 GB of HBM.
-            ("affine@2k", "affine", {
-                "max_keypoints": 4096, "n_blobs": 12000,
-                "sigma_range": (0.7, 1.4), "nms_size": 3,
-                "harris_window_sigma": 1.2, "cand_tile": 4,
-                "batch": 32,
-            }),
-            ("piecewise", "piecewise", {}),
-            ("homography", "homography", {}),
-            # Scale-pyramid path (round-4 capability, benched since
-            # round 5 per VERDICT r4 item 7): similarity drift with the
-            # generator's ±3% zoom walk through n_octaves=3 — records
-            # the pyramid + coarse-to-fine + polish path's fps and RMSE
-            # so a regression there is driver-visible round over round.
-            ("pyramid", "similarity", {"n_octaves": 3}),
+            (label, m, dict(kw)) for label, (m, kw) in CONFIG_ROWS.items()
         ]
         if args.all:
             rows = [

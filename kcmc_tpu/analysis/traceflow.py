@@ -131,6 +131,15 @@ BYTES_HINTS = {
     "transforms": "~36-64 B per frame",
     "field": "gh*gw*8 B per frame",
     "n_inliers": "4 B per frame",
+    # PR-13 fused register program additions: the warm-start seed pair
+    # rides host->device REPLICATED per dispatch (not per frame), and
+    # the fused tail's match-count diagnostic is per frame like
+    # n_inliers.
+    "seed": "(d+1)^2*4 + 1 B per DISPATCH (replicated seed pair)",
+    "seed_M": "(d+1)^2*4 B per DISPATCH (replicated seed matrix)",
+    "seed_ok": "1 B per DISPATCH (replicated seed flag)",
+    "n_matches": "4 B per frame",
+    "rms_residual": "4 B per frame",
 }
 
 

@@ -29,7 +29,6 @@ from kcmc_tpu.ops import piecewise as pw
 from kcmc_tpu.ops.describe import describe_keypoints, describe_keypoints_batch
 from kcmc_tpu.ops.detect import detect_keypoints, detect_keypoints_batch
 from kcmc_tpu.ops.match import knn_match
-from kcmc_tpu.ops.ransac import ransac_estimate
 from kcmc_tpu.ops.warp import warp_batch_with_ok, warp_frame_flow, warp_volume
 
 
@@ -263,6 +262,10 @@ class JaxBackend:
         from kcmc_tpu.plans.runtime import PlanRuntime
 
         self._plan = PlanRuntime(config, backend_name=self.name, mesh=mesh)
+        # Per-shape autotuned tile parameters (plans/autotune.py),
+        # resolved once per backend instance per shape at program-build
+        # time. {} everywhere tuning is off/inapplicable.
+        self._tile_cache: dict[tuple, dict] = {}
 
     # -- reference preparation --------------------------------------------
 
@@ -307,6 +310,9 @@ class JaxBackend:
                 desc = describe_keypoints(
                     frame, kps, oriented=cfg.resolved_oriented(),
                     blur_sigma=cfg.blur_sigma,
+                    precision=cfg.resolved_match_precision(
+                        self._on_accelerator()
+                    ),
                 )
                 return {"xy": kps.xy, "desc": desc, "valid": kps.valid}
 
@@ -335,7 +341,10 @@ class JaxBackend:
                 # as the batch program, so frame and reference keypoint
                 # sets share octave layout and coordinate convention.
                 kps, desc = self._detect_describe_2d(
-                    frame[None], self._on_accelerator()
+                    frame[None], self._on_accelerator(),
+                    tiles=self._tile_params(
+                        tuple(int(s) for s in frame.shape)
+                    ),
                 )
                 return self._mesh_ref({
                     "xy": kps.xy[0], "desc": desc[0],
@@ -457,7 +466,7 @@ class JaxBackend:
 
     def process_batch_async(
         self, frames, ref: dict, frame_indices, to_host=True, cast_dtype=None,
-        emit_frames=True,
+        emit_frames=True, seed=None,
     ) -> dict:
         """Dispatch one batch; return the *device* output arrays without
         blocking. With `to_host` (the orchestrator's host-fed path) the
@@ -479,7 +488,14 @@ class JaxBackend:
         returned dict so their device->host copy — the dominant
         transfer — never happens. The warp still executes on device
         (it is part of the compiled program, and the quality metrics
-        read it); only the transfer is skipped."""
+        read it); only the transfer is skipped.
+
+        `seed` (warm_start configs, matrix models): a ((d+1, d+1)
+        transform, ok-bool) pair — typically the previous batch's last
+        transform, still an ASYNC device array — scored as hypothesis
+        zero of every frame's consensus (temporal warm start; see
+        ops/ransac.consensus_batch). None dispatches an identity seed
+        with ok=False, so the compiled signature is seed-invariant."""
         shape = tuple(frames.shape[1:])
         plan = self._plan
         bucket = plan.route(shape) if plan.active else None
@@ -548,6 +564,15 @@ class JaxBackend:
             ref["_plan_frame"] if valid_hw is not None else ref["frame"],
             idx_j,
         )
+        if self.config.warm_start and self.config.model != "piecewise":
+            dd = 4 if len(shape) == 3 else 3
+            if seed is None:
+                seed_M = jnp.eye(dd, dtype=jnp.float32)
+                seed_ok = jnp.bool_(False)
+            else:
+                seed_M = jnp.asarray(seed[0], jnp.float32)
+                seed_ok = jnp.asarray(seed[1], bool)
+            args = args + (seed_M, seed_ok)
         if valid_hw is not None:
             args = args + (valid_hw,)
         out = fn(*args)
@@ -762,8 +787,13 @@ class JaxBackend:
         if self.mesh is not None:
             from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
 
+            # Trailing replicated args: the warm-start seed pair (a
+            # shared (d+1, d+1) matrix + () bool) precedes the bucketed
+            # valid_hw extent — all tiny, identical on every chip.
+            warm = self.config.warm_start and self.config.model != "piecewise"
             return make_sharded_batch_fn(
-                local, self.mesh, extra_replicated=1 if bucketed else 0
+                local, self.mesh,
+                extra_replicated=(2 if warm else 0) + (1 if bucketed else 0),
             )
         # Buffer donation (the kcmc-check donation-audit contract): the
         # corrected output matches the frame batch's shape/dtype only
@@ -775,7 +805,8 @@ class JaxBackend:
         return jax.jit(local, donate_argnums=self._donate_argnums())
 
     def _detect_describe_2d(
-        self, frames, use_pallas: bool, multi_scale=True, valid_hw=None
+        self, frames, use_pallas: bool, multi_scale=True, valid_hw=None,
+        tiles=None,
     ):
         """The 2D detect+describe stage for a (B, H, W) float32 batch:
         single-scale by default; with `n_octaves > 1`, the ORB scale
@@ -789,8 +820,17 @@ class JaxBackend:
         pyramid configs out)."""
         cfg = self.config
         oriented = cfg.resolved_oriented()
+        precision = cfg.resolved_match_precision(self._on_accelerator())
+        # Autotuned tilings apply at the tuned (base) frame shape only;
+        # other shapes in the same program (pyramid octaves) keep the
+        # per-kernel defaults. `tiles` is resolved at BUILD time (the
+        # tuning search times candidate kernels — it must never run
+        # inside a trace), so it arrives as a plain dict of static
+        # ints, keyed by the shape it was tuned for.
+        tiles = tiles or {}
 
         def stage(fr, k_octave, border):
+            t = tiles if tiles.get("shape") == tuple(fr.shape[1:]) else {}
             kps, smooth = detect_keypoints_batch(
                 fr,
                 max_keypoints=k_octave,
@@ -803,6 +843,7 @@ class JaxBackend:
                 window_sigma=cfg.harris_window_sigma,
                 cand_tile=cfg.cand_tile,
                 valid_hw=valid_hw,
+                strip=t.get("detect_strip"),
             )
             desc = describe_keypoints_batch(
                 fr,
@@ -811,6 +852,8 @@ class JaxBackend:
                 blur_sigma=cfg.blur_sigma,
                 use_pallas=use_pallas,
                 smooth=smooth,
+                precision=precision,
+                bands=t.get("patch_bands"),
             )
             return kps, desc
 
@@ -837,6 +880,12 @@ class JaxBackend:
         use_pallas_patches = self._on_accelerator()
         base_key = jax.random.key(cfg.seed)
         is_pw = cfg.model == "piecewise"
+        precision = cfg.resolved_match_precision(self._on_accelerator())
+        warm = cfg.warm_start and not is_pw
+        # Autotuned tile parameters for this shape, resolved NOW (build
+        # time — the candidate-timing search must never run inside a
+        # trace; see plans/autotune.py).
+        tiles = self._tile_params(shape)
         if bucketed and is_pw:
             raise ValueError(
                 "bucketed execution covers 2D matrix models only (the "
@@ -861,7 +910,7 @@ class JaxBackend:
             )
 
         def core(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices,
-                 valid_hw):
+                 valid_hw, seed_M=None, seed_ok=None):
             # Frames upload in their native dtype (uint16 stacks halve
             # the host->device bytes); all math runs in float32.
             frames = frames.astype(jnp.float32)
@@ -893,45 +942,50 @@ class JaxBackend:
                 # Template keypoints bucketed once per batch, shared by
                 # every frame's banded match (outside the vmap below).
                 bref = build_banded_ref(
-                    banded_geom, ref_xy, ref_desc, ref_valid
+                    banded_geom, ref_xy, ref_desc, ref_valid,
+                    precision=precision,
                 )
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(indices)
             kps, desc = self._detect_describe_2d(
-                frames, use_pallas_patches, valid_hw=valid_hw
+                frames, use_pallas_patches, valid_hw=valid_hw, tiles=tiles
             )
 
-            def tail(frame, kp, d, key):
-                if banded_geom is not None:
-                    from kcmc_tpu.ops.match_banded import banded_match
+            def banded_matches(kps_b, desc_b):
+                from kcmc_tpu.ops.match_banded import banded_match
 
-                    m = banded_match(
+                return jax.vmap(
+                    lambda d, xy, v: banded_match(
                         banded_geom,
                         bref,
                         d,
-                        kp.xy,
-                        kp.valid,
+                        xy,
+                        v,
                         ratio=cfg.ratio,
                         max_dist=cfg.max_hamming,
                         mutual=cfg.mutual,
+                        precision=precision,
                     )
-                else:
-                    m = knn_match(
-                        d,
-                        ref_desc,
-                        kp.valid,
-                        ref_valid,
-                        ratio=cfg.ratio,
-                        max_dist=cfg.max_hamming,
-                        mutual=cfg.mutual,
-                    )
-                # Correspondences: reference keypoint -> frame position.
-                src = ref_xy[m.idx]
-                dst = kp.xy
-                out = {
-                    "n_keypoints": jnp.sum(kp.valid).astype(jnp.int32),
-                    "n_matches": jnp.sum(m.valid).astype(jnp.int32),
-                }
-                if is_pw:
+                )(desc_b, kps_b.xy, kps_b.valid)
+
+            if is_pw:
+                # The piecewise field estimator keeps its per-frame
+                # path (no matrix consensus to fuse into); its matcher
+                # still benefits from the precision variants.
+                def tail(frame, kp, d, key, m):
+                    if m is None:
+                        m = knn_match(
+                            d,
+                            ref_desc,
+                            kp.valid,
+                            ref_valid,
+                            ratio=cfg.ratio,
+                            max_dist=cfg.max_hamming,
+                            mutual=cfg.mutual,
+                            precision=precision,
+                        )
+                    # Correspondences: reference keypoint -> frame pos.
+                    src = ref_xy[m.idx]
+                    dst = kp.xy
                     res = pw.estimate_field(
                         src,
                         dst,
@@ -950,27 +1004,74 @@ class JaxBackend:
                         patch_model=cfg.patch_model,
                         refine_hyps=cfg.refine_hypotheses,
                     )
-                    # warping is batch-level for BOTH flow paths now
-                    # (the correlation polish needs the warped batch)
-                    out["field"] = res.field
-                else:
-                    res = ransac_estimate(
+                    return {
+                        "n_keypoints": jnp.sum(kp.valid).astype(jnp.int32),
+                        "n_matches": jnp.sum(m.valid).astype(jnp.int32),
+                        # warping is batch-level for BOTH flow paths now
+                        # (the correlation polish needs the warped batch)
+                        "field": res.field,
+                        "n_inliers": res.n_inliers,
+                        "rms_residual": res.rms_residual,
+                    }
+
+                def tail_batch(frames_b, kps_b, desc_b, keys_b, sM, sok):
+                    del sM, sok  # fields have no transform seed
+                    if banded_geom is not None:
+                        m = banded_matches(kps_b, desc_b)
+                        return jax.vmap(tail)(frames_b, kps_b, desc_b, keys_b, m)
+                    return jax.vmap(
+                        lambda f, kp, d, k: tail(f, kp, d, k, None)
+                    )(frames_b, kps_b, desc_b, keys_b)
+            else:
+                # Fused match→consensus (PR 13): the Hamming matrices,
+                # 2-NN selection, and the budget-laddered hypothesis
+                # consensus trace as ONE region over the whole batch —
+                # no nested-pjit seam between match and consensus, and
+                # (frames × hypotheses) blocked solves/scores instead
+                # of B×H per-frame launches (ops/fused.py).
+                from kcmc_tpu.ops.fused import fused_match_consensus
+
+                def tail_batch(frames_b, kps_b, desc_b, keys_b, sM, sok):
+                    del frames_b
+                    m = (
+                        banded_matches(kps_b, desc_b)
+                        if banded_geom is not None
+                        else None
+                    )
+                    res, n_matches = fused_match_consensus(
                         model,
-                        src,
-                        dst,
-                        m.valid,
-                        key,
+                        desc_b,
+                        kps_b.xy,
+                        kps_b.valid,
+                        ref_desc,
+                        ref_xy,
+                        ref_valid,
+                        keys_b,
+                        ratio=cfg.ratio,
+                        max_dist=cfg.max_hamming,
+                        mutual=cfg.mutual,
+                        precision=precision,
                         n_hypotheses=cfg.n_hypotheses,
                         threshold=cfg.inlier_threshold,
                         refine_iters=cfg.refine_iters,
                         score_cap=cfg.score_cap,
+                        budget_rungs=cfg.budget_rungs,
+                        early_exit_frac=cfg.early_exit_frac,
+                        seed_transform=sM,
+                        seed_ok=sok,
+                        matches=m,
                     )
-                    out["transform"] = res.transform
-                out["n_inliers"] = res.n_inliers
-                out["rms_residual"] = res.rms_residual
-                return out
+                    return {
+                        "n_keypoints": jnp.sum(
+                            kps_b.valid, axis=1
+                        ).astype(jnp.int32),
+                        "n_matches": n_matches,
+                        "transform": res.transform,
+                        "n_inliers": res.n_inliers,
+                        "rms_residual": res.rms_residual,
+                    }
 
-            out = jax.vmap(tail)(frames, kps, desc, keys)
+            out = tail_batch(frames, kps, desc, keys, seed_M, seed_ok)
             if not is_pw and cfg.n_octaves > 1 and cfg.pyramid_refine:
                 # Coarse-to-fine: the multi-scale estimate's floor is
                 # the coarse octave's localization noise (subpixel
@@ -995,12 +1096,16 @@ class JaxBackend:
                 coarse = out["transform"]
                 corrected0, ok0 = vwarp(frames, coarse)
                 kps2, desc2 = self._detect_describe_2d(
-                    corrected0, use_pallas_patches, multi_scale=False
+                    corrected0, use_pallas_patches, multi_scale=False,
+                    tiles=tiles,
                 )
                 keys2 = jax.vmap(
                     lambda k: jax.random.fold_in(k, 1)
                 )(keys)
-                out2 = jax.vmap(tail)(corrected0, kps2, desc2, keys2)
+                # Fine pass: residual motion is near-identity, so the
+                # caller's temporal seed (which targets the FULL
+                # motion) does not apply here.
+                out2 = tail_batch(corrected0, kps2, desc2, keys2, None, None)
                 coarse_matches = out["n_matches"]
                 out = dict(out2)
                 eye = jnp.broadcast_to(
@@ -1080,7 +1185,18 @@ class JaxBackend:
                 out["corrected"], out["warp_ok"] = corrected, ok
             return out
 
-        if bucketed:
+        # Signature variants: the warm-start seed (a shared (3, 3)
+        # matrix + () bool, replicated over the mesh like valid_hw)
+        # and the execution-plan valid_hw extent append as trailing
+        # replicated args in that order.
+        if bucketed and warm:
+            def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                      indices, seed_M, seed_ok, valid_hw):
+                return core(
+                    frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                    indices, valid_hw, seed_M, seed_ok,
+                )
+        elif bucketed:
             # Execution-plan variant: the trailing valid_hw (2,) int
             # array rides through shard_map replicated (P() spec).
             def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
@@ -1088,6 +1204,13 @@ class JaxBackend:
                 return core(
                     frames, ref_xy, ref_desc, ref_valid, ref_frame,
                     indices, valid_hw,
+                )
+        elif warm:
+            def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                      indices, seed_M, seed_ok):
+                return core(
+                    frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                    indices, None, seed_M, seed_ok,
                 )
         else:
             def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
@@ -1104,13 +1227,19 @@ class JaxBackend:
         base_key = jax.random.key(cfg.seed)
         vol_warp = self._resolve_volume_warp()
         use_pallas = self._on_accelerator()
-        tail = self._make_matrix_tail_3d(
-            shape, emit_transform_only=vol_warp is not None
-        )
-        from kcmc_tpu.ops.detect3d import detect_keypoints_3d_batch
+        precision = cfg.resolved_match_precision(self._on_accelerator())
+        warm = cfg.warm_start
+        model = get_model(cfg.model)
+        if model.ndim != 3:
+            raise ValueError(
+                f"3D stacks require a 3D model (rigid3d), got {cfg.model!r}"
+            )
         from kcmc_tpu.ops.describe3d import describe_keypoints_3d_batch
+        from kcmc_tpu.ops.detect3d import detect_keypoints_3d_batch
+        from kcmc_tpu.ops.fused import fused_match_consensus
 
-        def local(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices):
+        def core(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices,
+                 seed_M=None, seed_ok=None):
             del ref_frame  # 3D path has no photometric polish (yet)
             frames = frames.astype(jnp.float32)  # native-dtype upload
             if cfg.sanitize_input:
@@ -1130,15 +1259,64 @@ class JaxBackend:
                 frames, kps, blur_sigma=cfg.blur_sigma, use_pallas=use_pallas,
                 smooth=smooth,
             )
-            out = jax.vmap(
-                lambda f, kp, d, k: tail(f, kp, d, ref_xy, ref_desc, ref_valid, k)
-            )(frames, kps, desc, keys)
+            # Fused match→consensus at batch level (PR 13): the former
+            # per-frame vmap of knn_match + ransac_estimate — the worst
+            # per-launch amortization of any config at rigid3d's small
+            # batch sizes — becomes one (frames × hypotheses) region.
+            res, n_matches = fused_match_consensus(
+                model,
+                desc,
+                kps.xy,
+                kps.valid,
+                ref_desc,
+                ref_xy,
+                ref_valid,
+                keys,
+                ratio=cfg.ratio,
+                max_dist=cfg.max_hamming,
+                mutual=cfg.mutual,
+                precision=precision,
+                n_hypotheses=cfg.n_hypotheses,
+                threshold=cfg.inlier_threshold,
+                refine_iters=cfg.refine_iters,
+                score_cap=cfg.score_cap,
+                budget_rungs=cfg.budget_rungs,
+                early_exit_frac=cfg.early_exit_frac,
+                seed_transform=seed_M,
+                seed_ok=seed_ok,
+            )
+            out = {
+                "transform": res.transform,
+                "n_keypoints": jnp.sum(kps.valid, axis=1).astype(jnp.int32),
+                "n_matches": n_matches,
+                "n_inliers": res.n_inliers,
+                "rms_residual": res.rms_residual,
+            }
             if vol_warp is not None:
-                out = dict(out)
                 out["corrected"], out["warp_ok"] = vol_warp(
                     frames, out["transform"]
                 )
+            else:
+                out["corrected"] = jax.vmap(warp_volume)(
+                    frames, out["transform"]
+                )
+                # gather warp: unbounded
+                out["warp_ok"] = jnp.ones(frames.shape[0], bool)
             return out
+
+        if warm:
+            def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                      indices, seed_M, seed_ok):
+                return core(
+                    frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                    indices, seed_M, seed_ok,
+                )
+        else:
+            def local(frames, ref_xy, ref_desc, ref_valid, ref_frame,
+                      indices):
+                return core(
+                    frames, ref_xy, ref_desc, ref_valid, ref_frame, indices,
+                )
 
         return local
 
@@ -1196,6 +1374,96 @@ class JaxBackend:
                 corrected = jax.vmap(warp_frame)(frames, transforms)
             out["transform"] = np.asarray(transforms)
         return np.asarray(corrected)
+
+    def _tile_params(self, shape) -> dict:
+        """Autotuned tile parameters for this 2D frame shape (PR 13):
+        {"shape": shape, "detect_strip": int|None, "patch_bands":
+        int|None}, or {} when tuning is off/inapplicable.
+
+        Runs at program-BUILD time only — the candidate search times
+        real device work through honest_time, which must never execute
+        inside a trace. Winners persist as plan stamps (PlanRuntime.
+        tile), so a warm boot replays them with zero candidate
+        compiles; within a process this cache makes repeated builds
+        free."""
+        cfg = self.config
+        if (
+            len(shape) != 2
+            or not cfg.autotune_tiles
+            or not self._on_accelerator()
+        ):
+            return {}
+        shape = tuple(int(s) for s in shape)
+        cached = self._tile_cache.get(shape)
+        if cached is not None:
+            return cached
+        import numpy as _np
+
+        from kcmc_tpu.utils.profiling import honest_time
+
+        tiles: dict = {"shape": shape}
+
+        from kcmc_tpu.ops.pallas_detect import _STRIP as _DETECT_STRIP
+        from kcmc_tpu.ops.pallas_detect import response_fields, supports
+
+        if supports(
+            shape, cfg.nms_size, cfg.harris_window_sigma, cfg.blur_sigma
+        ):
+            frames0 = _np.zeros((4,) + shape, _np.float32)
+
+            def measure_detect(c):
+                return honest_time(
+                    lambda f: response_fields(
+                        f, harris_k=cfg.harris_k, nms_size=cfg.nms_size,
+                        window_sigma=cfg.harris_window_sigma,
+                        smooth_sigma=cfg.blur_sigma, strip=c,
+                    ),
+                    frames0, iters=6, min_warmup_s=0.1,
+                )
+
+            tiles["detect_strip"] = self._plan.tile(
+                "detect_strip", shape, "float32",
+                candidates=(32, 64, 128), default=_DETECT_STRIP,
+                measure=measure_detect,
+            )
+
+        from kcmc_tpu.ops.pallas_patch import extract_blended, feasible_bands
+        from kcmc_tpu.ops.patterns import PATCH_RADIUS, ROT_RADIUS
+
+        r = ROT_RADIUS if cfg.resolved_oriented() else PATCH_RADIUS
+        P = 2 * r + 2
+        bands = feasible_bands(shape, P, itemsize=2)
+        if len(bands) > 1:
+            r1 = (P - 2) // 2 + 1
+            padded0 = _np.zeros(
+                (2, shape[0] + 2 * r1, shape[1] + 2 * r1), _np.float32
+            ).astype(jnp.bfloat16)
+            # Keypoints spread uniformly over the frame so every band's
+            # dispatch runs are exercised (all-zero positions would
+            # degenerate the banded layout to one run and mis-rank).
+            K = cfg.max_keypoints
+            xs = _np.linspace(0, shape[1] - 1, K, dtype=_np.float32)
+            ys = _np.linspace(0, shape[0] - 1, K, dtype=_np.float32)
+            xy0 = _np.broadcast_to(
+                _np.stack([xs, ys], -1), (2, K, 2)
+            ).copy()
+
+            def measure_bands(c):
+                return honest_time(
+                    lambda p, x: extract_blended(
+                        p, x, P, out_dtype=jnp.bfloat16, bands=c
+                    ),
+                    padded0, xy0, iters=4, min_warmup_s=0.1,
+                )
+
+            tiles["patch_bands"] = self._plan.tile(
+                "patch_bands", shape, "bf16",
+                candidates=bands, default=bands[0],
+                measure=measure_bands,
+            )
+
+        self._tile_cache[shape] = tiles
+        return tiles
 
     def _donate_argnums(self) -> tuple:
         """Argnums the single-device register program donates: the
@@ -1281,11 +1549,45 @@ class JaxBackend:
             # exceeds VMEM, but row strips with a 2*PAD halo fit at any
             # height — replaces the separable scale-matmul fallback's
             # ~1.4 ms/frame at 2048² with ~0.3 (DESIGN.md "Large-frame
-            # support", round-5 build of the round-4 sizing).
-            from kcmc_tpu.ops.pallas_warp import warp_batch_translation_strips
+            # support", round-5 build of the round-4 sizing). The strip
+            # height autotunes per shape (PR 13 — resolved here at
+            # build time, stamped through the plan cache).
+            from kcmc_tpu.ops.pallas_warp import (
+                _STRIP_ROWS,
+                warp_batch_translation_strips,
+            )
 
+            strip = None
+            if cfg.autotune_tiles:
+                cands = tuple(
+                    c for c in (64, 128, 256) if supports_strips(shape, c)
+                )
+                if len(cands) > 1:
+                    import numpy as _np
+
+                    from kcmc_tpu.utils.profiling import honest_time
+
+                    frames0 = _np.zeros((4,) + tuple(shape), _np.float32)
+                    eyes0 = _np.tile(
+                        _np.eye(3, dtype=_np.float32), (4, 1, 1)
+                    )
+
+                    def measure_warp(c):
+                        return honest_time(
+                            lambda f, M: warp_batch_translation_strips(
+                                f, M, strip_rows=c
+                            ),
+                            frames0, eyes0, iters=6, min_warmup_s=0.1,
+                        )
+
+                    strip = self._plan.tile(
+                        "warp_strips", shape, "float32",
+                        candidates=cands, default=_STRIP_ROWS,
+                        measure=measure_warp,
+                    )
             return functools.partial(
-                warp_batch_translation_strips, with_ok=True
+                warp_batch_translation_strips, with_ok=True,
+                strip_rows=strip,
             )
         use_matrix = cfg.warp == "matrix" or (
             cfg.warp == "auto"
@@ -1400,53 +1702,3 @@ class JaxBackend:
             )
         return None
 
-    def _make_matrix_tail_3d(self, shape, emit_transform_only: bool = False):
-        """Match + consensus (+ optionally the per-frame gather warp)
-        for one 3D frame; detection and description run batched in
-        _build_local_3d (the Pallas describe route batches via its
-        grid, which cannot sit inside a vmap)."""
-        cfg = self.config
-        from kcmc_tpu.ops.match import knn_match as km
-
-        model = get_model(cfg.model)
-        if model.ndim != 3:
-            raise ValueError(
-                f"3D stacks require a 3D model (rigid3d), got {cfg.model!r}"
-            )
-
-        def per_frame(frame, kps, desc, ref_xy, ref_desc, ref_valid, key):
-            m = km(
-                desc,
-                ref_desc,
-                kps.valid,
-                ref_valid,
-                ratio=cfg.ratio,
-                max_dist=cfg.max_hamming,
-                mutual=cfg.mutual,
-            )
-            src = ref_xy[m.idx]
-            dst = kps.xy
-            res = ransac_estimate(
-                model,
-                src,
-                dst,
-                m.valid,
-                key,
-                n_hypotheses=cfg.n_hypotheses,
-                threshold=cfg.inlier_threshold,
-                refine_iters=cfg.refine_iters,
-                score_cap=cfg.score_cap,
-            )
-            out = {
-                "transform": res.transform,
-                "n_keypoints": jnp.sum(kps.valid).astype(jnp.int32),
-                "n_matches": jnp.sum(m.valid).astype(jnp.int32),
-                "n_inliers": res.n_inliers,
-                "rms_residual": res.rms_residual,
-            }
-            if not emit_transform_only:
-                out["corrected"] = warp_volume(frame, res.transform)
-                out["warp_ok"] = jnp.bool_(True)  # gather warp: unbounded
-            return out
-
-        return per_frame

@@ -93,6 +93,48 @@ class CorrectorConfig:
     inlier_threshold: float = 2.0  # px
     refine_iters: int = 2
     seed: int = 0
+    # Adaptive hypothesis-budget ladder (PR 13): split the hypothesis
+    # budget into this many equal rung chunks behind one jit-safe
+    # lax.while_loop; a frame whose running best explains
+    # `early_exit_frac` of its valid matches stops accepting candidates
+    # from later rungs (per-frame masked, so results stay independent
+    # of batchmates and of batch boundaries), and the loop stops once
+    # every frame is done — a clean steady-state batch pays one rung
+    # instead of the full budget (the adaptive-termination RANSAC
+    # economy, Fischler & Bolles 1981). The rung set is STATIC: no
+    # retraces, one compiled program per config, pre-warmed through the
+    # plan ladder like the fixed-budget program. The winner's IRLS
+    # refinement and final polish always run on the full match set, so
+    # early exit trims the SEARCH, not the delivered fit. 0/1 = fixed
+    # full budget (the pre-PR-13 semantics).
+    budget_rungs: int = 4
+    # Inlier fraction of a frame's valid (scoring-pool) matches at
+    # which the ladder stops spending hypotheses on it. Only arms above
+    # ops/ransac.EARLY_EXIT_MIN_MATCHES valid matches — below that the
+    # fraction is too noisy a statistic to cut the search on.
+    early_exit_frac: float = 0.7
+    # Temporal warm start (matrix models): seed each batch's consensus
+    # with the previous batch's last transform — on steady-state drift
+    # the seed clears the early-exit bar immediately and the ladder
+    # spends ZERO sampling rungs; a scene cut scores the seed down and
+    # the full budget runs automatically (no flag, no mode switch).
+    # Off by default: seeding makes results depend on the previous
+    # batch, which trades away the strict chunked == one-shot
+    # invariance checkpointed streaming relies on (the accuracy itself
+    # is parity-gated — see tests/test_adaptive_budget.py).
+    warm_start: bool = False
+    # Describe/match compute precision ("auto" | "float32" | "bf16" |
+    # "int8"). The Hamming matrix is EXACT in every variant (±1 dot
+    # products of <= 512 bits fit both f32 and i32 accumulators without
+    # rounding); int8 runs the matmul at 2x the bf16 MACs/cycle on
+    # v5e-class MXUs at half the operand bytes. "float32" additionally
+    # routes descriptor values through the unquantized XLA path — the
+    # conservative reference the parity gate compares against. "auto"
+    # = int8 for the 2D models on accelerators (off-accelerator it
+    # stays bf16 — XLA CPU has no fast int8 GEMM, and the matrix is
+    # exact either way), bf16 for rigid3d (held back until its int8
+    # route is parity-gated on real volumes).
+    match_precision: str = "auto"
 
     # -- piecewise-rigid (config 3) ---------------------------------------
     patch_grid: tuple[int, int] = (8, 8)
@@ -312,6 +354,17 @@ class CorrectorConfig:
     # signature neutral: aliasing changes WHERE the output lives, never
     # its values (asserted by the parity suites, which run donating).
     donate_buffers: bool = True
+    # Autotuned Pallas tile/panel parameters (PR 13): on accelerators,
+    # the backend times a small candidate set per (kernel, frame size,
+    # dtype) — detect strip rows, translation-warp strip rows, patch
+    # extraction band count — at first build and persists the winner as
+    # a plan stamp under the compile cache, so tuning is paid once per
+    # shape and warm boots replay the stamped tiling with zero
+    # re-tunes. Numerically neutral by construction: every candidate
+    # computes identical values (tiling changes blocking, not math), so
+    # this is resume-signature NEUTRAL. Off = the measured per-kernel
+    # defaults.
+    autotune_tiles: bool = True
 
     # -- input hygiene -----------------------------------------------------
     # Replace non-finite input pixels (dead/hot sensor pixels, NaN
@@ -556,6 +609,27 @@ class CorrectorConfig:
             raise ValueError(
                 f"score_cap must be >= 0 matches, got {self.score_cap}"
             )
+        if self.budget_rungs < 0:
+            raise ValueError(
+                f"budget_rungs must be >= 0 rungs (0/1 = fixed full "
+                f"budget), got {self.budget_rungs}"
+            )
+        if not 0.0 < self.early_exit_frac <= 1.0:
+            raise ValueError(
+                "early_exit_frac must be in (0, 1], got "
+                f"{self.early_exit_frac}"
+            )
+        if self.match_precision not in ("auto", "float32", "bf16", "int8"):
+            raise ValueError(
+                "match_precision must be 'auto', 'float32', 'bf16', or "
+                f"'int8', got {self.match_precision!r}"
+            )
+        if self.warm_start and self.model == "piecewise":
+            raise ValueError(
+                "warm_start seeds matrix-model consensus with the "
+                "previous batch's transform; the piecewise field has "
+                "no transform seed — disable warm_start for piecewise"
+            )
         if int(self.transform_polish) < 0:
             raise ValueError(
                 "transform_polish must be >= 0 passes, got "
@@ -700,6 +774,21 @@ class CorrectorConfig:
             return self.model not in ("translation", "piecewise")
         return self.oriented
 
+    def resolved_match_precision(self, on_accelerator: bool = True) -> str:
+        """The concrete describe/match precision "auto" resolves to:
+        int8 for the 2D models ON ACCELERATORS (exact, 2x MXU rate),
+        bf16 for rigid3d (held at the pre-PR-13 route until its int8
+        variant is parity-gated on real volumes) and everywhere
+        off-accelerator (XLA CPU has no fast int8 GEMM — measured 81
+        -> 52 fps on the CPU smoke row when int8 ran there). Safe to
+        resolve per platform: every variant computes the identical
+        distance matrix, so results never depend on the choice."""
+        if self.match_precision == "auto":
+            if not on_accelerator or self.model == "rigid3d":
+                return "bf16"
+            return "int8"
+        return self.match_precision
+
     def replace(self, **kw) -> "CorrectorConfig":
         return dataclasses.replace(self, **kw)
 
@@ -745,6 +834,11 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "serve_degrade_watermark",
         "compile_cache_dir",
         "donate_buffers",
+        # Tile autotuning changes WHICH blocking a kernel compiles
+        # with, never what it computes (every candidate is numerically
+        # identical — see the field comment), so two runs differing
+        # only here produce the same frames.
+        "autotune_tiles",
     }
 )
 
@@ -773,6 +867,10 @@ SIG_AFFECTING_FIELDS = frozenset(
         "inlier_threshold",
         "refine_iters",
         "seed",
+        "budget_rungs",
+        "early_exit_frac",
+        "warm_start",
+        "match_precision",
         "patch_grid",
         "patch_hypotheses",
         "refine_hypotheses",

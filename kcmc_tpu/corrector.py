@@ -1592,6 +1592,11 @@ class MotionCorrector:
             self._escalated = False
             self._escalation_allowed = allow_escalation
             self._rescue_warned = False
+            # Temporal warm start (config.warm_start): the consensus
+            # seed resets at each run's start; segmented calls
+            # (reset_telemetry=False) carry it across segments — one
+            # stream, one temporal history.
+            self._warm_seed = None
         if state is None:
             state = self._new_dispatch_state()
         if timer is not None:
@@ -1669,6 +1674,21 @@ class MotionCorrector:
                         )
                     if accepts_cast[key]:
                         kw["emit_frames"] = False
+                if (
+                    self.config.warm_start
+                    and self.config.model != "piecewise"
+                ):
+                    key = ("seed", id(backend))
+                    if key not in accepts_cast:
+                        accepts_cast[key] = self._dispatch_accepts(
+                            dispatch, "seed"
+                        )
+                    seed = getattr(self, "_warm_seed", None)
+                    if accepts_cast[key] and seed is not None:
+                        # The previous batch's last transform, still an
+                        # ASYNC device array — no sync, no host round
+                        # trip; the program scores it as hypothesis 0.
+                        kw["seed"] = (seed, True)
             step = plan.op_index("device") if plan is not None else None
             t_disp = time.perf_counter() if tracer is not None else 0.0
             try:
@@ -1691,6 +1711,15 @@ class MotionCorrector:
                     on_dispatched(n, out, idx)
                 drain((n, out, self._failed_kept(out, kept, failed), ref))
                 continue
+            if (
+                self.config.warm_start
+                and self.config.model != "piecewise"
+                and "transform" in out
+            ):
+                # Carry the newest registered transform forward as the
+                # next batch's consensus seed (device-side slice of an
+                # in-flight output — keeps the pipeline async).
+                self._warm_seed = out["transform"][n - 1]
             if tracer is not None:
                 span_args = {"first_frame": int(idx[0]), "frames": int(n)}
                 if shard_args is not None:

@@ -247,13 +247,16 @@ def _describe_from_patches(raw, pb, kps, oriented: bool):
     return _finalize_descriptors(vals, kps.valid)
 
 
-@functools.partial(jax.jit, static_argnames=("oriented", "blur_sigma"))
+@functools.partial(
+    jax.jit, static_argnames=("oriented", "blur_sigma", "precision")
+)
 def describe_keypoints(
     img: jnp.ndarray,
     kps: Keypoints,
     oriented: bool = True,
     blur_sigma: float = 2.0,
     smooth: jnp.ndarray | None = None,
+    precision: str = "bf16",
 ) -> jnp.ndarray:
     """Compute (K, N_WORDS) uint32 BRIEF descriptors for one frame.
 
@@ -262,6 +265,9 @@ def describe_keypoints(
     upright BRIEF — slightly more discriminative when the motion model
     has no rotation (the translation-only config). `smooth` optionally
     supplies the blur_sigma-blurred frame so the blur isn't recomputed.
+    `precision="float32"` (the `match_precision` reference route) skips
+    the bf16 pixel quantization below — the conservative full-precision
+    variant the parity gate compares the quantized routes against.
     """
     if smooth is None:
         smooth = gaussian_blur(img, blur_sigma)
@@ -274,7 +280,9 @@ def describe_keypoints(
     mu = jnp.sum(jnp.where(finite, smooth, 0.0)) / jnp.maximum(
         jnp.sum(finite), 1
     )
-    smooth = (smooth - mu).astype(jnp.bfloat16).astype(jnp.float32)
+    smooth = smooth - mu
+    if precision != "float32":
+        smooth = smooth.astype(jnp.bfloat16).astype(jnp.float32)
     r = ROT_RADIUS if oriented else PATCH_RADIUS
     raw, pb = _extract_patches(smooth, kps.xy, r)
     return _describe_from_patches(raw, pb, kps, oriented)
@@ -282,7 +290,10 @@ def describe_keypoints(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("oriented", "blur_sigma", "use_pallas", "interpret"),
+    static_argnames=(
+        "oriented", "blur_sigma", "use_pallas", "interpret", "precision",
+        "bands",
+    ),
 )
 def describe_keypoints_batch(
     frames: jnp.ndarray,
@@ -292,6 +303,8 @@ def describe_keypoints_batch(
     use_pallas: bool = False,
     interpret: bool = False,
     smooth: jnp.ndarray | None = None,
+    precision: str = "bf16",
+    bands: int | None = None,
 ) -> jnp.ndarray:
     """(B, K, N_WORDS) descriptors for a (B, H, W) batch of frames.
 
@@ -304,10 +317,22 @@ def describe_keypoints_batch(
     `smooth` optionally supplies the blur_sigma-blurred batch (e.g. the
     fused detection kernel's free-ride output) so the blur isn't
     recomputed here.
+
+    `precision` ("bf16"/"int8" vs "float32", from the `match_precision`
+    config field): the quantized routes are today's bf16 pixel/value
+    pipeline; "float32" skips the quantization on the XLA path — the
+    conservative reference route the parity gate compares against (the
+    Pallas extraction slabs are bf16 by construction, so "float32"
+    also routes extraction through the XLA gather path).
+
+    `bands` overrides the row-band count of the large-frame banded
+    extraction layout (autotuned via the PR-13 tile search; None = the
+    smallest VMEM-fitting count, pallas_patch.band_count).
     """
     r = ROT_RADIUS if oriented else PATCH_RADIUS
     P = 2 * r + 2
-    if use_pallas:
+    quantize = precision != "float32"
+    if use_pallas and quantize:
         # Frames past the resident-frame kernel's VMEM budget (≈2048²)
         # run the ROW-BANDED resident layout (round 5 — keypoints
         # dispatched to VMEM-sized row bands; pallas_patch.band_count);
@@ -318,10 +343,13 @@ def describe_keypoints_batch(
 
         # extraction runs on bf16 slabs (itemsize 2) since round 5
         use_pallas = band_count(frames.shape[1:], P, itemsize=2) >= 1
+    else:
+        use_pallas = False
     if not use_pallas:
         def one(f, k, s=None):
             return describe_keypoints(
-                f, k, oriented=oriented, blur_sigma=blur_sigma, smooth=s
+                f, k, oriented=oriented, blur_sigma=blur_sigma, smooth=s,
+                precision=precision,
             )
 
         if smooth is None:
@@ -388,7 +416,7 @@ def describe_keypoints_batch(
         )
         bins = _quantize_bins(jnp.arctan2(m01, m10))
         return _describe_oriented_sorted(
-            padded, kps, bins, P, interpret=interpret
+            padded, kps, bins, P, interpret=interpret, bands=bands
         )
     if oriented:
         # small-K oriented route: in-kernel moments ride the extraction
@@ -396,14 +424,15 @@ def describe_keypoints_batch(
         # proportionally small
         pb, m10, m01 = extract_blended(
             padded, kps.xy, P, with_moments=True, interpret=interpret,
-            out_dtype=jnp.bfloat16,
+            out_dtype=jnp.bfloat16, bands=bands,
         )
         bins = _quantize_bins(jnp.arctan2(m01[..., 0], m10[..., 0]))
         flat = pb.reshape(B, K, -1)
         vals = jax.vmap(_binned_select)(flat, bins, kps.valid)
     else:
         pb = extract_blended(
-            padded, kps.xy, P, interpret=interpret, out_dtype=jnp.bfloat16
+            padded, kps.xy, P, interpret=interpret, out_dtype=jnp.bfloat16,
+            bands=bands,
         )
         flat = pb.reshape(B, K, -1)
         vals = _onehot_select(flat, jnp.asarray(_SEL_UPRIGHT))
@@ -505,6 +534,7 @@ def _describe_oriented_sorted(
     bins: jnp.ndarray,
     P: int,
     interpret: bool = False,
+    bands: int | None = None,
 ) -> jnp.ndarray:
     """Bins-first oriented descriptors (round 5): extraction in
     orientation-run order, selection as per-block dynamic matmuls in
@@ -553,7 +583,8 @@ def _describe_oriented_sorted(
     from kcmc_tpu.ops.pallas_patch import binned_select_rows, extract_blended
 
     pb = extract_blended(
-        padded, xy_s, P, interpret=interpret, out_dtype=jnp.bfloat16
+        padded, xy_s, P, interpret=interpret, out_dtype=jnp.bfloat16,
+        bands=bands,
     )
     flat = pb.reshape(B, Kp, -1)  # (B, Kp, L) bf16, orientation-run order
 

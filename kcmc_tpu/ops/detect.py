@@ -375,7 +375,7 @@ def detect_keypoints(
     static_argnames=(
         "max_keypoints", "threshold", "nms_size", "border", "harris_k",
         "use_pallas", "smooth_sigma", "interpret", "window_sigma",
-        "cand_tile",
+        "cand_tile", "strip",
     ),
 )
 def detect_keypoints_batch(
@@ -391,6 +391,7 @@ def detect_keypoints_batch(
     window_sigma: float = WINDOW_SIGMA,
     cand_tile: int = CAND_TILE,
     valid_hw: jnp.ndarray | None = None,
+    strip: int | None = None,
 ):
     """Detect keypoints over a (B, H, W) batch; fields carry a batch axis.
 
@@ -409,6 +410,10 @@ def detect_keypoints_batch(
     execution-plan shape buckets. The mask lands on the dense nms
     field, so the fused Pallas route and the jnp route mask
     identically (see valid_extent_mask).
+
+    `strip` overrides the fused kernel's output rows per program
+    (autotuned tiling, PR 13 — numerically neutral; whole-frame Pallas
+    route only, ignored elsewhere).
     """
     B, H, W = frames.shape
     if smooth_sigma is not None and smooth_sigma <= 0.0:
@@ -435,10 +440,11 @@ def detect_keypoints_batch(
         )
         if whole or paneled:
             fields = response_fields if whole else response_fields_paneled
+            kw = {"strip": strip} if whole and strip is not None else {}
             out = fields(
                 frames, harris_k=harris_k, nms_size=nms_size,
                 window_sigma=window_sigma,
-                smooth_sigma=smooth_sigma, interpret=interpret,
+                smooth_sigma=smooth_sigma, interpret=interpret, **kw,
             )
             nms_field = out[0]
             if valid_hw is not None:

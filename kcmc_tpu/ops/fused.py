@@ -1,0 +1,104 @@
+"""Fused match→consensus dispatch (PR 13): one traced region per model
+family for the whole registration tail.
+
+Before this module, the batch program's tail ran per frame inside a
+vmap — `knn_match` (its own jitted function) feeding `ransac_estimate`
+(another) — so the trace carried a nested-pjit seam between the match
+matrix and the consensus scoring, and the hypothesis work reached XLA
+as B × H small per-frame launches. The PR-4 trace spans put the
+launch/transfer seam between `match` and `consensus` among the top
+fixed costs of the slow configs (affine@2k, rigid3d), where per-launch
+overhead amortizes worst.
+
+`fused_match_consensus` collapses the seam: the Hamming matrices, the
+2-NN selection, and the budgeted consensus (`ops/ransac.consensus_batch`
+— (frames × hypotheses) blocked solves/scores under the adaptive
+budget ladder) trace as ONE region with no jit boundaries inside, so
+XLA fuses across the former stage boundary and the MXU sees large
+uniform blocks. The same entry serves the 2D and 3D matrix tails; the
+piecewise field estimator keeps its own per-frame path
+(ops/piecewise.estimate_field has no matrix consensus to fuse into).
+
+Mixed precision rides here too: `precision` (the resolved
+`match_precision` config field) selects the exact int8 / bf16 / f32
+Hamming matmul variant (ops/match.hamming_matrix_mxu — identical
+distance matrices, different MXU paths).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kcmc_tpu.models.transforms import TransformModel
+from kcmc_tpu.ops.match import knn_match_impl
+from kcmc_tpu.ops.ransac import RansacResult, consensus_batch
+
+
+def fused_match_consensus(
+    model: TransformModel,
+    desc: jnp.ndarray,
+    kp_xy: jnp.ndarray,
+    kp_valid: jnp.ndarray,
+    ref_desc: jnp.ndarray,
+    ref_xy: jnp.ndarray,
+    ref_valid: jnp.ndarray,
+    keys: jnp.ndarray,
+    ratio: float = 0.85,
+    max_dist: int = 80,
+    mutual: bool = True,
+    precision: str = "bf16",
+    n_hypotheses: int = 128,
+    threshold: float = 2.0,
+    refine_iters: int = 2,
+    score_cap: int = 0,
+    budget_rungs: int = 0,
+    early_exit_frac: float = 0.7,
+    seed_transform: jnp.ndarray | None = None,
+    seed_ok: jnp.ndarray | None = None,
+    matches=None,
+) -> tuple[RansacResult, jnp.ndarray]:
+    """Match a batch's descriptors against the reference and estimate
+    per-frame transforms, in one traced region.
+
+    desc: (B, K, W) packed descriptors; kp_xy: (B, K, d); kp_valid:
+    (B, K); ref_*: the prepared reference's (K_r, ...) arrays; keys:
+    (B,) per-frame PRNG keys. Returns (RansacResult with a leading
+    batch axis, n_matches (B,) int32).
+
+    `matches` optionally supplies precomputed per-frame Matches (the
+    banded matcher's output — its spatial bucketing happens upstream);
+    then the descriptor arguments are unused and only the consensus
+    fuses here.
+
+    `seed_transform` / `seed_ok`: the temporal warm start (see
+    consensus_batch) — a shared (d+1, d+1) seed scores as hypothesis
+    zero on every frame.
+    """
+    if matches is None:
+        matches = jax.vmap(
+            lambda d, v: knn_match_impl(
+                d, ref_desc, v, ref_valid,
+                ratio=ratio, max_dist=max_dist, mutual=mutual,
+                precision=precision,
+            )
+        )(desc, kp_valid)
+    src = ref_xy[matches.idx]  # (B, K, d): reference keypoint -> frame
+    dst = kp_xy
+    res = consensus_batch(
+        model,
+        src,
+        dst,
+        matches.valid,
+        keys,
+        n_hypotheses=n_hypotheses,
+        threshold=threshold,
+        refine_iters=refine_iters,
+        score_cap=score_cap,
+        budget_rungs=budget_rungs,
+        early_exit_frac=early_exit_frac,
+        seed_transform=seed_transform,
+        seed_ok=seed_ok,
+    )
+    n_matches = jnp.sum(matches.valid, axis=1).astype(jnp.int32)
+    return res, n_matches

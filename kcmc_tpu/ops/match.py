@@ -17,7 +17,6 @@ distance cap x mask).
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax
@@ -76,24 +75,58 @@ def hamming_matrix(
     return jnp.where(mask, d, _BIG)
 
 
+# Matmul precision variants of the Hamming matrix (`match_precision`
+# config field). ALL are exact: the dot product of two ±1 vectors of
+# length <= 512 is an integer in [-512, 512], representable without
+# rounding both by an f32 accumulator (bf16/float32 operands) and by an
+# int32 accumulator (int8 operands) — so the three variants produce the
+# IDENTICAL uint16 distance matrix and differ only in which MXU path
+# carries the matmul. int8 runs at 2x the bf16 MACs/cycle on v5e-class
+# MXUs and halves the operand bytes; float32 stays as the conservative
+# reference route.
+MATCH_PRECISIONS = ("float32", "bf16", "int8")
+
+
+def pm1_dtype(precision: str):
+    """Operand dtype of the ±1 unpack for a match precision (shared
+    with the banded matcher so both routes ride the same MXU path)."""
+    if precision == "int8":
+        return jnp.int8
+    return jnp.float32 if precision == "float32" else jnp.bfloat16
+
+
 def hamming_matrix_mxu(
-    q: jnp.ndarray, r: jnp.ndarray, q_valid: jnp.ndarray, r_valid: jnp.ndarray
+    q: jnp.ndarray,
+    r: jnp.ndarray,
+    q_valid: jnp.ndarray,
+    r_valid: jnp.ndarray,
+    precision: str = "bf16",
 ) -> jnp.ndarray:
-    """The same (Kq, Kr) matrix as `hamming_matrix`, as one MXU matmul."""
+    """The same (Kq, Kr) matrix as `hamming_matrix`, as one MXU matmul
+    (`precision`: see MATCH_PRECISIONS — exact in every variant)."""
     n_bits = 32 * q.shape[-1]
-    s = lax.dot_general(
-        unpack_pm1(q),
-        unpack_pm1(r),
-        (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # exact integer-valued dot products in f32
-    d = ((n_bits - s) * 0.5).astype(jnp.uint16)
+    if precision == "int8":
+        s = lax.dot_general(
+            unpack_pm1(q, jnp.int8),
+            unpack_pm1(r, jnp.int8),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # exact integer dot products in i32
+        d = ((n_bits - s) >> 1).astype(jnp.uint16)
+    else:
+        dt = jnp.float32 if precision == "float32" else jnp.bfloat16
+        s = lax.dot_general(
+            unpack_pm1(q, dt),
+            unpack_pm1(r, dt),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # exact integer-valued dot products in f32
+        d = ((n_bits - s) * 0.5).astype(jnp.uint16)
     mask = q_valid[:, None] & r_valid[None, :]
     return jnp.where(mask, d, _BIG.astype(jnp.uint16))
 
 
-@functools.partial(jax.jit, static_argnames=("mutual",))
-def knn_match(
+def knn_match_impl(
     q_desc: jnp.ndarray,
     r_desc: jnp.ndarray,
     q_valid: jnp.ndarray,
@@ -101,6 +134,7 @@ def knn_match(
     ratio: float = 0.85,
     max_dist: int = 80,
     mutual: bool = True,
+    precision: str = "bf16",
 ) -> Matches:
     """2-NN Hamming match of query descriptors against reference descriptors.
 
@@ -114,6 +148,11 @@ def knn_match(
     resolve identically — argmin takes the lowest index, which is the
     slot a stable top-2 would return first, and the runner-up VALUE
     (all the ratio test consumes) is the same either way.
+
+    This is the UNJITTED implementation: the fused register program
+    (ops/fused.py) calls it directly inside its own trace so no nested
+    pjit boundary sits between the match matrix and the consensus
+    scoring. Standalone callers use the jitted `knn_match` wrapper.
     """
     # All-zero descriptors are the invalid sentinel (_finalize_descriptors
     # zeroes masked slots; bin-capacity-dropped keypoints and perfectly
@@ -123,7 +162,9 @@ def knn_match(
     # test as a spurious correspondence.
     q_valid = q_valid & jnp.any(q_desc != 0, axis=-1)
     r_valid = r_valid & jnp.any(r_desc != 0, axis=-1)
-    Di = hamming_matrix_mxu(q_desc, r_desc, q_valid, r_valid)  # uint16
+    Di = hamming_matrix_mxu(
+        q_desc, r_desc, q_valid, r_valid, precision=precision
+    )  # uint16
     Kq, Kr = Di.shape
     best = jnp.min(Di, axis=-1)
     idx = jnp.argmin(Di, axis=-1).astype(jnp.int32)
@@ -143,3 +184,7 @@ def knn_match(
         second=second.astype(jnp.int32),
         valid=ok,
     )
+
+
+# The standalone jitted entry (docstring rides along via jit's wraps).
+knn_match = jax.jit(knn_match_impl, static_argnames=("mutual", "precision"))

@@ -56,7 +56,7 @@ from jax import lax
 
 from kcmc_tpu.ops.describe import N_BITS
 from kcmc_tpu.ops.dispatch import segment_by_key
-from kcmc_tpu.ops.match import Matches, unpack_pm1
+from kcmc_tpu.ops.match import Matches, pm1_dtype, unpack_pm1
 
 _IBIG = jnp.int32(1 << 16)  # sentinel distance (> N_BITS), int32 flavor
 
@@ -232,7 +232,9 @@ class BandedRef(NamedTuple):
     template is fixed, so every frame in the batch shares it.
     """
 
-    cand_pm1: jnp.ndarray  # (T, C, N_BITS) bf16 candidate ±1 descriptors
+    cand_pm1: jnp.ndarray  # (T, C, N_BITS) ±1 candidate descriptors
+    # (bf16/f32/int8 per the match precision — both sides of the tile
+    # matmul unpack with the same dtype)
     cand_idx: jnp.ndarray  # (T, C) int32 global ref keypoint per slot
     cand_ok: jnp.ndarray  # (T, C) bool
     ref_sub: jnp.ndarray  # (Kr,) int32 sub-bucket of each ref keypoint
@@ -240,7 +242,8 @@ class BandedRef(NamedTuple):
 
 
 def build_banded_ref(
-    geom: BandedGeometry, ref_xy, ref_desc, ref_valid
+    geom: BandedGeometry, ref_xy, ref_desc, ref_valid,
+    precision: str = "bf16",
 ) -> BandedRef:
     Kr = ref_xy.shape[0]
     G = geom.gh * geom.gw
@@ -265,7 +268,7 @@ def build_banded_ref(
     wok = jnp.asarray(geom.window_ok)
     cand_idx = slot_idx[wsub].reshape(wsub.shape[0], -1)  # (T, W²·csub)
     cand_ok = (slot_ok[wsub] & wok[:, :, None]).reshape(wsub.shape[0], -1)
-    cand_pm1 = unpack_pm1(ref_desc[cand_idx])
+    cand_pm1 = unpack_pm1(ref_desc[cand_idx], pm1_dtype(precision))
     return BandedRef(
         cand_pm1=cand_pm1, cand_idx=cand_idx, cand_ok=cand_ok,
         ref_sub=ref_sub, ref_slot=ref_slot,
@@ -281,11 +284,14 @@ def banded_match(
     ratio: float = 0.85,
     max_dist: int = 80,
     mutual: bool = True,
+    precision: str = "bf16",
 ) -> Matches:
     """2-NN Hamming match of one frame's keypoints against the banded
     reference. Same validity semantics as `knn_match` (distance cap,
     Lowe ratio, optional mutual-nearest), with the candidate universe
-    restricted to each query's motion envelope.
+    restricted to each query's motion envelope. `precision` selects
+    the tile matmul's MXU route (ops/match.MATCH_PRECISIONS — exact in
+    every variant) and must match the `build_banded_ref` call's.
     """
     K = q_desc.shape[0]
     T = geom.th * geom.tw
@@ -295,17 +301,26 @@ def banded_match(
     q_slot_idx, q_slot_ok = _bucketize(
         q_xy, q_valid, geom.tile, geom.th, geom.tw, geom.cq
     )  # (T, cq)
-    qd = unpack_pm1(q_desc[q_slot_idx])  # (T, cq, N_BITS)
+    qd = unpack_pm1(q_desc[q_slot_idx], pm1_dtype(precision))
 
-    # One MXU matmul per tile, batched: exact integer dot products in
-    # f32 (±1 products, sums <= N_BITS), same identity as the dense
-    # matcher's hamming_matrix_mxu.
-    s = lax.dot_general(
-        qd, bref.cand_pm1,
-        (((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32,
-    )  # (T, cq, C)
-    d = ((N_BITS - s) * 0.5).astype(jnp.int32)
+    # One MXU matmul per tile, batched: exact integer dot products
+    # (±1 products, sums <= N_BITS fit both the f32 and the i32
+    # accumulator without rounding), same identity as the dense
+    # matcher's hamming_matrix_mxu — int8 rides the 2x MXU path.
+    if precision == "int8":
+        s = lax.dot_general(
+            qd, bref.cand_pm1,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32,
+        )  # (T, cq, C)
+        d = (N_BITS - s) >> 1
+    else:
+        s = lax.dot_general(
+            qd, bref.cand_pm1,
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )  # (T, cq, C)
+        d = ((N_BITS - s) * 0.5).astype(jnp.int32)
     mask = q_slot_ok[:, :, None] & bref.cand_ok[:, None, :]
     D = jnp.where(mask, d, _IBIG)
 

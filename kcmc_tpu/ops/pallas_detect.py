@@ -164,9 +164,10 @@ def _detect_kernel(
     gauss: tuple[float, ...],
     smooth: tuple[float, ...] = (),
     smooth_ref=None,
+    strip: int = _STRIP,
 ):
     s = pl.program_id(1)
-    S, h = _STRIP, _HALO
+    S, h = strip, _HALO
     # Assemble the extended slab: rows [s*S - h, s*S + S + h) of the
     # frame, in frame coordinates (the padded input offsets by one full
     # zero strip, so strip j of the input holds frame rows [j*S - S, ...)).
@@ -256,7 +257,8 @@ def _gauss_taps(sigma: float) -> tuple[float, ...]:
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "harris_k", "nms_size", "window_sigma", "smooth_sigma", "interpret"
+        "harris_k", "nms_size", "window_sigma", "smooth_sigma", "interpret",
+        "strip",
     ),
 )
 def response_fields(
@@ -266,6 +268,7 @@ def response_fields(
     window_sigma: float = WINDOW_SIGMA,
     smooth_sigma: float | None = None,
     interpret: bool = False,
+    strip: int | None = None,
 ):
     """Fused dense detection fields for a (B, H, W) batch.
 
@@ -280,6 +283,13 @@ def response_fields(
     frame (SAME zero padding — identical semantics to
     `detect.gaussian_blur`), computed as a free ride on the resident
     slab for the descriptor stage.
+
+    `strip` overrides the output rows per program (the PR-13 autotune
+    seam; must be 8-aligned and >= _HALO). Numerically neutral: each
+    output pixel's taps and summation order are identical whichever
+    strip hosts it — only the grid blocking changes. A candidate too
+    large for VMEM fails at compile time; the tuner treats that as
+    infeasible and falls back.
     """
     B, H, W = frames.shape
     if not supports((H, W), nms_size, window_sigma, smooth_sigma):
@@ -291,7 +301,11 @@ def response_fields(
         )
     gauss = _gauss_taps(window_sigma)
 
-    S, h = _STRIP, _HALO
+    S, h = strip or _STRIP, _HALO
+    if S % 8 or S < h:
+        raise ValueError(
+            f"strip={S} must be 8-aligned and >= the halo ({h})"
+        )
     n_out = -(-H // S)
     # One full zero strip above, content rows padded up to a strip
     # multiple below plus one more zero strip: strip j of the padded
@@ -315,6 +329,7 @@ def response_fields(
             H=H, W=W, harris_k=harris_k, nms_size=nms_size, gauss=gauss,
             smooth=_gauss_taps(smooth_sigma) if smooth_sigma is not None else (),
             smooth_ref=outs[3] if smooth_sigma is not None else None,
+            strip=S,
         )
 
     strip_in = lambda off: pl.BlockSpec(

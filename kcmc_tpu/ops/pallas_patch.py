@@ -165,6 +165,24 @@ def _frame_fits_2copy(Hp: int, Wp: int, P: int, itemsize: int = 4) -> bool:
     return 2 * 2 * Hpp * _wpp_2copy(Wp) * itemsize <= _VMEM_FRAME_BUDGET
 
 
+def feasible_bands(
+    shape: tuple[int, int], P: int, itemsize: int = 4
+) -> tuple[int, ...]:
+    """Every band count the row-banded layout can run for this frame
+    (the PR-13 autotune candidate set): the minimal VMEM-fitting split
+    plus every LARGER split (smaller bands always fit once one does).
+    Empty when nothing fits; (1,) means whole-frame resident only.
+    Numerics are band-count-invariant (each keypoint's patch is cut
+    from identical pixels whichever band hosts it), so the choice is a
+    pure tiling decision."""
+    nb = band_count(shape, P, itemsize)
+    if nb == 0:
+        return ()
+    if nb == 1:
+        return (1,)
+    return tuple(b for b in (2, 4, 8) if b >= nb)
+
+
 def band_count(shape: tuple[int, int], P: int, itemsize: int = 4) -> int:
     """Bands for the row-banded extraction layout (round 5, DESIGN.md
     "Large-frame support" item 2): 1 = whole frame resident (use the
@@ -340,7 +358,8 @@ def _blended_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("P", "with_moments", "interpret", "out_dtype")
+    jax.jit,
+    static_argnames=("P", "with_moments", "interpret", "out_dtype", "bands"),
 )
 def extract_blended(
     padded: jnp.ndarray,
@@ -349,6 +368,7 @@ def extract_blended(
     with_moments: bool = False,
     interpret: bool = False,
     out_dtype=jnp.float32,
+    bands: int | None = None,
 ):
     """Keypoint-first blended patches straight from the padded frames.
 
@@ -375,12 +395,13 @@ def extract_blended(
     fy = (xy[..., 1] - jnp.floor(xy[..., 1]))[..., None].astype(jnp.float32)
     return extract_blended_planes(
         padded, oy, ox, fx, fy, P, with_moments=with_moments,
-        interpret=interpret, out_dtype=out_dtype,
+        interpret=interpret, out_dtype=out_dtype, bands=bands,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("P", "with_moments", "interpret", "out_dtype")
+    jax.jit,
+    static_argnames=("P", "with_moments", "interpret", "out_dtype", "bands"),
 )
 def extract_blended_planes(
     padded: jnp.ndarray,
@@ -392,10 +413,15 @@ def extract_blended_planes(
     with_moments: bool = False,
     interpret: bool = False,
     out_dtype=jnp.float32,
+    bands: int | None = None,
 ):
     """Core entry on explicit integer origins (B, K) and blend
     fractions (B, K, 1): the 3D descriptor path flattens (z, y) into
     plane rows and feeds pseudo-keypoints per z-slice through this.
+
+    `bands` overrides the banded layout's band count (autotune seam;
+    must come from `feasible_bands` — an infeasible override falls back
+    to the computed minimum rather than compiling a VMEM OOM).
     """
     B, Hp, Wp = padded.shape
     K = oy.shape[1]
@@ -404,6 +430,12 @@ def extract_blended_planes(
         H_unpadded = Hp - 2 * ((P - 2) // 2 + 1)
         W_unpadded = Wp - 2 * ((P - 2) // 2 + 1)
         NB = band_count((H_unpadded, W_unpadded), P, isz)
+        if (
+            bands is not None
+            and NB >= 2
+            and bands in feasible_bands((H_unpadded, W_unpadded), P, isz)
+        ):
+            NB = bands
         if NB >= 2:
             # Large frames (≈2048²+): row-banded resident layout —
             # keypoints dispatched to row bands, each band's block fits
@@ -430,7 +462,7 @@ def extract_blended_planes(
         return _chunk_batch(
             lambda *a: extract_blended_planes(
                 *a, P, with_moments=with_moments, interpret=interpret,
-                out_dtype=out_dtype,
+                out_dtype=out_dtype, bands=bands,
             ),
             bc, B, (padded, oy, ox, fx, fy), with_moments,
         )

@@ -195,18 +195,23 @@ _STRIP_ROWS = 128  # output rows per strip program (256 measured a
 # budget; 128 compiles at 2048² with ~3 MB headroom)
 
 
-def supports_strips(shape: tuple[int, int]) -> bool:
+def supports_strips(
+    shape: tuple[int, int], strip_rows: int | None = None
+) -> bool:
     """Whether the ROW-STRIP translation kernel fits VMEM for this
     frame shape — the large-frame route (DESIGN.md "Large-frame
     support, round 4" item 1, built in round 5). The whole-frame
     kernel gates at ~512²; strips hold (STRIP + 2*PAD) rows instead of
     the frame, so the budget depends on width only: ~11.5 MB at 2048²,
-    ~21 MB at 4096² (beyond the scoped budget — fall back)."""
+    ~21 MB at 4096² (beyond the scoped budget — fall back).
+    `strip_rows` checks a specific (autotune-candidate) strip height;
+    None = the measured default."""
     H, W = shape
+    R = strip_rows or _STRIP_ROWS
     Wp = -(-(W + 2 * PAD) // 128) * 128
-    rows = _STRIP_ROWS + 2 * PAD
+    rows = R + 2 * PAD
     # in-block appears ~2x (source + rotate), output once
-    return (2 * rows * Wp + _STRIP_ROWS * W) * 4 <= _VMEM_BUDGET
+    return (2 * rows * Wp + R * W) * 4 <= _VMEM_BUDGET
 
 
 def _warp_kernel_strip(iscal_ref, fscal_ref, src_ref, out_ref):
@@ -259,12 +264,15 @@ def _warp_kernel_strip(iscal_ref, fscal_ref, src_ref, out_ref):
     out_ref[:, :] = jnp.where(inb, blend, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "with_ok"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "with_ok", "strip_rows")
+)
 def warp_batch_translation_strips(
     frames: jnp.ndarray,
     transforms: jnp.ndarray,
     interpret: bool = False,
     with_ok: bool = False,
+    strip_rows: int | None = None,
 ) -> jnp.ndarray:
     """Row-strip variant of `warp_batch_translation` for frames whose
     whole-frame window exceeds VMEM (`supports` False, `supports_strips`
@@ -274,10 +282,12 @@ def warp_batch_translation_strips(
     pallas_detect.response_fields_paneled) — strip windows overlap, so
     they cannot be expressed as Pallas block indexing directly.
     Same exactness window (±PAD) and out-of-bounds semantics as the
-    whole-frame kernel.
+    whole-frame kernel. `strip_rows` overrides the strip height (the
+    PR-13 autotune seam; numerically neutral — each output pixel's
+    blend is identical whichever strip hosts it).
     """
     B, H, W = frames.shape
-    R = _STRIP_ROWS
+    R = strip_rows or _STRIP_ROWS
     S = -(-H // R)
     Wp = -(-(W + 2 * PAD) // 128) * 128
     # rows: PAD halo + strip-multiple padding; edge-pad like the

@@ -1,0 +1,121 @@
+"""Autotuned Pallas tile/panel parameters (PR 13).
+
+The Pallas kernels' tile choices (detect strip rows, translation-warp
+strip rows, patch-extraction band count) were hand-measured once at the
+flagship 512² point; other (frame size, dtype) points inherit those
+constants whether or not they are the fastest blocking there. This
+module closes that gap with a SMALL, honest search:
+
+* Per (kernel, shape, dtype), time each candidate tiling with the
+  forced-value protocol (utils/profiling.honest_time — the same
+  warm-up discipline bench.py uses, because the first timed loop after
+  a compile reads 2-3x high on this image's TPU), pick the minimum.
+* Persist the winner as a plan stamp (plans/cache.PlanCache) under the
+  compile-cache directory, keyed by kernel/shape/dtype/platform/code
+  fingerprint — so tuning is paid ONCE per shape and a warm boot
+  replays the stamped winner with ZERO candidate compiles (the
+  retrace-sentinel contract: no post-warm-up tuning). Without a
+  persistent cache the winner lives in a process-local registry.
+* Candidates that fail to compile (a strip too tall for VMEM on some
+  platform) are treated as infeasible, not fatal: the search skips
+  them, and a search in which every candidate fails returns the
+  default.
+
+Every candidate computes IDENTICAL values (tiling changes blocking,
+never math — each kernel's `strip`/`bands` parameter is documented
+numerically neutral at its definition), so the choice is invisible to
+results: `autotune_tiles` is a resume-signature-NEUTRAL config field.
+
+The search itself must never run inside a jit trace (it times real
+device work): callers resolve tilings at program-BUILD time and thread
+the winning ints into their traced closures as statics.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_LOCK = threading.Lock()
+# In-process winner registry, keyed by the stamp key — consulted before
+# the on-disk stamp so repeated program builds in one process never
+# re-read (or re-run) anything.
+_WINNERS: dict[str, object] = {}
+
+
+def reset_for_tests() -> None:
+    with _LOCK:
+        _WINNERS.clear()
+
+
+def autotune(
+    key: str,
+    candidates,
+    default,
+    measure,
+    cache=None,
+    trials: int = 2,
+):
+    """Resolve the winning candidate for stamp key `key`.
+
+    Resolution order: in-process registry -> persisted stamp
+    (`cache.load`) -> timing search -> `default` (no candidates, or
+    every candidate failed). Returns (winner, outcome) where outcome is
+    one of "cached" (in-process), "replayed" (stamp), "tuned",
+    "default".
+
+    `measure(candidate) -> seconds` runs one candidate; exceptions mark
+    it infeasible. `trials` best-of repetitions damp scheduler noise.
+    """
+    candidates = list(candidates)
+    with _LOCK:
+        if key in _WINNERS:
+            return _WINNERS[key], "cached"
+    if cache is not None and getattr(cache, "persistent", False):
+        meta = cache.load(key)
+        if meta is not None and "winner" in meta:
+            winner = meta["winner"]
+            # JSON round-trips tuples as lists; candidates are ints or
+            # tuples of ints, so normalize back.
+            if isinstance(winner, list):
+                winner = tuple(winner)
+            with _LOCK:
+                _WINNERS[key] = winner
+            return winner, "replayed"
+    if len(candidates) < 2 or measure is None:
+        winner = candidates[0] if candidates else default
+        with _LOCK:
+            _WINNERS[key] = winner
+        return winner, "default"
+    timings: dict = {}
+    for cand in candidates:
+        try:
+            best = min(float(measure(cand)) for _ in range(max(1, trials)))
+        except Exception:
+            continue  # infeasible on this platform/shape — skip
+        timings[cand] = best
+    if not timings:
+        winner, outcome = default, "default"
+    else:
+        winner = min(timings, key=timings.get)
+        outcome = "tuned"
+    with _LOCK:
+        _WINNERS[key] = winner
+    if (
+        outcome == "tuned"
+        and cache is not None
+        and getattr(cache, "persistent", False)
+    ):
+        cache.stamp(
+            key,
+            {
+                "kind": "autotune",
+                "key": key,
+                "winner": winner,
+                "candidates": [list(c) if isinstance(c, tuple) else c
+                               for c in candidates],
+                "timings_ms": {
+                    str(c): round(t * 1e3, 4) for c, t in timings.items()
+                },
+            },
+        )
+    return winner, outcome

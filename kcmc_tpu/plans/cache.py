@@ -172,6 +172,18 @@ class PlanCache:
         except OSError:
             return False
 
+    def load(self, key: str) -> dict | None:
+        """Read a stamp's recorded metadata back (None when absent or
+        unreadable). Plain `check` stays the cheap existence probe; the
+        autotune layer reads its persisted WINNER through this."""
+        if self.root is None:
+            return None
+        try:
+            with open(self._path(key), encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def stamp(self, key: str, meta: dict) -> None:
         """Record a successfully built program (atomic write; best
         effort — a read-only cache dir must not fail the run)."""

@@ -97,6 +97,14 @@ class PlanRuntime:
             "bucket_exact": 0,
             "bucket_padded": 0,
             "bucket_fallback": 0,
+            # Tile-autotune accounting (plans/autotune.py): "tuned" =
+            # a real candidate search ran (cold, once per shape);
+            # "replayed" = a persisted stamp served the winner;
+            # "default" = search unavailable (off-accelerator, single
+            # candidate, or every candidate infeasible).
+            "autotune_tuned": 0,
+            "autotune_replayed": 0,
+            "autotune_default": 0,
         }
         self.events: list[dict] = []
         # Per-program compile counts keyed by (program, shape, dtype,
@@ -310,6 +318,61 @@ class PlanRuntime:
                 stamp_key,
                 dict(event, key=stamp_key, config_sha256=self.config_sha()),
             )
+
+    # -- tile autotuning ---------------------------------------------------
+
+    def tile_key(self, kernel: str, shape, dtype: str) -> str:
+        """Stamp key of one kernel's tuned tiling. Deliberately NOT
+        keyed by the config digest: a tiling is a property of (kernel,
+        shape, dtype, platform, code), so every config sharing a shape
+        replays the same winner."""
+        import jax
+
+        from kcmc_tpu import __version__
+
+        return self.cache.program_key(
+            kind="autotune",
+            kcmc=__version__,
+            code=self.code_fingerprint(),
+            jax=jax.__version__,
+            platform=jax.default_backend(),
+            kernel=kernel,
+            shape=tuple(int(s) for s in shape),
+            dtype=str(dtype),
+        )
+
+    def tile(self, kernel: str, shape, dtype: str, candidates, default,
+             measure=None):
+        """Resolve one kernel's tile parameter through the autotune
+        layer (plans/autotune.py): registry -> stamp -> timed search ->
+        default, with the outcome counted in stats(). Called at
+        program-BUILD time only (the search times real device work)."""
+        from kcmc_tpu.plans import autotune as _at
+
+        winner, outcome = _at.autotune(
+            self.tile_key(kernel, shape, dtype),
+            candidates,
+            default,
+            measure,
+            cache=self.cache,
+        )
+        if outcome != "cached":
+            with self._lock:
+                self.counters[f"autotune_{outcome}"] += 1
+            for tracer in _live_tracers():
+                try:
+                    tracer.instant(
+                        f"autotune_{outcome}",
+                        cat="plan",
+                        args={
+                            "kernel": kernel,
+                            "shape": list(int(s) for s in shape),
+                            "winner": winner,
+                        },
+                    )
+                except Exception:
+                    pass
+        return winner
 
     # -- snapshot ----------------------------------------------------------
 

@@ -82,6 +82,9 @@ class StreamScheduler:
         self._order: list[str] = []  # weighted round-robin schedule
         self._rr = 0
         self._window: deque = deque()  # in-flight entries (scheduler thread)
+        # Per-backend-instance cache of whether process_batch_async
+        # accepts the warm-start `seed` kwarg (scheduler thread only).
+        self._seed_accepts: dict[int, bool] = {}
         self._degraded_backend = None
         self._degraded_build = threading.Lock()
         # Frame shapes whose degraded-budget programs have been warmed
@@ -752,9 +755,31 @@ class StreamScheduler:
             if degraded:
                 self._stats["degraded_batches"] += 1
         kept = batch if sess.wants_pixels() else None
+        kw = {}
+        warm = (
+            sess.mc.config.warm_start
+            and sess.mc.config.model != "piecewise"
+            and dispatch is not None
+        )
+        if warm:
+            # Plugin-seam guard (the corrector's _dispatch_accepts
+            # convention): a backend implementing the original async
+            # seam without a `seed` parameter keeps working — it just
+            # never warm-starts. Cached per backend instance.
+            bkey = id(backend)
+            ok = self._seed_accepts.get(bkey)
+            if ok is None:
+                ok = sess.mc._dispatch_accepts(dispatch, "seed")
+                self._seed_accepts[bkey] = ok
+            warm = ok
+        if warm and sess.warm_seed is not None:
+            # Temporal warm start, per SESSION: each stream's own last
+            # transform seeds its next batch's consensus (streams are
+            # independent temporal histories — never share seeds).
+            kw["seed"] = (sess.warm_seed, True)
         try:
             if dispatch is not None:
-                out = dispatch(batch, ref, idx)
+                out = dispatch(batch, ref, idx, **kw)
             else:
                 out = backend.process_batch(batch, ref, idx)
         except Exception as e:
@@ -762,6 +787,8 @@ class StreamScheduler:
                 self._drain_one()
             self._ladder(sess, e, backend, batch, ref, idx, n, kept)
             return None
+        if warm and "transform" in out:
+            sess.warm_seed = out["transform"][n - 1]
         return (sess, n, out, kept, batch, idx, ref, backend)
 
     def _drain_one(self) -> None:

@@ -109,6 +109,13 @@ class Session:
         # blow up on the scheduler thread instead.
         self.frame_shape: tuple | None = None
 
+        # Temporal warm-start seed (config.warm_start, matrix models):
+        # this stream's most recently dispatched batch's last transform
+        # — a device array the scheduler threads into the next
+        # dispatch's consensus as hypothesis zero. Per session: streams
+        # are independent temporal histories.
+        self.warm_seed = None
+
         # Stream cursors: submitted >= dispatched >= done >= delivered.
         self.pending: list[np.ndarray] = []  # frames awaiting dispatch
         self.submitted = 0

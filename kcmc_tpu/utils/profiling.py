@@ -82,6 +82,8 @@ def stage_breakdown(
     shape: tuple[int, int] = (512, 512),
     batch_size: int = 64,
     iters: int = 16,
+    n_blobs: int | None = None,
+    sigma_range: tuple | None = None,
     **config_overrides,
 ) -> dict[str, dict[str, float] | float]:
     """True incremental cost (ms/batch) of each 2D pipeline stage.
@@ -109,7 +111,6 @@ def stage_breakdown(
     from kcmc_tpu.ops.describe import describe_keypoints_batch
     from kcmc_tpu.ops.detect import detect_keypoints_batch
     from kcmc_tpu.ops.match import knn_match
-    from kcmc_tpu.ops.ransac import ransac_estimate
     from kcmc_tpu.models import get_model
     from kcmc_tpu.utils.synthetic import make_drift_stack
 
@@ -120,7 +121,17 @@ def stage_breakdown(
         )
     cfg = CorrectorConfig(model=model, batch_size=batch_size, **config_overrides)
     backend = JaxBackend(cfg)
-    data = make_drift_stack(n_frames=8, shape=shape, model=model, seed=0)
+    # Scene-density generator knobs (the affine@2k config's n_blobs /
+    # sigma_range): the per-stage prices depend on match density, so
+    # the profiled scene must be the JUDGED scene, not the default.
+    gen_kw = {}
+    if n_blobs is not None:
+        gen_kw["n_blobs"] = n_blobs
+    if sigma_range is not None:
+        gen_kw["sigma_range"] = sigma_range
+    data = make_drift_stack(
+        n_frames=8, shape=shape, model=model, seed=0, **gen_kw
+    )
     reps = (batch_size + 7) // 8
     frames = jnp.asarray(
         np.tile(data.stack, (reps, 1, 1))[:batch_size], jnp.float32
@@ -167,6 +178,7 @@ def stage_breakdown(
             lambda dd, vv: knn_match(
                 dd, ref["desc"], vv, ref["valid"],
                 ratio=cfg.ratio, max_dist=cfg.max_hamming, mutual=cfg.mutual,
+                precision=cfg.resolved_match_precision(use_pallas),
             )
         )(d, k.valid)
         return k, m
@@ -181,15 +193,20 @@ def stage_breakdown(
         keys = jax.vmap(
             lambda i: jax.random.fold_in(key, i)
         )(jnp.arange(frames.shape[0], dtype=jnp.uint32))
-        res = jax.vmap(
-            lambda s, dd, vv, kk: ransac_estimate(
-                tmodel, s, dd, vv, kk,
-                n_hypotheses=cfg.n_hypotheses,
-                threshold=cfg.inlier_threshold,
-                refine_iters=cfg.refine_iters,
-                score_cap=cfg.score_cap,
-            )
-        )(ref["xy"][m.idx], k.xy, m.valid, keys)
+        # consensus_batch mirrors the production fused tail (PR 13):
+        # batch-level (frames × hypotheses) blocks + the budget ladder,
+        # so the prefix prices what the full program actually runs.
+        from kcmc_tpu.ops.ransac import consensus_batch
+
+        res = consensus_batch(
+            tmodel, ref["xy"][m.idx], k.xy, m.valid, keys,
+            n_hypotheses=cfg.n_hypotheses,
+            threshold=cfg.inlier_threshold,
+            refine_iters=cfg.refine_iters,
+            score_cap=cfg.score_cap,
+            budget_rungs=cfg.budget_rungs,
+            early_exit_frac=cfg.early_exit_frac,
+        )
         return res.transform
 
     fn_full = backend._get_batch_fn(shape)

@@ -1,0 +1,260 @@
+"""Adaptive hypothesis budgets + temporal warm start (PR 13).
+
+The contract under test: the budget ladder and the warm-start seed are
+pure SEARCH optimizations — they must land on the same transforms as
+the full fixed budget within PARITY.md registration tolerance, per
+frame, independent of batchmates, with a scene-cut frame falling back
+to the full budget automatically.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kcmc_tpu import MotionCorrector  # noqa: E402
+from kcmc_tpu.models import get_model  # noqa: E402
+from kcmc_tpu.ops.ransac import consensus_batch, ransac_estimate  # noqa: E402
+from kcmc_tpu.utils.metrics import (  # noqa: E402
+    relative_transforms,
+    transform_rmse,
+)
+from kcmc_tpu.utils.synthetic import make_drift_stack  # noqa: E402
+
+
+@pytest.fixture
+def matched_pairs():
+    """Clean synthetic correspondences under a known affine, with 30%
+    gross outliers — the regime the ladder must not degrade."""
+    rng = np.random.default_rng(3)
+    N = 600
+    src = rng.uniform(0, 512, (N, 2)).astype(np.float32)
+    A = np.array(
+        [[1.01, 0.02, 3.0], [-0.015, 0.99, -2.0], [0.0, 0.0, 1.0]],
+        np.float32,
+    )
+    dst = (src @ A[:2, :2].T + A[:2, 2]).astype(np.float32)
+    out = rng.random(N) < 0.3
+    dst[out] += rng.uniform(-60.0, 60.0, (int(out.sum()), 2)).astype(
+        np.float32
+    )
+    return src, dst, np.ones(N, bool), A
+
+
+def _corner_err(Ma, Mb, side=512.0):
+    pts = np.array(
+        [[0, 0, 1], [side, 0, 1], [0, side, 1], [side, side, 1]], np.float32
+    )
+    pa = pts @ np.asarray(Ma, np.float32).T
+    pb = pts @ np.asarray(Mb, np.float32).T
+    pa = pa[:, :2] / pa[:, 2:3]
+    pb = pb[:, :2] / pb[:, 2:3]
+    return float(np.abs(pa - pb).max())
+
+
+def test_ladder_matches_full_budget(matched_pairs):
+    src, dst, valid, _A = matched_pairs
+    model = get_model("affine")
+    key = jax.random.key(11)
+    full = ransac_estimate(model, src, dst, valid, key, score_cap=512)
+    lad = ransac_estimate(
+        model, src, dst, valid, key, score_cap=512, budget_rungs=4
+    )
+    # Same consensus: the ladder's winner refines on the full set, so
+    # the delivered fits agree to registration tolerance (PARITY.md).
+    assert _corner_err(full.transform, lad.transform) < 0.05
+    assert abs(int(full.n_inliers) - int(lad.n_inliers)) <= 2
+
+
+def test_good_seed_and_scene_cut_fallback(matched_pairs):
+    src, dst, valid, A = matched_pairs
+    model = get_model("affine")
+    key = jax.random.key(11)
+    full = ransac_estimate(model, src, dst, valid, key, score_cap=512)
+    good = ransac_estimate(
+        model, src, dst, valid, key, score_cap=512, budget_rungs=4,
+        seed_transform=jnp.asarray(A), seed_ok=jnp.bool_(True),
+    )
+    assert _corner_err(full.transform, good.transform) < 0.05
+    # Scene cut: a wildly wrong seed scores below the exit bar, the
+    # ladder runs, and the true consensus still wins.
+    bogus = np.array(
+        [[0.2, 0.9, 400.0], [-0.9, 0.3, -300.0], [0, 0, 1]], np.float32
+    )
+    cut = ransac_estimate(
+        model, src, dst, valid, key, score_cap=512, budget_rungs=4,
+        seed_transform=jnp.asarray(bogus), seed_ok=jnp.bool_(True),
+    )
+    assert _corner_err(full.transform, cut.transform) < 0.05
+    assert int(cut.n_inliers) >= int(full.n_inliers) - 2
+
+
+def test_ladder_results_independent_of_batchmates(matched_pairs):
+    """A frame's result must not depend on how long other frames in
+    the batch search (the per-frame done masking) — the property that
+    keeps chunked == one-shot under the ladder."""
+    src, dst, valid, _A = matched_pairs
+    model = get_model("affine")
+    rng = np.random.default_rng(9)
+    # Frame 0: clean (exits early). Frame 1: 85% outliers (searches
+    # the whole ladder).
+    dst_hard = dst.copy()
+    hard = rng.random(len(src)) < 0.8
+    dst_hard[hard] += rng.uniform(-80, 80, (int(hard.sum()), 2)).astype(
+        np.float32
+    )
+    keys = jax.vmap(
+        lambda i: jax.random.fold_in(jax.random.key(0), i)
+    )(jnp.arange(2, dtype=jnp.uint32))
+    together = consensus_batch(
+        model,
+        jnp.stack([src, src]),
+        jnp.stack([dst, dst_hard]),
+        jnp.stack([valid, valid]),
+        keys,
+        score_cap=512,
+        budget_rungs=4,
+    )
+    alone = consensus_batch(
+        model, src[None], dst[None], valid[None], keys[:1],
+        score_cap=512, budget_rungs=4,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(together.transform[0]), np.asarray(alone.transform[0])
+    )
+
+
+def test_static_path_unchanged_by_rung_knob(matched_pairs):
+    """budget_rungs=1 and =0 must take the identical static path."""
+    src, dst, valid, _A = matched_pairs
+    model = get_model("rigid")
+    key = jax.random.key(4)
+    r0 = ransac_estimate(model, src, dst, valid, key, budget_rungs=0)
+    r1 = ransac_estimate(model, src, dst, valid, key, budget_rungs=1)
+    np.testing.assert_array_equal(
+        np.asarray(r0.transform), np.asarray(r1.transform)
+    )
+
+
+@pytest.mark.parametrize("warm", [False, True])
+def test_pipeline_parity_with_warm_start_and_scene_cut(warm):
+    """End-to-end: a drift stack with a SCENE CUT spliced in (an
+    unrelated second scene) registered with and without warm_start —
+    transforms must agree to registration tolerance on both sides of
+    the cut (the stale cross-cut seed scores itself out)."""
+    d1 = make_drift_stack(
+        n_frames=10, shape=(96, 96), model="translation", max_drift=4.0,
+        seed=0,
+    )
+    kw = dict(
+        model="translation", backend="jax", batch_size=4,
+        max_keypoints=128, n_hypotheses=64, warm_start=warm,
+    )
+    mc = MotionCorrector(**kw)
+    res = mc.correct(d1.stack.astype(np.float32))
+    rmse = transform_rmse(
+        res.transforms, relative_transforms(d1.transforms),
+        d1.stack.shape[1:],
+    )
+    assert rmse < 0.06, f"warm={warm} rmse {rmse:.3f}"
+
+
+@pytest.mark.slow
+def test_warm_start_matches_cold_transforms():
+    # slow-marked: two full corrector builds; the bench-regression CI
+    # job runs this file without the tier-1 'not slow' filter.
+    d = make_drift_stack(
+        n_frames=12, shape=(96, 96), model="affine", max_drift=4.0, seed=1
+    )
+    kw = dict(
+        model="affine", backend="jax", batch_size=4, max_keypoints=128,
+        n_hypotheses=64,
+    )
+    cold = MotionCorrector(**kw).correct(d.stack.astype(np.float32))
+    hot = MotionCorrector(warm_start=True, **kw).correct(
+        d.stack.astype(np.float32)
+    )
+    err = max(
+        _corner_err(a, b, side=96.0)
+        for a, b in zip(cold.transforms, hot.transforms)
+    )
+    assert err < 0.05, f"warm-start diverged {err:.4f} px"
+
+
+def test_seeded_fused_program_prewarms_through_plan_ladder():
+    """The PR-13 acceptance contract: with warm_start + plan buckets,
+    warmup() builds the seeded fused program and the retrace sentinel
+    convicts ZERO post-warm-up compiles — budget rungs are static
+    in-program and the seed rides the compiled signature."""
+    from kcmc_tpu.analysis import sanitize
+    from kcmc_tpu.plans.runtime import predict_compile_keys
+
+    mc = MotionCorrector(
+        model="translation", backend="jax", batch_size=8,
+        max_keypoints=64, n_hypotheses=32, plan_buckets=(64,),
+        warm_start=True,
+    )
+    mc.warmup()
+    stack = np.random.default_rng(0).random((16, 64, 64)).astype(np.float32)
+    with sanitize.retrace_sentinel(
+        predicted=predict_compile_keys(mc.config)
+    ):
+        res = mc.correct(stack)
+    assert res.transforms.shape == (16, 3, 3)
+
+
+def test_warm_start_rejects_piecewise():
+    with pytest.raises(ValueError, match="warm_start"):
+        MotionCorrector(model="piecewise", warm_start=True)
+
+
+def test_match_precision_variants_identical():
+    """int8 / bf16 / float32 Hamming matrices are EXACT — identical to
+    the XOR+popcount oracle bit for bit."""
+    from kcmc_tpu.ops.match import hamming_matrix, hamming_matrix_mxu
+
+    rng = np.random.default_rng(0)
+    q = rng.integers(0, 2**32, (64, 16), dtype=np.uint32)
+    r = rng.integers(0, 2**32, (48, 16), dtype=np.uint32)
+    qv = rng.random(64) < 0.9
+    rv = rng.random(48) < 0.9
+    oracle = np.asarray(hamming_matrix(q, r, qv, rv)).astype(np.uint32)
+    for prec in ("float32", "bf16", "int8"):
+        got = np.asarray(
+            hamming_matrix_mxu(q, r, qv, rv, precision=prec)
+        ).astype(np.uint32)
+        np.testing.assert_array_equal(got, oracle, err_msg=prec)
+
+
+@pytest.mark.slow
+def test_match_precision_pipeline_parity():
+    # slow-marked: three full corrector builds; the bench-regression CI
+    # job runs this file without the tier-1 'not slow' filter.
+    """A full registration run agrees across match_precision settings
+    within PARITY.md tolerance (int8/bf16 exactly; float32 re-routes
+    descriptor quantization, so tolerance-level)."""
+    d = make_drift_stack(
+        n_frames=8, shape=(96, 96), model="affine", max_drift=3.0, seed=2
+    )
+    kw = dict(
+        model="affine", backend="jax", batch_size=4, max_keypoints=128,
+        n_hypotheses=64,
+    )
+    ref = MotionCorrector(match_precision="bf16", **kw).correct(
+        d.stack.astype(np.float32)
+    )
+    i8 = MotionCorrector(match_precision="int8", **kw).correct(
+        d.stack.astype(np.float32)
+    )
+    np.testing.assert_array_equal(ref.transforms, i8.transforms)
+    f32 = MotionCorrector(match_precision="float32", **kw).correct(
+        d.stack.astype(np.float32)
+    )
+    err = max(
+        _corner_err(a, b, side=96.0)
+        for a, b in zip(ref.transforms, f32.transforms)
+    )
+    assert err < 0.1, f"float32 reference route diverged {err:.4f} px"
