@@ -447,6 +447,23 @@ def _cmd_serve(args) -> int:
         overrides["serve_inflight"] = args.inflight
     if args.degrade_watermark is not None:
         overrides["serve_degrade_watermark"] = args.degrade_watermark
+    # Serve fault tolerance (docs/ROBUSTNESS.md "Serve-plane
+    # failures"): journaling/reap/transport knobs into the scheduler's
+    # shared corrector config. --inject-faults (and KCMC_FAULT_PLAN)
+    # already map to fault_plan via the shared override parser; the
+    # config's eager spec validation rejects a typo'd plan BEFORE the
+    # ready line, so a chaos run never arms half a plan against live
+    # sessions.
+    if args.journal_dir:
+        overrides["serve_journal_dir"] = args.journal_dir
+    if args.journal_every is not None:
+        # `is not None`, not truthiness: an explicit 0 must reach the
+        # config validator and be rejected, not silently mean "default"
+        overrides["serve_journal_every"] = args.journal_every
+    if args.session_timeout is not None:
+        overrides["serve_session_timeout_s"] = args.session_timeout
+    if args.io_timeout is not None:
+        overrides["serve_io_timeout_s"] = args.io_timeout
     args.reference = ref
     args.overrides = overrides
     from kcmc_tpu.serve.server import serve_main
@@ -767,6 +784,37 @@ def main(argv=None) -> int:
         "--degrade-watermark", type=float, default=None,
         help="queue fraction where QoS degradation engages before any "
         "429 rejection (serve_degrade_watermark; default 0.5)",
+    )
+    p.add_argument(
+        "--journal-dir", default="", metavar="DIR",
+        help="durable session-journal directory (serve_journal_dir): "
+        "sessions periodically persist resume state so a killed server "
+        "restarted over the same DIR resumes every journaled stream "
+        "via the resume_session verb (docs/ROBUSTNESS.md)",
+    )
+    p.add_argument(
+        "--journal-every", type=int, default=None, metavar="FRAMES",
+        help="journal cadence in drained frames "
+        "(serve_journal_every; default 64)",
+    )
+    p.add_argument(
+        "--session-timeout", type=float, default=None, metavar="SECS",
+        help="reap sessions whose client has been idle this long "
+        "(journaled, not dropped — resume_session restores them; "
+        "serve_session_timeout_s; 0 = never)",
+    )
+    p.add_argument(
+        "--io-timeout", type=float, default=None, metavar="SECS",
+        help="transport IO deadline baseline (serve_io_timeout_s; "
+        "default 30)",
+    )
+    p.add_argument(
+        "--inject-faults", default="", metavar="SPEC",
+        help="deterministic serve-plane chaos: the fault-plan grammar "
+        "(see `correct --inject-faults`) plus the serve surfaces — "
+        "transport (drop/stall a connection), scheduler (wedge the "
+        "loop), device (mid-dispatch errors per session), journal "
+        "(session-writer failures); also via KCMC_FAULT_PLAN",
     )
     p.add_argument(
         "--writer-depth", type=int, default=-1,

@@ -303,6 +303,37 @@ class CorrectorConfig:
     # drains below half the watermark. 1.0 = never degrade (reject
     # only).
     serve_degrade_watermark: float = 0.5
+    # Durable session-journal directory (None = journaling off). With a
+    # directory set, every session periodically persists its resume
+    # state — cursor, rolling-template history, transform high-water
+    # mark, accumulated diagnostics — as a checksummed atomic snapshot
+    # (`serve/journal.py`, reusing the quarantine-on-corruption
+    # checkpoint machinery), so a crashed/killed server restarted over
+    # the same directory resumes every journaled session from its last
+    # durable frame via the `resume_session` verb (docs/ROBUSTNESS.md
+    # "Serve-plane failures"). CLI: `serve --journal-dir`.
+    serve_journal_dir: str | None = None
+    # Journal cadence in frames: a session re-journals after this many
+    # newly drained frames (plus once at graceful drain). Smaller =
+    # tighter resume bound, more write amplification.
+    serve_journal_every: int = 64
+    # Per-session staleness bound, seconds (0 = never reap): a session
+    # whose client has neither submitted nor fetched for this long —
+    # with no work left in flight — is reaped by the scheduler:
+    # journaled (when journaling is armed) and closed, so dead clients
+    # stop pinning scheduler slots while their streams stay resumable.
+    serve_session_timeout_s: float = 0.0
+    # Transport IO deadline, seconds: the serve client's default
+    # connect/read timeout (every read gets a deadline, so a half-open
+    # socket surfaces as a retryable timeout instead of a forever-block)
+    # and the baseline the per-op read deadlines derive from.
+    serve_io_timeout_s: float = 30.0
+    # Consecutive primary-backend batch failures before the serve
+    # scheduler quarantines the backend and rebuilds it off the request
+    # path (sessions fail over per the degradation ladder meanwhile;
+    # the rebuild warm-boots through the persistent compile cache when
+    # configured). 0 = never quarantine.
+    serve_backend_strikes: int = 2
 
     @property
     def observability_enabled(self) -> bool:
@@ -690,6 +721,26 @@ class CorrectorConfig:
                 "serve_degrade_watermark must be in (0, 1], got "
                 f"{self.serve_degrade_watermark}"
             )
+        if self.serve_journal_every < 1:
+            raise ValueError(
+                f"serve_journal_every must be >= 1 frame, got "
+                f"{self.serve_journal_every}"
+            )
+        if self.serve_session_timeout_s < 0:
+            raise ValueError(
+                "serve_session_timeout_s must be >= 0 seconds (0 = "
+                f"never reap), got {self.serve_session_timeout_s}"
+            )
+        if self.serve_io_timeout_s <= 0:
+            raise ValueError(
+                "serve_io_timeout_s must be positive seconds, got "
+                f"{self.serve_io_timeout_s}"
+            )
+        if self.serve_backend_strikes < 0:
+            raise ValueError(
+                "serve_backend_strikes must be >= 0 failures (0 = "
+                f"never quarantine), got {self.serve_backend_strikes}"
+            )
         if self.heartbeat_s < 0:
             raise ValueError(
                 f"heartbeat_s must be >= 0 seconds (0 = off), got "
@@ -832,6 +883,15 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "serve_queue_depth",
         "serve_inflight",
         "serve_degrade_watermark",
+        # Serve fault tolerance (PR 14): journaling/reap/transport/
+        # supervision knobs schedule WHEN and WHERE recovery happens,
+        # never what a stream computes — a journaled session resumed
+        # under different knobs produces the same frames.
+        "serve_journal_dir",
+        "serve_journal_every",
+        "serve_session_timeout_s",
+        "serve_io_timeout_s",
+        "serve_backend_strikes",
         "compile_cache_dir",
         "donate_buffers",
         # Tile autotuning changes WHICH blocking a kernel compiles

@@ -983,6 +983,7 @@ class MotionCorrector:
     def _ladder_batch(
         self, first_exc, backend, batch, ref, idx, kw: dict, step,
         n: int, emit_frames: bool, cast_dtype,
+        skip_to_failover: bool = False,
     ) -> tuple[dict, bool]:
         """Walk the degradation ladder for one failed device batch.
 
@@ -992,6 +993,11 @@ class MotionCorrector:
         failed so interpolate_failed trajectory rescue covers them
         post-run. Fatal errors raise immediately from any rung — the
         ladder exists to outlive infrastructure, not to hide bugs.
+
+        `skip_to_failover` starts at rung 2 regardless of the error's
+        class: the serve supervisor's quarantine path uses it when the
+        primary is known-wedged, where re-running it would only burn
+        the backoff budget (docs/ROBUSTNESS.md "Serve-plane failures").
 
         Returns (host output dict, mark_failed) — mark_failed True only
         for a rung-3 synthesized output, whose frames must bypass the
@@ -1003,14 +1009,19 @@ class MotionCorrector:
         plan, policy = self._fault_plan, self._retry_policy
         report = self._robustness
         extra = getattr(backend, "transient_error_types", ())
-        if not faults.classify_transient(first_exc, extra):
+        if not skip_to_failover and not faults.classify_transient(
+            first_exc, extra
+        ):
             raise first_exc
         last = first_exc
         # batch is None only for drain-time failures of registration-
         # only spans (whose input frames are deliberately not pinned in
         # flight): re-execution rungs are unavailable, rung 3 still is.
         attempts = (
-            policy.attempts if policy is not None and batch is not None else 1
+            policy.attempts
+            if policy is not None and batch is not None
+            and not skip_to_failover
+            else 1
         )
         for retry in range(attempts - 1):
             report.device_retries += 1

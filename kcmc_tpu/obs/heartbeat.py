@@ -117,10 +117,21 @@ def aggregate_sampler(snapshot):
     depths, and admission decisions. `snapshot()` returns a dict:
 
     * ``sessions`` — list of ``{"name", "frames", "fps"}`` (required;
-      an empty list emits an idle line);
+      an empty list emits an idle line); entries may carry ``idle_s``
+      (client-liveness age) and a per-session ``robustness`` counter
+      dict;
     * ``queues`` — optional ``{session name: queued frames}``;
     * ``admission`` — optional counters dict (e.g. ``accepted``,
       ``degraded``, ``rejected``) — rendered only when any is nonzero;
+    * ``robustness`` — optional aggregate recovery counters (retries,
+      failovers, rescued frames, journal saves) — rendered only when
+      any is nonzero, so a healthy plane's line stays short;
+    * ``stale`` — optional ``{session name: idle seconds}`` of clients
+      approaching the staleness reap;
+    * ``loop_beat_age_s`` — optional scheduler-loop liveness age; ages
+      beyond 30 s are flagged as a WEDGE (the scheduler-queue-wedge
+      watchdog's narration — heavy device batches legitimately hold
+      the loop for seconds, a wedged queue holds it forever);
     * ``extra`` — optional pre-formatted string appended verbatim.
 
     Returns the sample callable to hand to ``Heartbeat``.
@@ -155,6 +166,27 @@ def aggregate_sampler(snapshot):
                 "admission "
                 + " ".join(f"{k}={v}" for k, v in sorted(admission.items()))
             )
+        robustness = snap.get("robustness")
+        if robustness and any(robustness.values()):
+            parts.append(
+                "robustness "
+                + " ".join(
+                    f"{k}={v}"
+                    for k, v in sorted(robustness.items())
+                    if v
+                )
+            )
+        stale = snap.get("stale")
+        if stale:
+            parts.append(
+                "stale "
+                + " ".join(
+                    f"{k}={float(v):.0f}s" for k, v in sorted(stale.items())
+                )
+            )
+        beat_age = snap.get("loop_beat_age_s")
+        if beat_age is not None and float(beat_age) > 30.0:
+            parts.append(f"SCHEDULER WEDGED {float(beat_age):.0f}s")
         extra = snap.get("extra")
         if extra:
             parts.append(str(extra))

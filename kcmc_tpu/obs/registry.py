@@ -70,6 +70,9 @@ INSTANT_NAMES = frozenset(
         "checkpoint_resume",
         "plan_cache_hit",
         "plan_cache_miss",
+        # serve session durability (serve/session.py, serve/scheduler.py)
+        "journal_save",
+        "journal_resume",
     }
 )
 

@@ -340,6 +340,22 @@ def render_report(run: dict, top: int = 10) -> str:
             f"rescued_frames={rb.get('rescued_frames', 0)} "
             f"faults_injected={rb.get('faults_injected', 0)}"
         )
+        # Serve-plane durability counters appear only when the run was
+        # a serve session that touched them (docs/ROBUSTNESS.md).
+        serve_bits = []
+        if rb.get("journal_saves") or rb.get("journal_failures"):
+            serve_bits.append(
+                f"journal_saves={rb.get('journal_saves', 0)} "
+                f"journal_failures={rb.get('journal_failures', 0)}"
+            )
+        if rb.get("deduped_frames"):
+            serve_bits.append(f"deduped_frames={rb['deduped_frames']}")
+        if rb.get("resumed_from_frame", -1) >= 0:
+            serve_bits.append(
+                f"resumed_from_frame={rb['resumed_from_frame']}"
+            )
+        if serve_bits:
+            lines.append("  serve durability: " + " ".join(serve_bits))
         if rb.get("quarantined_parts"):
             lines.append(
                 f"  quarantined checkpoint parts: {rb['quarantined_parts']}"

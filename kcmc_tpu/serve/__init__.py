@@ -16,8 +16,12 @@ existing registration pipeline (docs/SERVING.md):
   DEGRADES consensus budgets under load before it ever rejects;
 * `server.ServeServer` / `client.ServeClient` — a line-delimited
   JSON-over-TCP transport (`open_session` / `submit_frames` /
-  `results` / `close_session` / `stats`) behind the `kcmc_tpu serve`
-  CLI entrypoint.
+  `results` / `close_session` / `resume_session` / `stats`) behind the
+  `kcmc_tpu serve` CLI entrypoint;
+* `journal.SessionJournal` — durable per-session resume snapshots
+  (cursor, rolling-template history, transform high-water mark) so a
+  killed server restarted over the same `--journal-dir` resumes every
+  journaled stream (docs/ROBUSTNESS.md "Serve-plane failures").
 """
 
 from __future__ import annotations
@@ -30,6 +34,7 @@ __all__ = [
     "ServeServer",
     "ServeClient",
     "ServeError",
+    "SessionJournal",
 ]
 
 
@@ -38,6 +43,10 @@ def __getattr__(name):  # lazy: importing kcmc_tpu.serve must stay cheap
         from kcmc_tpu.serve import session
 
         return getattr(session, name)
+    if name == "SessionJournal":
+        from kcmc_tpu.serve.journal import SessionJournal
+
+        return SessionJournal
     if name in ("StreamScheduler", "OverloadedError"):
         from kcmc_tpu.serve import scheduler
 

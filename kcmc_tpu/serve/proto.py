@@ -10,13 +10,24 @@ numbers, so a frame batch costs ~1.33x its byte size instead of ~5x.
 Ops (docs/SERVING.md has the full field tables):
 
 * ``open_session`` — tenant/weight/reference/template_update/emit/
-  output(+expected_frames)/output_dtype -> ``{"session": id}``
-* ``submit_frames`` — session + frames -> admission decision (or a
+  output(+expected_frames)/output_dtype [+ session (a client-chosen
+  id — the idempotency key for reconnect-retried opens)] ->
+  ``{"session": id}``
+* ``submit_frames`` — session + frames [+ first (the session-global
+  index of this call's first frame — the idempotency key: a retried
+  submit's overlap with already-admitted frames is deduplicated, and
+  a `first` past the cursor is a gap error)] -> admission decision
+  ``{"accepted", "queued", "degraded", "deduped", "next"}`` (or a
   429-coded error when rejected)
 * ``results`` — session [+ timeout] -> next undelivered span of
   per-frame outputs (blocks until available)
 * ``close_session`` — session [+ timeout] -> final merged outputs
-* ``stats`` — scheduler gauges (sessions, queues, occupancy, admission)
+* ``resume_session`` — session -> ``{"session", "cursor", "resumed"}``:
+  re-attach to a live session (resumed=false) or rehydrate a journaled
+  one on a restarted server (resumed=true); the client re-submits
+  frames from ``cursor`` (docs/ROBUSTNESS.md "Serve-plane failures")
+* ``stats`` — scheduler gauges (sessions, queues, occupancy, admission,
+  supervisor/resilience counters)
 * ``ping`` / ``shutdown``
 """
 

@@ -72,6 +72,16 @@ class StreamScheduler:
         self.inflight_depth = cfg.serve_inflight
         self.queue_depth = cfg.serve_queue_depth
         self.watermark = cfg.serve_degrade_watermark
+        self.journal_dir = cfg.serve_journal_dir
+        self.journal_every = cfg.serve_journal_every
+        self.session_timeout_s = cfg.serve_session_timeout_s
+        # The serve plane's OWN fault-plan instance, for the surfaces
+        # the plane (not a session) owns: `scheduler` here, `transport`
+        # in serve/server.py's handler. Sessions arm their own plans
+        # (per-stream deterministic op counters) for device/io/journal.
+        from kcmc_tpu.utils.faults import resolve_fault_plan
+
+        self.fault_plan = resolve_fault_plan(cfg.fault_plan, seed=cfg.seed)
         # RLock: paths like a take_batch failure call session methods
         # (fail -> _cond, built on this same lock) while already
         # holding it — reentrancy beats a deadlock class.
@@ -112,6 +122,30 @@ class StreamScheduler:
         self._heartbeat = None
         self._heartbeat_s = float(heartbeat_s)
         self._seq = 0
+        # Backend supervision (docs/ROBUSTNESS.md "Serve-plane
+        # failures"): consecutive primary-backend batch failures;
+        # at cfg.serve_backend_strikes (a fatal dispatch error counts
+        # as the full threshold) the backend is quarantined and rebuilt
+        # on a background thread while the ladder's failover rung keeps
+        # sessions flowing. All under the plane lock.
+        self._strikes = 0
+        self._strike_limit = cfg.serve_backend_strikes
+        self._rebuilding = False
+        # Monotonic stamp of the last rebuild attempt's completion: a
+        # POISON batch (one tenant's content deterministically fatal in
+        # the kernel) recovers on the failover rung and keeps coming,
+        # and without a cooldown every recurrence would quarantine +
+        # rebuild + re-prewarm the whole plane forever.
+        self._last_rebuild = -float("inf")
+        # Serializes resume_session end to end (journal load ->
+        # open -> restore): a replayed/raced resume of the same id
+        # must observe the winner's FULLY restored session, never a
+        # freshly opened one whose cursor is still 0.
+        self._resume_lock = threading.Lock()
+        # Liveness beat of the scheduler loop (monotonic): stats() and
+        # the wedge watchdog read its age — a large age with pending
+        # work means the loop is wedged, not idle.
+        self._loop_beat = time.monotonic()
         self._stats = {
             "accepted_frames": 0,
             "rejected_submits": 0,
@@ -121,6 +155,11 @@ class StreamScheduler:
             "batches": 0,
             "occupied_frames": 0,  # valid frames across dispatched batches
             "frames_done": 0,
+            # serve fault tolerance (PR 14)
+            "deduped_frames": 0,  # idempotent-submit replays dropped
+            "backend_rebuilds": 0,  # quarantine->rebuild cycles started
+            "sessions_resumed": 0,  # journal resumes served
+            "sessions_reaped": 0,  # stale sessions journaled + closed
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -254,6 +293,19 @@ class StreamScheduler:
                 expected_frames=expected_frames, output_dtype=output_dtype,
                 compression=compression, telemetry=telemetry,
             )
+            if self.journal_dir:
+                from kcmc_tpu.serve.journal import SessionJournal
+
+                # The session's own fault plan / report: journal faults
+                # and durability counters are per-stream like every
+                # other robustness surface.
+                sess.attach_journal(
+                    SessionJournal(
+                        self.journal_dir, sid, every=self.journal_every,
+                        fault_plan=sess.mc._fault_plan,
+                        report=sess.mc._robustness,
+                    )
+                )
             with self._wake:
                 # Reference staging happens under the plane lock with
                 # the registration: the scheduler thread reads the
@@ -297,15 +349,181 @@ class StreamScheduler:
         ]
         self._rr %= len(self._order)
 
-    def submit(self, session_id: str, frames) -> dict:
+    def resume_session(self, session_id: str) -> tuple:
+        """Resume a journaled stream on this (possibly restarted)
+        server: returns ``(session, cursor, resumed)``.
+
+        Idempotent by construction — the client reconnect path calls
+        it blindly. A session still live on this server returns as-is
+        (``resumed=False``) with its current submit cursor, so a
+        client that merely lost its socket re-syncs without touching
+        session state. Otherwise the journal is loaded (quarantined
+        with a warning when corrupt), validated against the serving
+        config's resume signature, and a fresh session is rehydrated
+        from the snapshot; the client re-submits frames from `cursor`.
+        """
+        from kcmc_tpu.serve import journal as journal_mod
+
+        with self._resume_lock:
+            return self._resume_session_locked(session_id, journal_mod)
+
+    def _resume_session_locked(self, session_id: str, journal_mod) -> tuple:
+        with self._lock:
+            sess = self._sessions.get(session_id)
+            if sess is not None:
+                return sess, sess.submitted, False
+        if not self.journal_dir:
+            raise KeyError(
+                f"no open session {session_id!r} (and journaling is "
+                "off — set serve_journal_dir / --journal-dir to make "
+                "streams resumable)"
+            )
+        path = journal_mod.journal_path(self.journal_dir, session_id)
+        # Collect any part quarantined during the load so the counter
+        # reaches the resumed session's RobustnessReport below — the
+        # documented contract; corruption must not be advisory-only.
+        from kcmc_tpu.utils.metrics import RobustnessReport
+
+        load_report = RobustnessReport()
+        loaded = journal_mod.load_session_journal(path, report=load_report)
+        if loaded is None:
+            raise KeyError(
+                f"no journal for session {session_id!r} under "
+                f"{self.journal_dir} (never journaled, already closed, "
+                "or quarantined as corrupt)"
+            )
+        meta, segments, arrays = loaded
+        if meta.get("backend") and meta["backend"] != self.mc.backend_name:
+            # same gate the one-shot checkpoint signature carries: a
+            # stream's numerics (warm seeds, template history) must not
+            # mix two backends across the resume seam
+            raise ValueError(
+                f"session {session_id!r} was journaled on backend "
+                f"{meta['backend']!r}; this server runs "
+                f"{self.mc.backend_name!r} — restart with the original "
+                "backend to resume it"
+            )
+        want = journal_mod.serve_config_signature(self.mc.config)
+        if meta.get("config") != want:
+            raise ValueError(
+                f"session {session_id!r} was journaled under an "
+                "incompatible serving config (resume-signature "
+                "mismatch); restart the server with the original "
+                "config to resume it"
+            )
+        if meta.get("output"):
+            raise ValueError(
+                f"session {session_id!r} wrote a server-side output "
+                "file; those streams are not journal-resumable (the "
+                "writer state is not journaled) — use correct_file "
+                "checkpoints for durable file runs"
+            )
+        tue = meta.get("template_update_every")
+        try:
+            sess = self.open_session(
+                tenant=meta.get("tenant", "default"),
+                weight=int(meta.get("weight", 1)),
+                # 0 is meaningful (an explicit no-rolling override), so
+                # only an absent key falls back to the server default
+                template_update_every=int(tue) if tue is not None else None,
+                emit_frames=bool(meta.get("emit_frames", False)),
+                expected_frames=meta.get("expected_frames"),
+                session_id=session_id,
+            )
+        except ValueError:
+            # two clients racing to resume the same stream: the loser's
+            # open collides with the winner's registration — hand back
+            # the now-live session (same contract as the live-check)
+            with self._lock:
+                live = self._sessions.get(session_id)
+            if live is not None:
+                return live, live.submitted, False
+            raise
+        if load_report.quarantined_parts:
+            with self._lock:
+                sess.mc._robustness.quarantined_parts.extend(
+                    load_report.quarantined_parts
+                )
+        # restore takes the plane lock itself, releasing it around the
+        # boundary template blend (device-frame-sized host compute must
+        # not stall other tenants); _resume_lock + the restore guard
+        # keep the gap safe
+        try:
+            sess.restore_from_journal(
+                meta, segments, arrays, journal=sess.journal
+            )
+        except BaseException as e:
+            # The open above registered the session: left alive but
+            # un-restored, the live-check would hand it back on the
+            # next resume with cursor 0 and the client would silently
+            # re-submit the whole stream as fresh frames. Fail it so
+            # the scheduler finalizes and removes it (the error keeps
+            # the on-disk journal for the retry), then surface the
+            # restore error.
+            sess.fail(e)
+            with self._wake:
+                self._wake.notify_all()
+            raise
+        with self._wake:
+            self._stats["sessions_resumed"] += 1
+            self._wake.notify_all()
+        if sess.telemetry is not None and sess.telemetry.tracer is not None:
+            sess.telemetry.tracer.instant(
+                "journal_resume", cat="journal",
+                args={"done": int(meta["done"])},
+            )
+        advise(
+            f"kcmc serve: session {session_id} resumed from its "
+            f"journal at frame {int(meta['done'])}",
+            stacklevel=2,
+        )
+        return sess, int(meta["done"]), True
+
+    def submit(self, session_id: str, frames, first: int | None = None):
         """Admission-controlled submit. Returns a decision dict
-        ``{"accepted", "queued", "degraded"}``; raises OverloadedError
-        when the queue bound is exceeded (the last resort — QoS
-        degradation engages first, at the watermark)."""
+        ``{"accepted", "queued", "degraded", "next"}``; raises
+        OverloadedError when the queue bound is exceeded (the last
+        resort — QoS degradation engages first, at the watermark).
+
+        `first` is the idempotency key: the session-global index of
+        this call's first frame. A retried submit (client reconnect
+        after a transport timeout) replays frames the server already
+        admitted — the overlap is deduplicated here, so retries never
+        double-process a frame; a `first` PAST the session cursor is a
+        gap (lost frames) and is rejected so a stream can never
+        silently skip. Without `first` (legacy callers) frames append
+        unconditionally."""
         frames = np.asarray(frames)
-        n = 1 if frames.ndim == 2 else len(frames)
+        if frames.ndim == 2:
+            frames = frames[None]
+        n = len(frames)
         with self._wake:
             sess = self._get(session_id)
+            deduped = 0
+            if first is not None:
+                expected = sess.submitted
+                if int(first) > expected:
+                    raise ValueError(
+                        f"session {session_id}: submit gap — frames "
+                        f"{expected}..{int(first)} were never received "
+                        "(resync from resume_session's cursor)"
+                    )
+                deduped = min(expected - int(first), n)
+                if deduped:
+                    frames = frames[deduped:]
+                    n -= deduped
+                if n == 0:
+                    # pure replay: touch liveness, change nothing
+                    sess.deduped_frames += deduped
+                    self._stats["deduped_frames"] += deduped
+                    sess.last_activity = time.monotonic()
+                    return {
+                        "accepted": 0,
+                        "queued": sess.backlog(),
+                        "degraded": sess.degraded,
+                        "deduped": deduped,
+                        "next": sess.submitted,
+                    }
             queued = sess.backlog()
             if queued + n > self.queue_depth:
                 self._stats["rejected_submits"] += 1
@@ -326,6 +544,13 @@ class StreamScheduler:
             # permanently degraded by load it never added.
             sess.add_frames(frames)
             self._stats["accepted_frames"] += n
+            # Dedup counts only once the trimmed remainder is ADMITTED:
+            # a rejected/raising submit will be retried verbatim, and
+            # counting its overlap on every attempt would inflate the
+            # replay counters with phantom frames.
+            if deduped:
+                sess.deduped_frames += deduped
+                self._stats["deduped_frames"] += deduped
             if engage:
                 sess.degraded = True
                 self._stats["degrade_events"] += 1
@@ -341,6 +566,8 @@ class StreamScheduler:
                 "accepted": n,
                 "queued": sess.backlog(),
                 "degraded": sess.degraded,
+                "deduped": deduped,
+                "next": sess.submitted,
             }
 
     def close_session(self, session_id: str, timeout: float | None = None):
@@ -358,7 +585,25 @@ class StreamScheduler:
             # Already finalized and reaped (e.g. a retry after a
             # timed-out close): result() returns immediately.
             sess = self.lookup_session(session_id)
-        return sess.result(timeout=timeout)
+        out = sess.result(timeout=timeout)
+        # A client-initiated close that successfully consumed the
+        # result IS the clean close, even when the stream was already
+        # finalized by a staleness reap or shutdown drain (which keep
+        # the journal) — discard it, or resume_session could resurrect
+        # a stream its client believes complete into a duplicate.
+        # Under _resume_lock, and only while no LIVE session holds the
+        # sid: a session resumed between the reap and this close retry
+        # shares the journal path, and discarding it out from under
+        # that live stream would silently destroy its durability.
+        with self._resume_lock:
+            with self._lock:
+                live = self._sessions.get(session_id)
+                j = sess.journal
+                if live is None or live is sess:
+                    sess.journal = None
+            if j is not None and (live is None or live is sess):
+                j.discard()
+        return out
 
     def _get(self, session_id: str):
         sess = self._sessions.get(session_id)
@@ -422,6 +667,23 @@ class StreamScheduler:
                 s.sid for s in sessions if s.degraded
             )
             db = self._degraded_backend
+            strikes = self._strikes
+            rebuilding = self._rebuilding
+            beat_age = time.monotonic() - self._loop_beat
+            # per-session robustness: the plane-locked snapshots the
+            # drain path maintains (never the live report objects)
+            robustness = {
+                s.sid: dict(s._rb) for s in sessions if s._rb
+            }
+            journal = {
+                s.sid: {
+                    "saves": s.journal.saves,
+                    "failures": s.journal.failures,
+                    "last_saved": s.journal.last_saved,
+                }
+                for s in sessions
+                if s.journal is not None
+            }
         batches = max(st["batches"], 1)
         out = {
             "sessions_open": len(sessions),
@@ -440,7 +702,27 @@ class StreamScheduler:
                 "degraded_batches": st["degraded_batches"],
                 "degraded_active": degraded_active,
             },
+            # serve-plane fault tolerance (docs/ROBUSTNESS.md):
+            # supervisor state, the loop-wedge gauge, and per-session
+            # recovery/durability counters for operators and the CI
+            # chaos canaries.
+            "supervisor": {
+                "backend_strikes": strikes,
+                "backend_rebuilding": rebuilding,
+                "backend_rebuilds": st["backend_rebuilds"],
+                "loop_beat_age_s": round(max(beat_age, 0.0), 3),
+            },
+            "resilience": {
+                "deduped_frames": st["deduped_frames"],
+                "sessions_resumed": st["sessions_resumed"],
+                "sessions_reaped": st["sessions_reaped"],
+                "journal_dir": self.journal_dir,
+            },
         }
+        if robustness:
+            out["robustness"] = robustness
+        if journal:
+            out["journal"] = journal
         # Execution-plan / compile-cache accounting (kcmc_tpu/plans):
         # operators verify a resident server actually starts (and
         # stays) warm — zero stamp_misses after the first boot means
@@ -472,19 +754,48 @@ class StreamScheduler:
             inflight = len(self._window)
             queues = {s.sid: s.backlog() for s in sessions}
             snaps = [s.snapshot() for s in sessions]
+            rebuilding = self._rebuilding
+            beat_age = time.monotonic() - self._loop_beat
         batches = max(st["batches"], 1)
-        return {
+        # Aggregate the per-session robustness snapshots so the
+        # liveness line narrates recovery (retries/failovers/rescues)
+        # next to progress — "slow but surviving" vs "wedged".
+        rb_total: dict[str, int] = {}
+        for s in snaps:
+            for k, v in (s.get("robustness") or {}).items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    rb_total[k] = rb_total.get(k, 0) + int(v)
+        rb_total.pop("resumed_from_frame", None)
+        for k in ("deduped_frames", "sessions_resumed", "sessions_reaped"):
+            if st.get(k):
+                rb_total[k] = st[k]
+        extra = (
+            f"occupancy={st['occupied_frames'] / (batches * self.B):.2f}"
+            f" inflight={inflight}"
+        )
+        if rebuilding:
+            extra += " BACKEND-REBUILDING"
+        out = {
             "sessions": snaps,
             "queues": queues,
             "admission": {
                 "rejected": st["rejected_frames"],
                 "degraded": st["degraded_batches"],
             },
-            "extra": (
-                f"occupancy={st['occupied_frames'] / (batches * self.B):.2f}"
-                f" inflight={inflight}"
-            ),
+            "extra": extra,
+            "loop_beat_age_s": round(max(beat_age, 0.0), 3),
         }
+        if any(rb_total.values()):
+            out["robustness"] = rb_total
+        if self.session_timeout_s > 0:
+            stale = {
+                s["name"]: s["idle_s"]
+                for s in snaps
+                if s.get("idle_s", 0) > 0.5 * self.session_timeout_s
+            }
+            if stale:
+                out["stale"] = stale
+        return out
 
     # -- QoS ----------------------------------------------------------------
 
@@ -621,14 +932,49 @@ class StreamScheduler:
         for sess in leftovers:
             if sess.closed:
                 continue
+            # Graceful drain (SIGTERM / stop): every still-open stream
+            # goes to its journal first — drained state is durable, so
+            # a restarted server resumes it from this exact frame.
+            sess.maybe_journal(force=True)
+            sess.keep_journal = True
             if not sess.drained_out():
-                sess.fail(RuntimeError("serve scheduler stopped mid-stream"))
+                sess.fail(
+                    RuntimeError(
+                        "serve scheduler stopped mid-stream"
+                        + (
+                            " (journaled — resume_session on a "
+                            "restarted server continues from the last "
+                            "durable frame)"
+                            if sess.journal is not None
+                            else ""
+                        )
+                    )
+                )
             sess.begin_close()
             sess.finalize()
 
     def _loop_once(self) -> None:
         """One scheduler-loop iteration: dispatch a ready batch, else
         drain, else idle-wait for work."""
+        with self._lock:
+            self._loop_beat = time.monotonic()
+        if self.fault_plan is not None:
+            # `scheduler` chaos surface: a stall clause wedges this
+            # iteration (the stats/heartbeat wedge gauge must notice);
+            # a raising clause exercises the loop's error backstop.
+            # One op index per iteration, so step=N clauses target the
+            # Nth loop pass deterministically (like every surface).
+            step = self.fault_plan.op_index("scheduler")
+            stall = self.fault_plan.take_stall("scheduler", step)
+            if stall > 0:
+                advise(
+                    f"kcmc serve: injected scheduler stall of "
+                    f"{stall:.2f}s",
+                    stacklevel=2,
+                )
+                time.sleep(stall)
+            self.fault_plan.maybe_fail("scheduler", step)
+        self._reap_stale()
         self._prepare_references()
         with self._wake:
             picked = self._pick_locked() if self._running else None
@@ -661,6 +1007,78 @@ class StreamScheduler:
         with self._wake:
             if self._running and self._pick_preview_locked() is None:
                 self._wake.wait(timeout=0.1)
+
+    def _reap_stale(self) -> None:
+        """Journal-and-close sessions whose client has gone quiet past
+        `serve_session_timeout_s` (scheduler thread). Only fully
+        drained, not-closing sessions are eligible — a reap never
+        abandons admitted work. The journal survives (keep_journal), so
+        a client that merely slept can `resume_session` later; without
+        journaling only fully-FETCHED sessions are reaped (undelivered
+        spans would outlive the reap only in the bounded retention —
+        an eviction would silently end the returning client's stream),
+        and the freed session's final result stays fetchable through
+        the recently-closed retention."""
+        if self.session_timeout_s <= 0:
+            return
+        now = time.monotonic()
+        stale = []
+        with self._lock:
+            for s in self._sessions.values():
+                if (
+                    s.error is None
+                    and not s.closing
+                    # a thread blocked in fetch()/result() is a LIVE
+                    # client whose activity clock went stale mid-wait
+                    and s.waiters == 0
+                    and now - s.last_activity > self.session_timeout_s
+                    and s.drained_out()
+                    # no-data-loss gate: without a journal, a reaped
+                    # session's undelivered spans survive only in the
+                    # bounded retention — an eviction would turn them
+                    # into a silent "exhausted" for the returning
+                    # client. And a journal never stores corrected
+                    # PIXELS, so an emit-frames session's undelivered
+                    # spans would not survive a reap+resume either.
+                    # Both are reaped only once everything was fetched.
+                    and (
+                        (s.journal is not None and not s.emit_frames)
+                        or s.fully_delivered()
+                    )
+                ):
+                    # Close atomically with the check (begin_close is
+                    # reentrant on the plane lock): once closing is
+                    # set no new submit can slip in, so the journal
+                    # written below is the stream's final state.
+                    s.keep_journal = True
+                    self._stats["sessions_reaped"] += 1
+                    s.begin_close()
+                    # capture idle at check time: a client thread
+                    # waking into fetch() after we drop the lock
+                    # refreshes last_activity and would make the
+                    # advisory below log a nonsensical "idle for 0s"
+                    stale.append((s, now - s.last_activity))
+        for sess, idle_s in stale:
+            sess.maybe_journal(force=True)
+            if sess.journal is not None and sess.journal.last_saved > 0:
+                fate = "journaled and reaped — resume_session restores it"
+            elif sess.journal is not None:
+                # journaling armed but the stream never drained a frame
+                # — there is nothing durable to resume
+                fate = "reaped (no frames drained, nothing to journal)"
+            else:
+                fate = (
+                    "reaped (journaling is off; its final result stays "
+                    "fetchable through the recently-closed retention)"
+                )
+            advise(
+                f"kcmc serve: session {sess.sid} idle for "
+                f"{idle_s:.3g}s (> "
+                f"serve_session_timeout_s={self.session_timeout_s:g}); "
+                f"{fate}",
+                stacklevel=2,
+            )
+        # finalization happens in _finalize_ready on this same thread
 
     def _prepare_references(self) -> None:
         """Prepare staged references OUTSIDE the lock (device compute,
@@ -777,7 +1195,14 @@ class StreamScheduler:
             # transform seeds its next batch's consensus (streams are
             # independent temporal histories — never share seeds).
             kw["seed"] = (sess.warm_seed, True)
+        # Chaos surface: the serve dispatch is the same `device` fault
+        # surface the one-shot `_dispatch_batches` arms, on the
+        # SESSION's own plan (per-stream deterministic step counters).
+        plan = sess.mc._fault_plan
+        step = plan.op_index("device") if plan is not None else None
         try:
+            if plan is not None:
+                plan.maybe_fail("device", step)
             if dispatch is not None:
                 out = dispatch(batch, ref, idx, **kw)
             else:
@@ -785,7 +1210,7 @@ class StreamScheduler:
         except Exception as e:
             while self._window:
                 self._drain_one()
-            self._ladder(sess, e, backend, batch, ref, idx, n, kept)
+            self._ladder(sess, e, backend, batch, ref, idx, n, kept, step)
             return None
         if warm and "transform" in out:
             sess.warm_seed = out["transform"][n - 1]
@@ -816,20 +1241,74 @@ class StreamScheduler:
         except Exception as e:
             self._ladder(sess, e, backend, batch, ref, idx, n, kept)
             return
+        if backend is self.mc.backend:
+            with self._lock:
+                # a clean primary drain resets the supervisor's strikes
+                self._strikes = 0
         self._account_done(sess, n, host, kept, ref)
 
-    def _ladder(self, sess, exc, backend, batch, ref, idx, n, kept) -> None:
-        """Walk the session's degradation ladder for a failed batch
-        (retry -> failover backend -> mark-failed); a fatal error fails
-        that ONE stream, never the serving process."""
-        try:
-            out, failed = sess.mc._ladder_batch(
-                exc, backend, batch, ref, idx, {}, None, n, True, None
+    def _ladder(
+        self, sess, exc, backend, batch, ref, idx, n, kept, step=None
+    ) -> None:
+        """Walk the session's degradation ladder for a failed batch and
+        feed the backend supervisor. Transient errors walk the PR-2
+        ladder (retry with backoff -> failover backend -> mark-failed)
+        and count a strike against the primary; a FATAL error on the
+        primary no longer fails the stream — it quarantines the backend
+        (rebuilt off the request path, `_rebuild_backend`) and recovers
+        THIS batch on the failover rung directly, so a wedged
+        accelerator drops zero sessions. Genuine per-stream bugs still
+        fail their one stream: a batch the failover backend also
+        rejects fatally has no rung left."""
+        from kcmc_tpu.utils import faults
+
+        with self._lock:
+            current = backend is self.mc.backend
+            # The degraded QoS twin shares the physical device, so its
+            # failures feed the same supervisor (strike + failover
+            # recovery) — a wedge under overload is still a wedge.
+            degraded_rung = (
+                backend is not None and backend is self._degraded_backend
             )
-        except BaseException as e:
-            sess.fail(e)
-            sess.entry_done()
-            return
+        # Every window entry dispatched on a real backend — current
+        # primary, the degraded QoS twin, or a RETIRED backend an
+        # entry was in flight on when a rebuild swapped it out — walks
+        # the failover recovery below on a fatal error (zero-drop
+        # contract across the swap race). Only `batch is None`
+        # registration-only drains lack the re-execution rung. The
+        # current/degraded distinction above exists solely for strike
+        # accounting: retired backends must not strike the fresh
+        # primary.
+        primary = backend is not None
+        extra = getattr(backend, "transient_error_types", ())
+        transient = faults.classify_transient(exc, extra)
+        if (current or degraded_rung) and batch is not None:
+            self._note_strike(exc, fatal=not transient)
+        if not transient and primary and batch is not None:
+            try:
+                got = self._failover_batch(
+                    sess, exc, batch, ref, idx, n, backend, step
+                )
+            except BaseException as e:
+                # The entry MUST be accounted on every path: an
+                # unexpected error here (failover-backend construction,
+                # classification) would otherwise leak the in-flight
+                # count and wedge the stream's close forever.
+                sess.fail(e)
+                sess.entry_done()
+                return
+            if got is None:
+                return  # no rung left: the stream was failed already
+            out, failed = got
+        else:
+            try:
+                out, failed = sess.mc._ladder_batch(
+                    exc, backend, batch, ref, idx, {}, step, n, True, None
+                )
+            except BaseException as e:
+                sess.fail(e)
+                sess.entry_done()
+                return
         host = {
             k: np.asarray(v)[:n]
             for k, v in out.items()
@@ -837,6 +1316,136 @@ class StreamScheduler:
         }
         kept = sess.mc._failed_kept(host, kept, failed)
         self._account_done(sess, n, host, kept, ref)
+
+    # -- backend supervision (quarantine + off-path rebuild) ----------------
+
+    # Minimum spacing between rebuild attempts: inside it a strike-out
+    # skips the quarantine (batches still recover on the failover rung)
+    # so a deterministically-poison batch cannot thrash the plane with
+    # endless rebuild + re-prewarm cycles.
+    REBUILD_COOLDOWN_S = 30.0
+
+    def _note_strike(self, exc, fatal: bool) -> None:
+        """Count one batch failure on the supervised device (primary or
+        its degraded QoS twin); at the strike limit (a fatal error
+        counts as the whole limit) quarantine the backend and kick the
+        background rebuild."""
+        if self._strike_limit <= 0:
+            return
+        start = False
+        with self._lock:
+            self._strikes = (
+                self._strike_limit if fatal else self._strikes + 1
+            )
+            if (
+                self._strikes >= self._strike_limit
+                and not self._rebuilding
+                and time.monotonic() - self._last_rebuild
+                > self.REBUILD_COOLDOWN_S
+            ):
+                self._rebuilding = True
+                self._stats["backend_rebuilds"] += 1
+                start = True
+        if start:
+            advise(
+                f"kcmc serve: primary backend quarantined after "
+                f"{'a fatal' if fatal else 'repeated'} dispatch error "
+                f"({type(exc).__name__}: {exc}); rebuilding it off the "
+                "request path — batches recover on the failover rung "
+                "meanwhile, no session is dropped",
+                stacklevel=2,
+            )
+            self._spawn_warmup(
+                self._rebuild_backend, "kcmc-serve-backend-rebuild"
+            )
+
+    def _failover_batch(self, sess, exc, batch, ref, idx, n, backend, step):
+        """Recover one batch of a quarantined primary directly on the
+        ladder's lower rungs (the primary is known-wedged, so retrying
+        it would only burn the backoff budget): the canonical
+        `_ladder_batch` with `skip_to_failover` — failover backend,
+        then mark-failed, identical counters/advisories to the
+        one-shot path. Returns (host out, mark_failed), or None after
+        failing the stream (no rung left)."""
+        try:
+            return sess.mc._ladder_batch(
+                exc, backend, batch, ref, idx, {}, step, n, True, None,
+                skip_to_failover=True,
+            )
+        except BaseException as e:
+            # No rung left (fatal failover error, mark-failed
+            # unavailable): that ONE stream fails, accounted here.
+            sess.fail(e)
+            sess.entry_done()
+            return None
+
+    def _rebuild_backend(self) -> None:
+        """Quarantine recovery (non-daemon warm-up thread, joined on
+        stop): construct a FRESH primary backend — warm-booting through
+        the persistent compile/export caches when configured — pre-warm
+        each live session's frame shape on it, then swap it in under
+        the plane lock. Sessions re-stage their references so the
+        scheduler re-prepares them on the new backend; in-flight
+        entries dispatched on the quarantined backend re-dispatch
+        through the ladder when their drain surfaces the error."""
+        from kcmc_tpu.backends import get_backend
+
+        try:
+            # forward an explicitly constructed mesh (the mesh= ctor
+            # path, not config.mesh_devices) like _get_escalation_backend
+            # — a rebuild must not silently unshard the plane
+            mesh = getattr(self.mc.backend, "mesh", None)
+            options = {"mesh": mesh} if mesh is not None else {}
+            new = get_backend(self.mc.backend_name, self.mc.config, **options)
+            with self._lock:
+                shapes = {
+                    tuple(s.frame_shape): s.ref_frame
+                    for s in self._sessions.values()
+                    if s.frame_shape is not None and s.ref_frame is not None
+                }
+            for shape, ref_frame in shapes.items():
+                try:
+                    ref = new.prepare_reference(
+                        np.asarray(ref_frame, np.float32)
+                    )
+                    dummy = np.broadcast_to(
+                        ref_frame, (self.B,) + shape
+                    ).astype(np.float32)
+                    out = new.process_batch(dummy, ref, np.arange(self.B))
+                    for v in out.values():
+                        np.asarray(v)  # block until compile+run finished
+                except Exception:
+                    pass  # that shape compiles inline at first dispatch
+        except Exception as e:
+            advise(
+                f"kcmc serve: backend rebuild failed "
+                f"({type(e).__name__}: {e}); keeping the quarantined "
+                "backend — batches keep recovering on the failover rung",
+                stacklevel=2,
+            )
+            with self._lock:
+                self._rebuilding = False
+                self._last_rebuild = time.monotonic()
+            return
+        with self._wake:
+            self.mc.backend = new
+            self._seed_accepts.clear()
+            # The degraded QoS twin was built against the quarantined
+            # device context — invalidate it so overload traffic lazily
+            # rebuilds it on the fresh one instead of failing streams.
+            self._degraded_backend = None
+            self._degraded_warm_started.clear()
+            for s in self._sessions.values():
+                s.adopt_backend(new)
+            self._strikes = 0
+            self._rebuilding = False
+            self._last_rebuild = time.monotonic()
+            self._wake.notify_all()
+        advise(
+            "kcmc serve: rebuilt primary backend swapped in; sessions "
+            "re-prepare their references on it and dispatch resumes",
+            stacklevel=2,
+        )
 
     def _account_done(self, sess, n, host, kept, ref) -> None:
         try:

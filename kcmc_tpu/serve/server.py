@@ -18,11 +18,13 @@ from __future__ import annotations
 import json
 import socketserver
 import threading
+import time
 
 import numpy as np
 
 from kcmc_tpu.serve import proto
 from kcmc_tpu.serve.scheduler import OverloadedError, StreamScheduler
+from kcmc_tpu.utils.faults import FaultError
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -42,6 +44,21 @@ class _Handler(socketserver.StreamRequestHandler):
                 return
             if msg is None:
                 return  # client closed the connection
+            # `transport` chaos surface (the serve plane's shared fault
+            # plan): a stall clause half-opens the connection — the
+            # reply is delayed past the client's read deadline — and a
+            # raising clause drops it mid-request. Both exercise the
+            # client's reconnect + idempotent-replay contract.
+            plan = server.scheduler.fault_plan
+            if plan is not None:
+                t_step = plan.op_index("transport")
+                stall = plan.take_stall("transport", t_step)
+                if stall > 0:
+                    time.sleep(stall)
+                try:
+                    plan.maybe_fail("transport", t_step)
+                except FaultError:
+                    return  # drop the connection, no reply
             try:
                 resp = server.handle_op(msg)
             except OverloadedError as e:
@@ -118,12 +135,28 @@ class ServeServer:
                 expected_frames=msg.get("expected_frames"),
                 output_dtype=msg.get("output_dtype", "float32"),
                 compression=msg.get("compression", "none"),
+                # client-chosen id: the reconnect-retry idempotency key
+                session_id=msg.get("session"),
             )
             return {"ok": True, "session": sess.sid}
         if op == "submit_frames":
             frames = proto.decode_array(msg["frames"])
-            decision = self.scheduler.submit(msg["session"], frames)
+            first = msg.get("first")
+            decision = self.scheduler.submit(
+                msg["session"], frames,
+                first=int(first) if first is not None else None,
+            )
             return {"ok": True, **decision}
+        if op == "resume_session":
+            sess, cursor, resumed = self.scheduler.resume_session(
+                msg["session"]
+            )
+            return {
+                "ok": True,
+                "session": sess.sid,
+                "cursor": int(cursor),
+                "resumed": bool(resumed),
+            }
         if op == "results":
             try:
                 # lookup_session also finds recently reaped sessions, so
@@ -211,8 +244,6 @@ def _json_safe(obj):
 
 def serve_main(args) -> int:
     """`python -m kcmc_tpu serve` body (argparse args from __main__)."""
-    import time
-
     from kcmc_tpu import MotionCorrector
     from kcmc_tpu.obs.log import advise
 
@@ -266,6 +297,11 @@ def serve_main(args) -> int:
         "batch_size": mc.config.batch_size,
         "queue_depth": mc.config.serve_queue_depth,
         "inflight": mc.config.serve_inflight,
+        # transport-deadline baseline: operator tooling passes this to
+        # its ServeClient(io_timeout=) so client read deadlines follow
+        # the server's configured serve_io_timeout_s
+        "io_timeout_s": mc.config.serve_io_timeout_s,
+        "journal_dir": mc.config.serve_journal_dir,
         # process start -> ready wall time (includes backend + mesh
         # construction and the plan warm-up when configured)
         "warmup_s": round(time.perf_counter() - t_boot, 3),

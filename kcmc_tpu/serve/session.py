@@ -145,6 +145,37 @@ class Session:
             else np.dtype(output_dtype)
         )
 
+        # Durable-session state (serve/journal.py; attached by the
+        # scheduler when serve_journal_dir is configured). keep_journal
+        # marks finalizations that must NOT discard the journal: a
+        # scheduler shutdown (SIGTERM drain) or a staleness reap closes
+        # the stream server-side while leaving it client-resumable.
+        self.journal = None
+        self.keep_journal = False
+        self._config_sig: str | None = None
+        # _outs high-water already persisted as journal parts: each
+        # snapshot appends only the batches drained since the last one
+        # (O(new work) — the checkpoint layer's append-only contract).
+        self._outs_journaled = 0
+        # Client-liveness clock (monotonic): submits and result reads
+        # refresh it; the scheduler reaps sessions idle past
+        # serve_session_timeout_s (journaled, not dropped). `waiters`
+        # counts client threads currently BLOCKED in fetch()/result()
+        # — a long results() poll is a live client whose activity clock
+        # has gone stale, and the reaper must not close the stream out
+        # from under it.
+        self.last_activity = time.monotonic()
+        self.waiters = 0
+        # Idempotent-submit dedup: replayed frames dropped at admission
+        # (client reconnect retries). Folded into the RobustnessReport
+        # at finalize (scheduler thread) so the counter write stays
+        # under the plane lock.
+        self.deduped_frames = 0
+        # Plane-locked snapshot of the robustness counters for the
+        # heartbeat/stats readers (the report object itself is only
+        # touched by the scheduler thread mid-run).
+        self._rb: dict = {}
+
         # Per-session telemetry (trace + frame records) through the
         # run-id machinery: concurrent sessions configured with the same
         # artifact paths get per-session derived filenames. The serve
@@ -214,6 +245,7 @@ class Session:
             )
         if self._t0 is None:
             self._t0 = time.perf_counter()
+        self.last_activity = time.monotonic()
         if self.out_dt is None:
             self.out_dt = np.dtype(frames.dtype)
         if self.ref is None and self._ref_src is None:
@@ -221,6 +253,12 @@ class Session:
         self.pending.extend(np.asarray(f) for f in frames)
         self.submitted += len(frames)
         return len(frames)
+
+    def fully_delivered(self) -> bool:
+        """All drained result spans have been fetched (lock held) —
+        the staleness reaper's no-data-loss gate for unjournaled
+        sessions."""
+        return self._outs_delivered >= len(self._outs)
 
     def needs_reference(self) -> bool:
         """Whether the scheduler thread must prepare this session's
@@ -234,8 +272,17 @@ class Session:
         source read takes the lock; the compute does not."""
         with self._cond:
             src = self._ref_src
-        ref = self.mc.backend.prepare_reference(src)
+            backend = self.mc.backend
+        ref = backend.prepare_reference(src)
         with self._cond:
+            if self._ref_src is not src or self.mc.backend is not backend:
+                # The staging changed while this prepare was in flight
+                # — a journal restore's boundary re-roll swapped the
+                # source, or a quarantine rebuild swapped the backend.
+                # Installing would pin a stale template (silent parity
+                # divergence) or a dead-backend ref; drop it and let
+                # the next loop pass prepare the current staging.
+                return
             self.ref_frame = src
             self.ref = ref
             self._cond.notify_all()
@@ -248,6 +295,218 @@ class Session:
         with self._cond:
             self.closing = True
             self._cond.notify_all()
+
+    # -- durable journal (scheduler thread; serve/journal.py) --------------
+
+    def _rb_snapshot(self) -> dict:
+        """Plane-locked robustness snapshot for the heartbeat/stats
+        readers (the report object is scheduler-thread-only).
+        `faults_injected` is normally folded from the fault plan only
+        at finalize — fold the live counter here too, so a chaos run's
+        `stats` never shows failovers climbing with zero injections."""
+        rb = self.mc._robustness.as_dict()
+        plan = self.mc._fault_plan
+        if plan is not None and plan.injected:
+            rb["faults_injected"] = int(plan.injected)
+        return rb
+
+    def attach_journal(self, journal) -> None:
+        """Arm periodic journaling (scheduler-owned; called at open)."""
+        from kcmc_tpu.serve.journal import serve_config_signature
+
+        with self._cond:
+            self.journal = journal
+            self._config_sig = serve_config_signature(self.mc.config)
+
+    def _journal_state(self):
+        """Snapshot the resume state (lock held). Array contents are
+        append-only once drained, so only the dict/list copies need the
+        lock — serialization runs outside it. Returns only the batches
+        NEW since the last durable snapshot (journal parts are
+        append-only; corrected pixels are never journaled)."""
+        new_outs = [
+            {k: v for k, v in o.items() if k != "corrected"}
+            for o in self._outs[self._outs_journaled :]
+        ]
+        tail = list(self._tail)
+        meta = {
+            "sid": self.sid,
+            "tenant": self.tenant,
+            "weight": self.weight,
+            "config": self._config_sig,
+            "backend": self.mc.backend_name,
+            "model": self.mc.config.model,
+            "done": int(self.done),
+            "next_boundary": self._next_boundary,
+            "template_update_every": int(self.E) if self.E else 0,
+            "frame_shape": (
+                list(self.frame_shape) if self.frame_shape else None
+            ),
+            "out_dtype": str(self.out_dt) if self.out_dt is not None else None,
+            "emit_frames": bool(self.emit_frames),
+            "expected_frames": self.expected_frames,
+            "output": self.output,
+            "compression": self.compression,
+        }
+        return meta, new_outs, tail
+
+    def maybe_journal(self, force: bool = False) -> None:
+        """Write a durable snapshot when the cadence (or `force` — the
+        graceful-drain/reap path) calls for one. SCHEDULER thread only;
+        the serialization runs outside the plane lock."""
+        with self._cond:
+            j = self.journal
+            if j is None:
+                return
+            done = self.done
+            if done <= 0 or not (force or j.due(done)):
+                return
+            if force and done <= j.last_saved:
+                return  # nothing new since the last durable frame
+            meta, new_outs, tail = self._journal_state()
+            outs_high = len(self._outs)  # high-water this save covers
+        arrays: dict = {}
+        ref_frame = self.ref_frame
+        if ref_frame is not None:
+            # Rolling templates store under "template": the checkpoint
+            # loader's rewind gate keys on that name — a corrupt part
+            # of a rolling stream must NOT rewind (the stored template
+            # matches only the final cursor), while a static-reference
+            # stream may resume from its last good prefix.
+            key = "template" if self.E else "ref_frame"
+            arrays[key] = np.asarray(ref_frame, np.float32)
+        if tail:
+            arrays["tail_corrected"] = np.concatenate(
+                [np.asarray(t["corrected"], np.float32) for t in tail]
+            )
+            arrays["tail_warp_ok"] = np.concatenate(
+                [np.asarray(t["warp_ok"], bool) for t in tail]
+            )
+            meta["tail_lens"] = [int(len(t["corrected"])) for t in tail]
+        else:
+            meta["tail_lens"] = []
+        if j.save(meta, new_outs, arrays):
+            if self.telemetry is not None and self.telemetry.tracer is not None:
+                self.telemetry.tracer.instant(
+                    "journal_save", cat="journal",
+                    args={"done": int(meta["done"])},
+                )
+            with self._cond:
+                self._outs_journaled = outs_high
+                self._rb = self._rb_snapshot()
+
+    def restore_from_journal(
+        self, meta: dict, segments: list, arrays: dict, journal=None
+    ):
+        """Rehydrate a freshly opened session from a journal snapshot:
+        cursors, rolling-template history, the staged template source
+        (prepared on the CURRENT backend by the scheduler), and the
+        journaled per-batch outputs, restored delivered-by-journal —
+        corrected pixels are never journaled, so resumed `results`
+        spans start at the resume cursor while `close_session` still
+        returns the full stream's transforms/diagnostics. Called under
+        the plane lock at registration, before anything dispatches."""
+        with self._cond:
+            if self.submitted or self.pending or self.dispatched:
+                # A submit slipped in between registration and restore
+                # (only possible for a client violating the resume
+                # protocol): refusing is recoverable, silently
+                # re-basing its frame indices is not.
+                raise RuntimeError(
+                    f"session {self.sid} received frames before its "
+                    "journal restore completed; resume aborted"
+                )
+            done = int(meta["done"])
+            self.done = self.dispatched = self.submitted = done
+            if meta.get("frame_shape"):
+                self.frame_shape = tuple(meta["frame_shape"])
+            od = meta.get("out_dtype")
+            if od:
+                self.out_dt = np.dtype(od)
+            nb = meta.get("next_boundary")
+            self._next_boundary = int(nb) if nb is not None else None
+            restored = [dict(s) for s in segments]
+            if restored:
+                self._outs = restored
+                self._outs_delivered = len(restored)
+                self._outs_journaled = len(restored)
+                self._frames_delivered = done
+            lens = [int(x) for x in meta.get("tail_lens") or []]
+            if lens:
+                tc = np.asarray(arrays["tail_corrected"], np.float32)
+                tw = np.asarray(arrays["tail_warp_ok"], bool)
+                self._tail, lo = [], 0
+                for ln in lens:
+                    self._tail.append(
+                        {"corrected": tc[lo : lo + ln],
+                         "warp_ok": tw[lo : lo + ln]}
+                    )
+                    lo += ln
+            ref = arrays.get("template", arrays.get("ref_frame"))
+            if ref is not None:
+                self._ref_src = np.asarray(ref, np.float32)
+                self.ref = None
+            roll_src = None
+            if (
+                self._next_boundary is not None
+                and done == self._next_boundary
+                and self._tail
+                and self._ref_src is not None
+            ):
+                # The snapshot caught a closing stream exactly at a
+                # boundary whose roll was skipped (stream was ending).
+                # A resumed stream continues PAST the boundary, so it
+                # must roll — same blend an uninterrupted run would
+                # have done — or frames past the boundary would never
+                # dispatch. The blend itself runs AFTER this lock
+                # section (frame-sized host compute; other tenants'
+                # submits must keep flowing).
+                roll_src = self._ref_src
+                roll_tails = [t["corrected"] for t in self._tail]
+                roll_oks = [t["warp_ok"] for t in self._tail]
+            tr = restored[-1].get("transform") if restored else None
+            if (
+                self.mc.config.warm_start
+                and tr is not None
+                and len(tr)
+                and self.mc.config.model != "piecewise"
+            ):
+                self.warm_seed = np.asarray(tr[-1])
+            self.journal = journal
+            if journal is not None:
+                journal.adopt(meta)
+            self.mc._robustness.resumed_from_frame = done
+            self._rb = self._rb_snapshot()
+            self.last_activity = time.monotonic()
+            self._cond.notify_all()
+        if roll_src is not None:
+            rolled = self.mc._rolled_template(
+                roll_src, roll_tails, roll_oks, self.W_roll
+            )
+            with self._cond:
+                self._ref_src = rolled
+                # the scheduler may have prepared the unrolled source
+                # in the gap (no frame can have dispatched — the
+                # boundary gate holds ready_count at 0 until the next
+                # line advances it); clear it so the rolled template
+                # is what gets prepared
+                self.ref = None
+                self._tail = []
+                self._next_boundary += self.E
+                self._cond.notify_all()
+
+    def adopt_backend(self, backend) -> None:
+        """Point this stream at a rebuilt backend (the scheduler's
+        quarantine/rebuild path, plane lock held): the prepared
+        reference re-stages so the scheduler re-prepares it on the new
+        backend off this call, and the warm seed (a device array owned
+        by the quarantined backend) is dropped — the next batch simply
+        runs unseeded."""
+        self.mc.backend = backend
+        if self.ref_frame is not None:
+            self._ref_src = self.ref_frame
+            self.ref = None
+        self.warm_seed = None
 
     # -- dispatch side (scheduler thread, scheduler lock held) ------------
 
@@ -291,9 +550,11 @@ class Session:
         Mirrors the one-shot drain: exact-warp rescue of flagged frames
         (when their input pixels were kept), QC NaN-ing otherwise,
         rolling-template tail collection, writer append, telemetry."""
-        if self.error is not None:
-            return  # failed stream: entries drain without accounting
         with self._cond:
+            # error can be set off-thread (a client thread's failed
+            # journal restore, a ladder fail) — read it under the lock
+            if self.error is not None:
+                return  # failed stream: entries drain without accounting
             # out_dt is pinned by the first admitted submit (a client
             # thread, under this same lock) — snapshot it rather than
             # reading it unlocked mid-drain
@@ -309,15 +570,20 @@ class Session:
             )
         corrected = host.pop("corrected", None)
         if self.E and corrected is not None:
-            self._tail.append({
+            entry = {
                 "corrected": np.asarray(corrected, np.float32),
                 "warp_ok": np.asarray(
                     host.get("warp_ok", np.ones(len(corrected), bool)), bool
                 ),
-            })
-            have = sum(len(t["corrected"]) for t in self._tail)
-            while have - len(self._tail[0]["corrected"]) >= self.W_roll:
-                have -= len(self._tail.pop(0)["corrected"])
+            }
+            with self._cond:
+                # _tail mutations stay under the plane lock: the
+                # journal snapshot (scheduler thread) and a journal
+                # restore (handler thread) both touch it
+                self._tail.append(entry)
+                have = sum(len(t["corrected"]) for t in self._tail)
+                while have - len(self._tail[0]["corrected"]) >= self.W_roll:
+                    have -= len(self._tail.pop(0)["corrected"])
         if corrected is not None:
             corrected = _cast_output(corrected, out_dt)
             if self.writer is None and self.output is not None:
@@ -352,6 +618,9 @@ class Session:
             if self.telemetry is not None:
                 self.telemetry.note_batch(self.done, n, host)
             self.done += n
+            # plane-locked robustness snapshot for the heartbeat/stats
+            # readers (the report object is scheduler-thread-only)
+            self._rb = self._rb_snapshot()
             boundary = (
                 self._next_boundary is not None
                 and self.done == self._next_boundary
@@ -366,19 +635,23 @@ class Session:
             # the window busy meanwhile. The blend + re-preparation
             # compute outside the lock; only the handle swap takes it
             # (client-side set_reference probes `self.ref` under it).
+            with self._cond:
+                tails = [t["corrected"] for t in self._tail]
+                oks = [t["warp_ok"] for t in self._tail]
+                self._tail.clear()
             rolled = self.mc._rolled_template(
-                self.ref_frame,
-                [t["corrected"] for t in self._tail],
-                [t["warp_ok"] for t in self._tail],
-                self.W_roll,
+                self.ref_frame, tails, oks, self.W_roll
             )
-            self._tail.clear()
             new_ref = self.mc.backend.prepare_reference(rolled)
             with self._cond:
                 self.ref_frame = rolled
                 self.ref = new_ref
                 self._next_boundary += self.E
                 self._cond.notify_all()
+        # Journal AFTER any boundary roll so a snapshot never lands in
+        # the done==boundary/unrolled-tail in-between state (a resumed
+        # stream must have dispatchable frames).
+        self.maybe_journal()
 
     def entry_done(self) -> None:
         """Scheduler-side accounting: one of this session's dispatched
@@ -430,6 +703,9 @@ class Session:
             outs = [dict(o) for o in self._outs]
             done = self.done
             t0 = self._t0
+            deduped = self.deduped_frames
+            journal = self.journal
+            keep_journal = self.keep_journal or self.error is not None
         err: BaseException | None = None
         try:
             if self.writer is not None:
@@ -450,9 +726,22 @@ class Session:
         corrected = merged.pop("corrected", None)
         transforms = merged.pop("transform", None)
         fields = merged.pop("field", None)
+        # Fold the plane-locked dedup counter into the report here, on
+        # the scheduler thread — the thread that owns every other
+        # report write — so it lands in timing["robustness"] below.
+        self.mc._robustness.deduped_frames = int(deduped)
         transforms = self.mc._finalize_robustness(
             merged, transforms, 0, done, timing
         )
+        if journal is not None:
+            if keep_journal:
+                # Shutdown drain / staleness reap: the stream stays
+                # client-resumable — leave the last snapshot in place.
+                pass
+            else:
+                # Clean client-initiated close: a completed stream must
+                # not be resurrectable into a duplicate.
+                journal.discard()
         result = CorrectionResult(
             corrected=(
                 corrected
@@ -464,12 +753,16 @@ class Session:
             diagnostics=merged,
             timing=timing,
         )
+        with self._cond:
+            # error can be set off-thread (a client thread's failed
+            # journal restore) — snapshot under the lock
+            stream_err = self.error
         if self.telemetry is not None:
             try:
-                if err is None and self.error is None:
+                if err is None and stream_err is None:
                     self.telemetry.finish(timing)
                 else:
-                    self.telemetry.close(err or self.error)
+                    self.telemetry.close(err or stream_err)
             except BaseException as e:
                 err = err or e
         with self._cond:
@@ -489,12 +782,18 @@ class Session:
         if it failed. Delivered corrected frames are released from
         session memory."""
         with self._cond:
-            ok = self._cond.wait_for(
-                lambda: self.error is not None
-                or len(self._outs) > self._outs_delivered
-                or self.closed,
-                timeout=timeout,
-            )
+            self.last_activity = time.monotonic()
+            self.waiters += 1
+            try:
+                ok = self._cond.wait_for(
+                    lambda: self.error is not None
+                    or len(self._outs) > self._outs_delivered
+                    or self.closed,
+                    timeout=timeout,
+                )
+            finally:
+                self.waiters -= 1
+                self.last_activity = time.monotonic()
             if self.error is not None:
                 raise self.error
             if not ok:
@@ -524,7 +823,16 @@ class Session:
         """Block until the stream is finalized; return its result (or
         raise its error)."""
         with self._cond:
-            if not self._cond.wait_for(lambda: self.closed, timeout=timeout):
+            self.last_activity = time.monotonic()
+            self.waiters += 1
+            try:
+                done = self._cond.wait_for(
+                    lambda: self.closed, timeout=timeout
+                )
+            finally:
+                self.waiters -= 1
+                self.last_activity = time.monotonic()
+            if not done:
                 raise TimeoutError(
                     f"session {self.sid} did not finalize within {timeout}s"
                 )
@@ -539,13 +847,24 @@ class Session:
         with self._cond:  # reentrant: the scheduler snapshots under it
             t0 = self._t0
             done = self.done
+            idle = time.monotonic() - self.last_activity
+            rb = dict(self._rb)
+            rb_deduped = self.deduped_frames
         elapsed = (
             max(time.perf_counter() - t0, 1e-9)
             if t0 is not None
             else None
         )
-        return {
+        out = {
             "name": f"{self.tenant}/{self.sid}",
             "frames": done,
             "fps": (done / elapsed) if elapsed else 0.0,
+            "idle_s": round(max(idle, 0.0), 1),
         }
+        if rb_deduped:
+            rb["deduped_frames"] = int(rb_deduped)
+        if any(
+            v for v in rb.values() if not isinstance(v, (list, str))
+        ) or rb.get("quarantined_parts"):
+            out["robustness"] = rb
+        return out

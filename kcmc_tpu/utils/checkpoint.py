@@ -20,9 +20,11 @@ import numpy as np
 from kcmc_tpu.obs.log import advise
 
 
-def _file_digest(path: str) -> str:
+def file_digest(path: str) -> str:
     """sha256 of a file's bytes — the per-part content checksum guarding
-    resume against torn writes and bit rot."""
+    resume against torn writes and bit rot. Shared by the streaming
+    checkpoints here and the serve session journals
+    (`serve/journal.py`)."""
     h = hashlib.sha256()
     with open(path, "rb") as f:
         for block in iter(lambda: f.read(1 << 20), b""):
@@ -30,9 +32,9 @@ def _file_digest(path: str) -> str:
     return h.hexdigest()
 
 
-def _quarantine(path: str) -> str | None:
-    """Rename a corrupt checkpoint file to `<path>.corrupt` so the
-    evidence survives for post-mortem while the resume path stops
+def quarantine_file(path: str) -> str | None:
+    """Rename a corrupt checkpoint/journal file to `<path>.corrupt` so
+    the evidence survives for post-mortem while the resume path stops
     tripping over it. Returns the quarantine path (None if the rename
     itself failed — e.g. the file vanished)."""
     q = f"{path}.corrupt"
@@ -43,7 +45,10 @@ def _quarantine(path: str) -> str | None:
     return q
 
 
-def _atomic_savez(path: str, **payload) -> None:
+def atomic_savez(path: str, **payload) -> None:
+    """Write an .npz with all-or-nothing visibility: a mid-write kill
+    (SIGKILL, power loss) leaves either the previous file or the new
+    one, never a torn hybrid."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp.npz")
     os.close(fd)
@@ -103,17 +108,17 @@ def save_stream_checkpoint(
     meta = dict(meta)
     if new_segments:
         pp = _part_path(path, part_index)
-        _atomic_savez(pp, **_segment_arrays(new_segments))
+        atomic_savez(pp, **_segment_arrays(new_segments))
         meta["n_parts"] = part_index + 1
         # part_index re-saves overwrite orphans; truncate history to match
         history = list(meta.get("parts", []))[:part_index]
         history.append({
             "done": meta.get("done"),
             "writer": meta.get("writer"),
-            "checksum": _file_digest(pp),
+            "checksum": file_digest(pp),
         })
         meta["parts"] = history
-    _atomic_savez(path, meta=json.dumps(meta), **(arrays or {}))
+    atomic_savez(path, meta=json.dumps(meta), **(arrays or {}))
     return meta
 
 
@@ -155,7 +160,7 @@ def load_stream_checkpoint(path: str, fault_plan=None, report=None):
             meta = json.loads(str(z["meta"]))
             extra = {k: z[k] for k in z.files if k != "meta"}
     except Exception as e:
-        q = _quarantine(path)
+        q = quarantine_file(path)
         advise(
             f"kcmc: resume checkpoint {path} is corrupt "
             f"({type(e).__name__}: {e}); quarantined it"
@@ -175,7 +180,7 @@ def load_stream_checkpoint(path: str, fault_plan=None, report=None):
             fault_plan.corrupt_file(pp)
         try:
             if p < len(history) and history[p].get("checksum"):
-                digest = _file_digest(pp)
+                digest = file_digest(pp)
                 want = history[p]["checksum"]
                 if digest != want:
                     raise ValueError(
@@ -185,7 +190,7 @@ def load_stream_checkpoint(path: str, fault_plan=None, report=None):
             with np.load(pp, allow_pickle=False) as z:
                 part = _split_segments({k: z[k] for k in z.files})
         except Exception as e:
-            q = _quarantine(pp)
+            q = quarantine_file(pp)
             if report is not None and q:
                 report.quarantined_parts.append(q)
             rewind = (
@@ -276,7 +281,7 @@ class ResumableCorrector:
 
     def _save(self, meta: dict, arrays: dict) -> None:
         # atomic replace so a mid-write kill can't corrupt the checkpoint
-        _atomic_savez(self.path, meta=json.dumps(meta), **arrays)
+        atomic_savez(self.path, meta=json.dumps(meta), **arrays)
 
     # -- main loop ---------------------------------------------------------
 

@@ -6,15 +6,23 @@ device errors, flaky storage reads, and corrupt checkpoints instead of
 dying at frame 800k. This module provides both halves of that story:
 
 * **FaultPlan** — a seedable, deterministic fault injector. A plan is
-  parsed from a compact spec string and armed around the three failure
+  parsed from a compact spec string and armed around the failure
   surfaces of a run: chunk reads (``io_read``, in
   `io.reader.ChunkedStackLoader`), per-batch device execution
-  (``device``, in `MotionCorrector._dispatch_batches`), the numpy
-  failover rung (``failover``), and checkpoint part load
-  (``checkpoint``, in `utils.checkpoint.load_stream_checkpoint`).
+  (``device``, in `MotionCorrector._dispatch_batches` AND the serve
+  scheduler's dispatch path), the numpy failover rung (``failover``),
+  checkpoint part load (``checkpoint``, in
+  `utils.checkpoint.load_stream_checkpoint`), and — for the serve
+  plane — client transport (``transport``, in the server's connection
+  handler: a raising clause drops the connection, a ``stall=`` clause
+  half-opens it), the scheduler loop (``scheduler``: a ``stall=``
+  clause wedges one loop iteration, a raising clause exercises the
+  loop's error backstop), and session journaling (``journal``, in
+  `serve.journal.SessionJournal.save`).
   Activated via `CorrectorConfig(fault_plan=...)`, the
   ``KCMC_FAULT_PLAN`` environment variable, or the CLI's
-  ``--inject-faults`` — so chaos runs need no code changes.
+  ``--inject-faults`` (``correct``, ``apply``, and ``serve``) — so
+  chaos runs need no code changes.
 
 * **RetryPolicy** — bounded retries with exponential backoff and
   seeded jitter, shared by the IO and device retry loops.
@@ -30,6 +38,7 @@ Spec grammar (see docs/ROBUSTNESS.md for the full reference)::
     plan    := clause ("," clause)*
     clause  := surface (":" token)*
     surface := io_read | device | failover | checkpoint
+              | transport | scheduler | journal
     token   := key "=" value | action
     action  := transient (default) | fatal | raise (alias of fatal)
               | always (alias of times=inf)
@@ -41,6 +50,11 @@ Spec grammar (see docs/ROBUSTNESS.md for the full reference)::
                                probability F (seeded, deterministic)
                corrupt_part=N  checkpoint surface only: corrupt part
                                file N on disk before it is loaded
+               stall=SECS      transport/scheduler surfaces only: the
+                               matched operation STALLS for SECS
+                               seconds instead of raising (half-open
+                               socket / wedged scheduler simulation;
+                               consumed via `take_stall`)
 
 Example — the chaos trifecta::
 
@@ -61,7 +75,20 @@ import time
 
 import numpy as np
 
-SURFACES = ("io_read", "device", "failover", "checkpoint")
+SURFACES = (
+    "io_read",
+    "device",
+    "failover",
+    "checkpoint",
+    # serve-plane surfaces (PR 14): client transport, the scheduler
+    # loop, and per-session journal writes
+    "transport",
+    "scheduler",
+    "journal",
+)
+
+# Surfaces whose clauses may carry stall=SECS (wedge, don't raise).
+_STALL_SURFACES = ("transport", "scheduler")
 
 
 class FaultError(RuntimeError):
@@ -136,6 +163,7 @@ class _Clause:
     action: str = "transient"  # transient | fatal
     p: float | None = None  # per-attempt probability (seeded)
     corrupt_part: int | None = None  # checkpoint surface only
+    stall: float | None = None  # transport/scheduler: wedge seconds
     fired: int = 0
 
 
@@ -165,10 +193,16 @@ def _parse_clause(text: str) -> _Clause:
                     raise ValueError(f"p must be in (0, 1], got {val!r}")
             elif key == "corrupt_part":
                 c.corrupt_part = int(val)
+            elif key == "stall":
+                c.stall = float(val)
+                if c.stall <= 0.0:
+                    raise ValueError(
+                        f"stall must be positive seconds, got {val!r}"
+                    )
             else:
                 raise ValueError(
                     f"unknown fault-clause key {key!r} in {text!r} "
-                    "(known: step, times, p, corrupt_part)"
+                    "(known: step, times, p, corrupt_part, stall)"
                 )
         elif tok in ("transient",):
             c.action = "transient"
@@ -188,6 +222,11 @@ def _parse_clause(text: str) -> _Clause:
     if c.surface == "checkpoint" and c.corrupt_part is None:
         raise ValueError(
             f"checkpoint clauses need corrupt_part=N ({text!r})"
+        )
+    if c.stall is not None and c.surface not in _STALL_SURFACES:
+        raise ValueError(
+            f"stall= applies to the {'/'.join(_STALL_SURFACES)} surfaces "
+            f"only ({text!r})"
         )
     return c
 
@@ -236,27 +275,48 @@ class FaultPlan:
             self._ops[surface] = i + 1
             return i
 
+    def _take_clause(self, surface: str, step: int | None, stall: bool):
+        """Consume and return the first live clause matching this
+        attempt (stall=True selects stall clauses, False raising ones);
+        None when nothing matches. Lock held by the caller."""
+        for c in self.clauses:
+            if c.surface != surface or (c.stall is not None) != stall:
+                continue
+            if c.step is not None and step is not None and c.step != step:
+                continue
+            if c.fired >= c.times:
+                continue
+            if c.p is not None and c._rng.random() >= c.p:
+                continue
+            c.fired += 1
+            self.injected += 1
+            return c
+        return None
+
     def maybe_fail(self, surface: str, step: int | None) -> None:
-        """Raise the configured fault if a clause matches this attempt."""
+        """Raise the configured fault if a clause matches this attempt
+        (stall clauses never raise — consume them via `take_stall`)."""
         with self._lock:
-            for c in self.clauses:
-                if c.surface != surface:
-                    continue
-                if c.step is not None and step is not None and c.step != step:
-                    continue
-                if c.fired >= c.times:
-                    continue
-                if c.p is not None and c._rng.random() >= c.p:
-                    continue
-                c.fired += 1
-                self.injected += 1
-                msg = (
-                    f"injected {c.action} fault: {surface}"
-                    f"[step={step}] attempt {c.fired}"
-                )
-                if c.action == "fatal":
-                    raise FatalFaultError(msg)
-                raise TransientFaultError(msg)
+            c = self._take_clause(surface, step, stall=False)
+            if c is None:
+                return
+            msg = (
+                f"injected {c.action} fault: {surface}"
+                f"[step={step}] attempt {c.fired}"
+            )
+            if c.action == "fatal":
+                raise FatalFaultError(msg)
+            raise TransientFaultError(msg)
+
+    def take_stall(self, surface: str, step: int | None = None) -> float:
+        """Seconds the matched operation should stall (0.0 = no stall
+        clause fired). The serve plane's transport handler and
+        scheduler loop consume these to simulate half-open sockets and
+        wedged queues; the CALLER sleeps, so injection never blocks
+        unrelated surfaces behind the plan lock."""
+        with self._lock:
+            c = self._take_clause(surface, step, stall=True)
+            return float(c.stall) if c is not None else 0.0
 
     # -- checkpoint surface ------------------------------------------------
 

@@ -1,0 +1,631 @@
+"""Serve-plane fault tolerance (ISSUE 14; docs/ROBUSTNESS.md
+"Serve-plane failures").
+
+The contract under test, end to end:
+
+* **submit idempotency** — frames carry monotonic indices; replayed
+  submits (client reconnect retries) are deduplicated at admission
+  with outputs parity-equal to a clean run, and gaps are rejected;
+* **durable journals + crash resume** — a server killed with SIGKILL
+  mid-stream restarts over the same `--journal-dir` and resumes every
+  journaled session from its last durable frame, with resumed outputs
+  parity-equal (<= 1e-4, the `test_serve_parity.py` tolerance) to an
+  uninterrupted run; corrupt journals quarantine instead of crashing;
+* **backend supervision** — a FATAL injected device error quarantines
+  the backend (rebuilt off the request path) and fails the batch over
+  without dropping any session;
+* **client resilience** — every read has a deadline (no forever-block
+  on a half-open socket), transport drops/stalls are absorbed by
+  reconnect + idempotent replay, and a dead server surfaces as
+  ServeError(code=503), distinct from a drained stream (None);
+* **graceful drain + staleness** — a stopping scheduler journals every
+  open session first, and idle clients are reaped (journaled, not
+  dropped).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.serve.journal import (
+    SessionJournal,
+    journal_path,
+    load_session_journal,
+)
+from kcmc_tpu.serve.scheduler import StreamScheduler
+from kcmc_tpu.utils.faults import (
+    FatalFaultError,
+    FaultPlan,
+    TransientFaultError,
+)
+from kcmc_tpu.utils.synthetic import make_drift_stack
+
+TOL = 1e-4
+MC_KW = dict(
+    model="translation", backend="numpy", batch_size=8,
+    max_keypoints=64, n_hypotheses=32,
+)
+
+
+def _stack(n=24, seed=0, shape=(48, 48)):
+    d = make_drift_stack(
+        n_frames=n, shape=shape, model="translation", max_drift=3.0,
+        seed=seed,
+    )
+    return d.stack.astype(np.float32)
+
+
+def _wait_done(sched, sess, n, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        with sched._lock:
+            if sess.done >= n:
+                return
+        time.sleep(0.02)
+    raise AssertionError(f"session never drained {n} frames")
+
+
+# -- fault-plan grammar: the serve surfaces ---------------------------------
+
+
+def test_serve_surfaces_parse_and_fire():
+    plan = FaultPlan.from_spec(
+        "transport:step=1:raise, scheduler:stall=0.5, journal:times=2"
+    )
+    # transport raises only at its step ("raise" aliases fatal)
+    plan.maybe_fail("transport", 0)
+    with pytest.raises(FatalFaultError):
+        plan.maybe_fail("transport", 1)
+    # stall clauses never raise; they are consumed via take_stall
+    assert plan.take_stall("scheduler") == 0.5
+    assert plan.take_stall("scheduler") == 0.0  # spent
+    # journal clause fires per attempt until its budget is spent
+    for _ in range(2):
+        with pytest.raises(TransientFaultError):
+            plan.maybe_fail("journal", plan.op_index("journal"))
+    plan.maybe_fail("journal", plan.op_index("journal"))
+    assert plan.injected == 4
+
+
+def test_stall_key_is_surface_restricted():
+    with pytest.raises(ValueError, match="stall"):
+        FaultPlan.from_spec("device:stall=1.0")
+    with pytest.raises(ValueError, match="positive"):
+        FaultPlan.from_spec("transport:stall=0")
+
+
+# -- submit idempotency -----------------------------------------------------
+
+
+def test_duplicate_submit_dedup_parity():
+    """Replayed overlapping submits (reconnect retries) must be
+    invisible: outputs equal a one-shot run of the logical stream."""
+    stack = _stack(20, seed=1)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+
+    mc = MotionCorrector(**MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="dup")
+        sched.submit(s.sid, stack[:8], first=0)
+        # full replay of the first submit (a retry whose first attempt
+        # actually landed): dropped wholesale
+        d = sched.submit(s.sid, stack[:8], first=0)
+        assert d["accepted"] == 0 and d["deduped"] == 8
+        # partial overlap: only the new tail is admitted
+        d = sched.submit(s.sid, stack[4:14], first=4)
+        assert d["accepted"] == 6 and d["deduped"] == 4
+        assert d["next"] == 14
+        sched.submit(s.sid, stack[14:], first=14)
+        res = sched.close_session(s.sid, timeout=120)
+    finally:
+        sched.stop()
+    assert res.timing["n_frames"] == 20
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+    assert res.timing["robustness"]["deduped_frames"] == 12
+
+
+def test_submit_gap_rejected():
+    mc = MotionCorrector(**MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="gap")
+        sched.submit(s.sid, _stack(4), first=0)
+        with pytest.raises(ValueError, match="gap"):
+            sched.submit(s.sid, _stack(4), first=9)
+    finally:
+        sched.stop()
+
+
+# -- journal round-trip + crash resume (in-process) -------------------------
+
+
+def test_scheduler_stop_journals_and_resume_is_parity_exact(tmp_path):
+    """The graceful-drain half of the resume contract: stop() journals
+    every open session; a NEW scheduler over the same directory resumes
+    it and the combined outputs equal an uninterrupted run."""
+    stack = _stack(24, seed=2)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+
+    mc = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4, **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    s = sched.open_session(tenant="t", session_id="J1")
+    sched.submit(s.sid, stack[:14], first=0)
+    _wait_done(sched, s, 14)
+    sched.stop()  # graceful drain: journals, then fails the open stream
+    assert os.path.exists(journal_path(str(tmp_path), "J1"))
+
+    mc2 = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4, **MC_KW
+    )
+    sched2 = StreamScheduler(mc2).start()
+    try:
+        sess, cursor, resumed = sched2.resume_session("J1")
+        assert resumed and cursor == 14
+        # client replays from BEFORE the cursor: dedup absorbs it
+        d = sched2.submit("J1", stack[10:], first=10)
+        assert d["deduped"] == 4 and d["accepted"] == 10
+        res = sched2.close_session("J1", timeout=120)
+        st = sched2.stats()
+    finally:
+        sched2.stop()
+    assert res.timing["n_frames"] == 24
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+    rb = res.timing["robustness"]
+    assert rb["resumed_from_frame"] == 14
+    assert st["resilience"]["sessions_resumed"] == 1
+    # clean close discards the journal: no duplicate resurrection
+    assert not os.path.exists(journal_path(str(tmp_path), "J1"))
+
+
+def test_rolling_template_journal_resume_parity(tmp_path):
+    """Resume across a template boundary: the journaled rolling state
+    (template source, boundary, blend tail) must reproduce the
+    uninterrupted boundary updates exactly."""
+    stack = _stack(32, seed=3)
+    truth = MotionCorrector(
+        template_update_every=16, **MC_KW
+    ).correct(stack)
+
+    mc = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4,
+        template_update_every=16, **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    s = sched.open_session(
+        tenant="roll", session_id="R1", template_update_every=16
+    )
+    sched.submit(s.sid, stack[:21], first=0)  # past the first boundary
+    _wait_done(sched, s, 21)
+    sched.stop()
+
+    mc2 = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4,
+        template_update_every=16, **MC_KW
+    )
+    sched2 = StreamScheduler(mc2).start()
+    try:
+        _sess, cursor, resumed = sched2.resume_session("R1")
+        assert resumed
+        sched2.submit("R1", stack[cursor:], first=cursor)
+        res = sched2.close_session("R1", timeout=120)
+    finally:
+        sched2.stop()
+    assert res.timing["n_frames"] == 32
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+
+
+def test_corrupt_journal_quarantines_and_rewinds(tmp_path):
+    """Checkpoint-grade corruption handling: a corrupt part rewinds a
+    static-reference journal to its last good prefix, a rolling-
+    template journal refuses the rewind, and a corrupt meta record is
+    quarantined — the serving plane never crashes over any of them."""
+    j = SessionJournal(str(tmp_path), "C1", every=1)
+    ref = {"ref_frame": np.zeros((4, 4), np.float32)}
+    base = {"sid": "C1", "config": "x", "tail_lens": []}
+    def seg(v):
+        return [{
+            "transform": np.eye(3, dtype=np.float32)[None],
+            "n_inliers": np.array([v]),
+        }]
+    assert j.save(dict(base, done=1), seg(3), ref)
+    assert j.save(dict(base, done=2), seg(5), ref)
+    path = journal_path(str(tmp_path), "C1")
+    meta, segments, _arrays = load_session_journal(path)
+    assert meta["done"] == 2 and len(segments) == 2
+
+    # corrupt the SECOND part: quarantined + rewound to cursor 1
+    part1 = f"{path}.part00001.npz"
+    with open(part1, "r+b") as f:
+        f.truncate(os.path.getsize(part1) // 2)
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        got = load_session_journal(path)
+    assert got is not None
+    meta, segments, _arrays = got
+    assert meta["done"] == 1 and len(segments) == 1
+    assert os.path.exists(part1 + ".corrupt")
+
+    # a ROLLING journal with a corrupt part refuses the rewind (the
+    # stored template matches only the final cursor)
+    jr = SessionJournal(str(tmp_path), "C2", every=1)
+    tmpl = {"template": np.zeros((4, 4), np.float32)}
+    rbase = {"sid": "C2", "config": "x", "tail_lens": []}
+    assert jr.save(dict(rbase, done=1), seg(1), tmpl)
+    assert jr.save(dict(rbase, done=2), seg(2), tmpl)
+    rpath = journal_path(str(tmp_path), "C2")
+    rpart1 = f"{rpath}.part00001.npz"
+    with open(rpart1, "r+b") as f:
+        f.truncate(os.path.getsize(rpart1) // 2)
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        assert load_session_journal(rpath) is None
+
+    # corrupt the META record: quarantined, stream unresumable
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        assert load_session_journal(path) is None
+    assert os.path.exists(path + ".corrupt")
+    assert not os.path.exists(path)
+
+    # a scheduler resume over the quarantined journal reports "no
+    # journal", it does not crash the plane
+    mc = MotionCorrector(serve_journal_dir=str(tmp_path), **MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        with pytest.raises(KeyError, match="no journal"):
+            sched.resume_session("C1")
+    finally:
+        sched.stop()
+
+
+def test_resume_config_mismatch_rejected(tmp_path):
+    mc = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4, **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    s = sched.open_session(session_id="M1")
+    sched.submit(s.sid, _stack(12), first=0)
+    _wait_done(sched, s, 12)
+    sched.stop()
+
+    kw = dict(MC_KW, n_hypotheses=64)  # SIG-AFFECTING change
+    mc2 = MotionCorrector(serve_journal_dir=str(tmp_path), **kw)
+    sched2 = StreamScheduler(mc2).start()
+    try:
+        with pytest.raises(ValueError, match="incompatible"):
+            sched2.resume_session("M1")
+    finally:
+        sched2.stop()
+
+
+def test_journal_write_failure_never_fails_the_stream(tmp_path):
+    """An injected journal fault degrades durability (counted,
+    advised), never the stream."""
+    stack = _stack(16, seed=4)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+    mc = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4,
+        fault_plan="journal:always", **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="jf")
+        with pytest.warns(RuntimeWarning, match="journal write"):
+            sched.submit(s.sid, stack, first=0)
+            res = sched.close_session(s.sid, timeout=120)
+    finally:
+        sched.stop()
+    assert res.timing["n_frames"] == 16
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+    assert res.timing["robustness"]["journal_failures"] >= 1
+
+
+# -- backend supervision ----------------------------------------------------
+
+
+@pytest.mark.parametrize("spec", ["device:step=1:fatal"])
+def test_fatal_device_error_fails_over_without_dropping_session(spec):
+    """The acceptance case: a FATAL injected device error quarantines
+    the backend and recovers the batch on the failover rung — zero
+    dropped sessions, outputs within tolerance."""
+    stack = _stack(24, seed=5)
+    truth = MotionCorrector(backend="jax", **{
+        k: v for k, v in MC_KW.items() if k != "backend"
+    }).correct(stack)
+
+    kw = {k: v for k, v in MC_KW.items() if k != "backend"}
+    mc = MotionCorrector(backend="jax", fault_plan=spec, **kw)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="sup")
+        with pytest.warns(RuntimeWarning, match="quarantined"):
+            sched.submit(s.sid, stack, first=0)
+            res = sched.close_session(s.sid, timeout=180)
+        st = sched.stats()
+    finally:
+        sched.stop()
+    assert res.timing["n_frames"] == 24
+    rb = res.timing["robustness"]
+    assert rb["backend_failovers"] >= 1
+    assert rb["failed_frames"] == 0
+    assert st["supervisor"]["backend_rebuilds"] >= 1
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+
+
+def test_transient_strikes_quarantine_and_rebuild():
+    """Repeated transient dispatch failures cross the strike threshold
+    and trigger a rebuild; the ladder's retry rung still recovers every
+    batch, so nothing is lost meanwhile."""
+    stack = _stack(24, seed=6)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+    mc = MotionCorrector(
+        fault_plan="device:times=2:transient",
+        retry_backoff_s=0.001, serve_backend_strikes=2, **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="strikes")
+        sched.submit(s.sid, stack, first=0)
+        res = sched.close_session(s.sid, timeout=120)
+    finally:
+        sched.stop()
+    assert res.timing["n_frames"] == 24
+    assert res.timing["robustness"]["device_retries"] >= 1
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+
+
+# -- scheduler-queue wedge surface ------------------------------------------
+
+
+def test_scheduler_stall_and_error_injection_survive():
+    """A scheduler stall clause wedges one iteration (visible in the
+    wedge gauge ordering: the loop still beats afterwards) and a
+    raising clause lands in the loop's error backstop — the plane keeps
+    serving either way."""
+    stack = _stack(12, seed=7)
+    mc = MotionCorrector(
+        fault_plan="scheduler:stall=0.2, scheduler:raise", **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="wedge")
+        sched.submit(s.sid, stack, first=0)
+        res = sched.close_session(s.sid, timeout=120)
+        st = sched.stats()
+    finally:
+        sched.stop()
+    assert res.timing["n_frames"] == 12
+    assert st["supervisor"]["loop_beat_age_s"] >= 0.0
+    assert sched.fault_plan.injected == 2
+
+
+# -- staleness reap ---------------------------------------------------------
+
+
+def test_stale_session_reaped_journaled_and_resumable(tmp_path):
+    stack = _stack(12, seed=8)
+    mc = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4,
+        serve_session_timeout_s=0.3, **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="idle", session_id="Z1")
+        sched.submit(s.sid, stack, first=0)
+        _wait_done(sched, s, 12)
+        # the reap fires on the scheduler thread once the client has
+        # been idle past the timeout — poll the counter
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            with sched._lock:
+                if sched._stats["sessions_reaped"]:
+                    break
+            time.sleep(0.05)
+        st = sched.stats()
+        assert st["resilience"]["sessions_reaped"] == 1
+        assert st["sessions_open"] == 0
+        # journaled, not dropped: the reaped stream resumes
+        assert os.path.exists(journal_path(str(tmp_path), "Z1"))
+        _sess, cursor, resumed = sched.resume_session("Z1")
+        assert resumed and cursor == 12
+        res = sched.close_session("Z1", timeout=120)
+        assert res.timing["n_frames"] == 12
+    finally:
+        sched.stop()
+
+
+# -- heartbeat narration ----------------------------------------------------
+
+
+def test_aggregate_sampler_renders_resilience():
+    from kcmc_tpu.obs.heartbeat import aggregate_sampler
+
+    line = aggregate_sampler(lambda: {
+        "sessions": [
+            {"name": "t/s1", "frames": 10, "fps": 2.0, "idle_s": 40.0}
+        ],
+        "robustness": {"backend_failovers": 2, "journal_saves": 3},
+        "stale": {"t/s1": 40.0},
+        "loop_beat_age_s": 45.0,
+    })()
+    assert "robustness backend_failovers=2 journal_saves=3" in line
+    assert "stale t/s1=40s" in line
+    assert "SCHEDULER WEDGED 45s" in line
+
+
+def test_aggregate_sampler_quiet_when_healthy():
+    from kcmc_tpu.obs.heartbeat import aggregate_sampler
+
+    line = aggregate_sampler(lambda: {
+        "sessions": [{"name": "t/s1", "frames": 10, "fps": 2.0}],
+        "robustness": {"backend_failovers": 0},
+        "loop_beat_age_s": 0.01,
+    })()
+    assert "robustness" not in line
+    assert "WEDGED" not in line
+
+
+# -- transport resilience (real sockets) ------------------------------------
+
+
+def _client(port, **kw):
+    from kcmc_tpu.serve.client import ServeClient
+
+    return ServeClient(port=port, **kw)
+
+
+def test_transport_drop_and_stall_absorbed_by_reconnect():
+    """A dropped connection and a stalled (half-open) reply are both
+    absorbed: the client reconnects with backoff and replays the
+    idempotent request; submits never double-process (dedup)."""
+    from kcmc_tpu.serve.server import ServeServer
+
+    stack = _stack(16, seed=9)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+    stall_s = 3.0
+    mc = MotionCorrector(
+        # message 1 (the first submit) is dropped mid-request; message 3
+        # stalls past the client read deadline (half-open socket:
+        # io_timeout=1 -> deadline 2s < 3s stall)
+        fault_plan=f"transport:step=1:raise, transport:step=3:stall={stall_s}",
+        **MC_KW,
+    )
+    server = ServeServer(mc, port=0)
+    with server:
+        c = _client(
+            server.port, io_timeout=1.0, reconnect_backoff_s=0.05
+        )
+        sid = c.open_session(tenant="net", session_id="N1")
+        c.submit(sid, stack[:8])   # dropped -> reconnect -> replayed
+        t_stall = time.monotonic()
+        c.submit(sid, stack[8:])   # stalled reply -> timeout -> replayed
+        out = c.close_session(sid, timeout=120)
+        st = c.stats()
+        c.close()
+        # let the stalled handler wake and tear its connection down (the
+        # sanitizer's socket-leak checker runs at test end)
+        time.sleep(max(0.0, t_stall + stall_s + 0.5 - time.monotonic()))
+    assert out["frames"] == 16
+    assert np.abs(out["transforms"] - truth.transforms).max() < TOL
+    # each replayed submit was deduplicated, never double-processed
+    assert st["frames_done"] == 16
+
+
+def test_results_distinguishes_server_gone_from_drained():
+    from kcmc_tpu.serve.client import ServeClient, ServeError
+    from kcmc_tpu.serve.server import ServeServer
+
+    mc = MotionCorrector(**MC_KW)
+    server = ServeServer(mc, port=0)
+    with server:
+        c = ServeClient(
+            port=server.port, io_timeout=1.0,
+            reconnect_attempts=2, reconnect_backoff_s=0.05,
+        )
+        sid = c.open_session(tenant="gone")
+        c.submit(sid, _stack(4))
+        got = c.results(sid, timeout=30.0)
+        assert got is not None and got["n"] == 4
+        c.close_session(sid, timeout=60)
+        # stream drained: None, not an error
+        assert c.results(sid, timeout=5.0) is None
+        # drop our socket so the post-shutdown poll must RECONNECT (a
+        # lingering handler thread of the stopped server could otherwise
+        # still answer on the old connection); close() is terminal, so
+        # the keep-the-client-usable drop is disconnect()
+        c.disconnect()
+    # server gone: a coded transport error, not a hang and not None
+    with pytest.raises(ServeError) as ei:
+        c.results(sid, timeout=5.0)
+    assert ei.value.code == 503
+    c.close()
+
+
+# -- the kill -9 canary (real process, real SIGKILL) ------------------------
+
+
+def _spawn_serve(tmp_path, extra=()):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "kcmc_tpu", "serve",
+            "--port", "0", "--backend", "numpy",
+            "--batch-size", "8", "--max-keypoints", "64",
+            "--hypotheses", "32",
+            "--journal-dir", str(tmp_path / "journals"),
+            "--journal-every", "4",
+            *extra,
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    ready = json.loads(proc.stdout.readline())
+    assert ready["serving"] is True
+    return proc, ready["port"]
+
+
+@pytest.mark.slow
+def test_kill9_mid_stream_restart_resumes_with_zero_gaps(tmp_path):
+    """THE acceptance canary: SIGKILL a serving process mid-stream,
+    restart it over the same journal dir, resume, and the full-stream
+    outputs are parity-equal to an uninterrupted run — no frame gaps,
+    no duplicates."""
+    stack = _stack(24, seed=10)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+
+    proc, port = _spawn_serve(tmp_path)
+    try:
+        c = _client(port, io_timeout=5.0, reconnect_attempts=2)
+        sid = c.open_session(tenant="k9", session_id="K1")
+        c.submit(sid, stack[:16])
+        # wait until the journal has durable frames
+        jp = journal_path(str(tmp_path / "journals"), "K1")
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 60.0:
+            if os.path.exists(jp):
+                loaded = load_session_journal(jp)
+                if loaded is not None and int(loaded[0]["done"]) >= 4:
+                    break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("journal never became durable")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        c.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    proc2, port2 = _spawn_serve(tmp_path)
+    try:
+        c2 = _client(port2, io_timeout=5.0)
+        cursor = c2.resume_session("K1")
+        assert cursor >= 4
+        # re-submit from the cursor: no gaps (the server rejects them),
+        # overlap is impossible (we start exactly at the cursor)
+        c2.submit("K1", stack[cursor:])
+        out = c2.close_session("K1", timeout=180)
+        st = c2.stats()
+        c2.shutdown()
+        c2.close()
+    finally:
+        try:
+            proc2.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+
+    assert out["frames"] == 24
+    assert np.abs(out["transforms"] - truth.transforms).max() < TOL
+    assert st["resilience"]["sessions_resumed"] == 1
