@@ -345,6 +345,7 @@ def run_bench_serve(
             t.join()
         dt = time.perf_counter() - t0
         stats = sched.stats()
+        metrics = sched.metrics()
     finally:
         sched.stop()
     total = n_frames * n_streams
@@ -352,6 +353,37 @@ def run_bench_serve(
         _rmse(data, "translation", r.transforms, None)
         for r in results.values()
     )
+
+    def _pq(summary: dict | None) -> dict | None:
+        if not summary or not summary.get("count"):
+            return None
+        return {
+            "p50": round((summary.get("p50_s") or 0.0) * 1e3, 2),
+            "p99": round((summary.get("p99_s") or 0.0) * 1e3, 2),
+        }
+
+    # Judged per-segment and per-stream latency columns (obs/latency):
+    # the plane rollup's request segments, plus each closed stream's
+    # own end-to-end p50/p99 from its close_session timing — the
+    # baseline row the latency-QoS work (ROADMAP item 2) is judged
+    # against. None when latency_telemetry was disabled (the overhead
+    # A/B; see --latency-off).
+    plane_totals = metrics.get("plane", {}).get("totals", {})
+    latency_ms = {
+        seg: pq
+        for seg, pq in (
+            (s, _pq(plane_totals.get(s)))
+            for s in ("request.total", "request.queue_wait",
+                      "request.device", "request.delivery")
+        )
+        if pq is not None
+    }
+    per_stream_latency_ms = {}
+    for sid, res in results.items():
+        sec = (res.timing.get("latency") or {}).get("totals", {})
+        pq = _pq(sec.get("request.total"))
+        if pq is not None:
+            per_stream_latency_ms[sid] = pq
     return {
         "fps": total / dt,
         "per_stream_fps": round(total / dt / n_streams, 2),
@@ -361,6 +393,8 @@ def run_bench_serve(
         "n_frames": total,
         "batch_occupancy": stats["batch_occupancy"],
         "admission": stats["admission"],
+        "latency_ms": latency_ms or None,
+        "per_stream_latency_ms": per_stream_latency_ms or None,
     }
 
 
@@ -1075,6 +1109,12 @@ def main() -> None:
         help="concurrent client streams for --serve (default 2)",
     )
     ap.add_argument(
+        "--latency-off", action="store_true",
+        help="run --serve with latency_telemetry disabled — the A/B "
+        "for the < 2%% telemetry-overhead contract documented in "
+        "docs/OBSERVABILITY.md 'Request latency'",
+    )
+    ap.add_argument(
         "--coldstart", action="store_true",
         help="cold-start mode: measure process start -> first corrected "
         "frame in fresh subprocesses, cold compile cache vs warm "
@@ -1355,6 +1395,7 @@ def main() -> None:
         rv = _run_with_retry(
             run_bench_serve, args.frames, args.size, args.batch,
             n_streams=args.streams,
+            latency_telemetry=not args.latency_off,
         )
         configs = dict(configs or {})
         configs[f"serve_{args.streams}streams"] = dict(
@@ -1363,12 +1404,22 @@ def main() -> None:
             n_streams=rv["n_streams"],
             batch_occupancy=rv["batch_occupancy"],
             admission=rv["admission"],
+            latency_telemetry=not args.latency_off,
+            latency_ms=rv["latency_ms"],
+            per_stream_latency_ms=rv["per_stream_latency_ms"],
         )
+        tot_lat = (rv["latency_ms"] or {}).get("request.total")
         print(
             f"[bench] serve x{args.streams} {args.size}x{args.size}: "
             f"{rv['fps']:.1f} fps total ({rv['per_stream_fps']:.1f} "
             f"per stream), occupancy {rv['batch_occupancy']:.2f}, "
-            f"rmse {rv['rmse_px']:.3f} px",
+            f"rmse {rv['rmse_px']:.3f} px"
+            + (
+                f", e2e p50 {tot_lat['p50']:.1f}ms p99 "
+                f"{tot_lat['p99']:.1f}ms"
+                if tot_lat
+                else ""
+            ),
             file=sys.stderr,
         )
 

@@ -551,6 +551,43 @@ def _cmd_report(args) -> int:
     return report_main(args.artifact, top=args.top, as_json=args.json)
 
 
+def _cmd_metrics(args) -> int:
+    """Scrape a serve replica's request-latency/health metrics
+    (docs/OBSERVABILITY.md "Request latency"): JSON by default,
+    Prometheus text exposition with --text. `source` is host:port of
+    a live server, or a previously dumped metrics JSON file."""
+    import os
+
+    from kcmc_tpu.obs.latency import render_prometheus
+    from kcmc_tpu.obs.top import parse_addr
+
+    if os.path.isfile(args.source):
+        with open(args.source, encoding="utf-8") as f:
+            snap = json.load(f)
+        # accept either the raw verb reply ({"ok":..,"metrics":..})
+        # or a bare payload dumped earlier by this command
+        m = snap.get("metrics", snap)
+    else:
+        host, port = parse_addr(args.source)
+        from kcmc_tpu.serve.client import ServeClient
+
+        with ServeClient(host=host, port=port) as c:
+            m = c.metrics()
+    if args.text:
+        print(render_prometheus(m), end="")
+    else:
+        print(json.dumps(m))
+    return 0
+
+
+def _cmd_top(args) -> int:
+    """Live terminal dashboard over a serve replica: per-session
+    fps/queue depth, segment latency p50/p99, supervisor state."""
+    from kcmc_tpu.obs.top import main as top_main
+
+    return top_main(args)
+
+
 def _cmd_selftest(args) -> int:
     from kcmc_tpu.selftest import main as selftest_main
 
@@ -987,6 +1024,47 @@ def main(argv=None) -> int:
         help="command to run, e.g. `pytest tests/test_serve.py -q`",
     )
     p.set_defaults(fn=_cmd_sanitize)
+
+    p = sub.add_parser(
+        "metrics",
+        help="scrape a serve replica's request-latency/health metrics "
+        "(the `metrics` verb): JSON by default, Prometheus text "
+        "exposition with --text — the machine-readable surface a "
+        "router or scraper health-checks replicas on "
+        "(docs/OBSERVABILITY.md 'Request latency')",
+    )
+    p.add_argument(
+        "source", nargs="?", default="127.0.0.1:7733",
+        help="host:port of a live server (default 127.0.0.1:7733), or "
+        "a dumped metrics JSON file to re-render",
+    )
+    p.add_argument(
+        "--text", action="store_true",
+        help="Prometheus text exposition (histogram buckets, counters, "
+        "gauges) instead of the JSON payload",
+    )
+    p.set_defaults(fn=_cmd_metrics)
+
+    p = sub.add_parser(
+        "top",
+        help="live terminal dashboard over a serve replica: "
+        "per-session fps and queue depth, per-segment latency "
+        "p50/p99, supervisor state and wedge age (polls the "
+        "metrics/stats verbs)",
+    )
+    p.add_argument(
+        "addr", nargs="?", default="127.0.0.1:7733",
+        help="host:port of the serve replica (default 127.0.0.1:7733)",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0, metavar="SECS",
+        help="refresh period (default 2)",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (scripting / CI smoke)",
+    )
+    p.set_defaults(fn=_cmd_top)
 
     p = sub.add_parser(
         "report",

@@ -37,9 +37,17 @@ REGISTRY_PATH = "kcmc_tpu/obs/registry.py"
 SPAN_SET_NAME = "SPAN_NAMES"
 TIMING_SET_NAME = "TIMING_KEYS"
 
-# method name -> emits a span-like name as first string arg
+# method name -> emits a span-like name as first string arg.
+# `observe` is the latency-segment recorder (obs/latency.py
+# SegmentLatencies.observe) — its first argument is a lifecycle
+# segment name, governed by REQUEST_SEGMENTS/JOURNAL_SPANS in the
+# registry so an unregistered segment fails this pass (the CI canary
+# proves it).
 SPAN_EMITTERS = frozenset(
-    {"complete", "span", "instant", "counter", "stage", "stall", "add_stall"}
+    {
+        "complete", "span", "instant", "counter", "stage", "stall",
+        "add_stall", "observe",
+    }
 )
 
 

@@ -283,6 +283,18 @@ class CorrectorConfig:
     # counters) to stderr every period — liveness for unattended runs.
     # CLI: --heartbeat SECS.
     heartbeat_s: float = 0.0
+    # Per-request latency telemetry (docs/OBSERVABILITY.md "Request
+    # latency"): serve sessions accumulate mergeable log-bucket
+    # histograms per lifecycle segment and QoS rung (submit admission,
+    # queue wait, batch formation, dispatch, device execution, drain,
+    # delivery, end-to-end), exported through the `metrics` serve verb
+    # / `kcmc_tpu metrics --text` / `kcmc_tpu top`; one-shot runs with
+    # any obs surface armed record the dispatch/device/drain subset
+    # into `timing["latency"]`. Cost is a handful of perf_counter
+    # reads and O(1) integer histogram adds per BATCH seam (measured
+    # < 2% on `bench.py --serve` — the acceptance gate). On by
+    # default; False drops every record site to one attribute check.
+    latency_telemetry: bool = True
 
     # -- serving (kcmc_tpu/serve; docs/SERVING.md) -------------------------
     # Per-session admission bound, in frames: a `submit_frames` that
@@ -880,6 +892,9 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "trace_path",
         "frame_records_path",
         "heartbeat_s",
+        # Pure observability: histograms record WHEN things happened,
+        # never change what a run computes.
+        "latency_telemetry",
         "serve_queue_depth",
         "serve_inflight",
         "serve_degrade_watermark",

@@ -1615,6 +1615,12 @@ class MotionCorrector:
         # obs seam: per-batch dispatch spans land on the consumer
         # thread's trace track (None when tracing is off — free).
         tracer = getattr(timer, "tracer", None) if timer is not None else None
+        # Request-latency segments (obs/latency.py): one-shot runs with
+        # telemetry armed record the dispatch/device/drain subset of
+        # the serve vocabulary, so `timing["latency"]` / `kcmc_tpu
+        # report` read the same schema as the serve `metrics` verb.
+        tel = getattr(self, "_telemetry", None)
+        lat = tel.latency if tel is not None else None
         # Per-shard attribution for mesh runs: every dispatch span
         # carries the shard count, the device ids the batch fanned out
         # to, and the per-shard frame slice, so a Perfetto view of a
@@ -1701,7 +1707,11 @@ class MotionCorrector:
                         # trip; the program scores it as hypothesis 0.
                         kw["seed"] = (seed, True)
             step = plan.op_index("device") if plan is not None else None
-            t_disp = time.perf_counter() if tracer is not None else 0.0
+            t_disp = (
+                time.perf_counter()
+                if tracer is not None or lat is not None
+                else 0.0
+            )
             try:
                 if plan is not None:
                     plan.maybe_fail("device", step)
@@ -1742,6 +1752,18 @@ class MotionCorrector:
                     "dispatch_batch", t_disp, time.perf_counter() - t_disp,
                     cat="dispatch", args=span_args,
                 )
+            t_disp_done = 0.0
+            if lat is not None:
+                t_disp_done = time.perf_counter()
+                if dispatch is not None:
+                    # async seam only: a synchronous backend EXECUTES
+                    # inside the dispatch call, and that interval is
+                    # recorded as request.device below — recording it
+                    # here too would double-count the kernel time and
+                    # break the segments-telescope property
+                    lat.observe(
+                        "request.dispatch", t_disp_done - t_disp, n=n
+                    )
             if on_dispatched is not None:
                 # pre-drop hook: the device-template tail needs the
                 # still-async "corrected" arrays even on spans whose
@@ -1754,14 +1776,27 @@ class MotionCorrector:
             if dispatch is not None:
                 inflight.append(
                     (n, out, kept, batch if keep_for_ladder else None,
-                     idx, step, backend, kw, emit_frames, cast_dtype, ref)
+                     idx, step, backend, kw, emit_frames, cast_dtype, ref,
+                     t_disp_done)
                 )
                 if len(inflight) >= depth:
                     self._drain_entry(inflight.pop(0), drain, to_host, state)
             else:
                 if self._robust_active():
                     self._note_out_template(out)
-                drain((n, out, kept, ref))
+                if lat is not None:
+                    # synchronous backends execute inside the dispatch
+                    # call — that duration IS the device segment
+                    lat.observe(
+                        "request.device", t_disp_done - t_disp, n=n
+                    )
+                    t_dr = time.perf_counter()
+                    drain((n, out, kept, ref))
+                    lat.observe(
+                        "request.drain", time.perf_counter() - t_dr, n=n
+                    )
+                else:
+                    drain((n, out, kept, ref))
         if flush:
             flush_inflight()
 
@@ -1774,7 +1809,8 @@ class MotionCorrector:
         against (carried in the entry), so ladder re-attempts of a
         pre-boundary batch never re-register it against a template that
         advanced while it was in flight."""
-        n, out, kept, batch, idx, step, backend, kw, emit2, cast2, ref = entry
+        (n, out, kept, batch, idx, step, backend, kw, emit2, cast2, ref,
+         t_disp_done) = entry
         if self._robust_active() and to_host:
             timer = state.get("timer") if state is not None else None
             try:
@@ -1789,7 +1825,18 @@ class MotionCorrector:
                     e, backend, batch, ref, idx, kw, step, n, emit2, cast2
                 )
                 kept = self._failed_kept(out, kept, failed)
-        drain((n, out, kept, ref))
+        tel = getattr(self, "_telemetry", None)
+        lat = tel.latency if tel is not None else None
+        if lat is not None and t_disp_done:
+            # device segment = dispatch return -> host-side drain start
+            # (window residency + async completion); the drain segment
+            # wraps the callback (materialization, rescue, records)
+            t_host = time.perf_counter()
+            lat.observe("request.device", t_host - t_disp_done, n=n)
+            drain((n, out, kept, ref))
+            lat.observe("request.drain", time.perf_counter() - t_host, n=n)
+        else:
+            drain((n, out, kept, ref))
 
     def _failed_kept(self, out: dict, kept, failed: bool):
         """Drain-side handling of a rung-3 (mark-failed) ladder result:

@@ -126,6 +126,9 @@ def aggregate_sampler(snapshot):
     * ``robustness`` — optional aggregate recovery counters (retries,
       failovers, rescued frames, journal saves) — rendered only when
       any is nonzero, so a healthy plane's line stays short;
+    * ``latency`` — optional end-to-end request-latency digest
+      (``{"p50_ms", "p99_ms"}`` of the plane's ``request.total``
+      histogram) — the liveness line's tail-latency pulse;
     * ``stale`` — optional ``{session name: idle seconds}`` of clients
       approaching the staleness reap;
     * ``loop_beat_age_s`` — optional scheduler-loop liveness age; ages
@@ -175,6 +178,12 @@ def aggregate_sampler(snapshot):
                     for k, v in sorted(robustness.items())
                     if v
                 )
+            )
+        lat = snap.get("latency")
+        if lat and lat.get("p99_ms") is not None:
+            parts.append(
+                f"latency p50={float(lat.get('p50_ms', 0.0)):.0f}ms "
+                f"p99={float(lat['p99_ms']):.0f}ms"
             )
         stale = snap.get("stale")
         if stale:

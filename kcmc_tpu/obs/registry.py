@@ -70,14 +70,37 @@ INSTANT_NAMES = frozenset(
         "checkpoint_resume",
         "plan_cache_hit",
         "plan_cache_miss",
-        # serve session durability (serve/session.py, serve/scheduler.py)
-        "journal_save",
-        "journal_resume",
     }
 )
 
 # Counter series.
 COUNTER_NAMES = frozenset({"frames_done"})
+
+# Request-lifecycle latency segments (obs/latency.py): the shared
+# vocabulary of the per-request telemetry plane — every
+# `SegmentLatencies.observe(...)` site in serve/scheduler.py,
+# serve/session.py, and corrector.py uses these literals, and the
+# `metrics` verb / `kcmc_tpu report` latency section / `kcmc_tpu top`
+# render exactly them. Serve records the full ladder; one-shot runs
+# record the dispatch/device/drain subset (no client queue exists).
+REQUEST_SEGMENTS = frozenset(
+    {
+        "request.admission",  # submit entry -> admitted to the queue
+        "request.queue_wait",  # admitted -> taken into a batch
+        "request.batch_form",  # take_batch stack+pad
+        "request.dispatch",  # batch formed -> device dispatch returned
+        "request.device",  # dispatch returned -> host materialized
+        "request.drain",  # materialized -> session accounting done
+        "request.delivery",  # accounted -> fetched by the client
+        "request.total",  # submit entry -> fetched (end to end)
+    }
+)
+
+# Durable-journal DURATION spans (serve/session.py, serve/scheduler.py):
+# tracer spans (cat "journal") AND latency segments, so durability cost
+# shows up both in Perfetto and in the `metrics` verb. These replaced
+# the PR-14 `journal_save`/`journal_resume` instants.
+JOURNAL_SPANS = frozenset({"journal.save", "journal.resume"})
 
 SPAN_NAMES = (
     STAGE_SPANS
@@ -88,6 +111,8 @@ SPAN_NAMES = (
     | FEEDER_SPANS
     | INSTANT_NAMES
     | COUNTER_NAMES
+    | REQUEST_SEGMENTS
+    | JOURNAL_SPANS
 )
 
 # -- timing payload keys ---------------------------------------------------
@@ -118,5 +143,10 @@ TIMING_KEYS = frozenset(
         # reads n_frames back in serve/server.py close_session)
         "n_frames",
         "elapsed_s",
+        # request-latency section (obs/latency.py SegmentLatencies
+        # .report(): {"segments": ..., "totals": ...}) — attached by
+        # serve session finalize and RunTelemetry.finish, rendered by
+        # obs/report.py and the `metrics` verb consumers
+        "latency",
     }
 )

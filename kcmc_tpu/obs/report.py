@@ -194,6 +194,7 @@ def _stage_table(timing: dict) -> list[str]:
                 else ""
             )
         )
+    lines.extend(_latency_table(timing))
     fps = timing.get("frames_per_sec")
     if fps:
         lines.append(f"Throughput: {fps:.1f} frames/sec")
@@ -230,6 +231,50 @@ def _stage_table(timing: dict) -> list[str]:
                 f"    {ev.get('program', '?'):<18} {shape:<12}"
                 f" {ev.get('dtype', ''):<8} {ev.get('seconds', 0.0):>8.3f}s"
                 f"{tag}"
+            )
+    return lines
+
+
+def _fmt_ms(v) -> str:
+    """Milliseconds, or the em dash for stats a pre-latency-plane
+    artifact (or an empty histogram) doesn't carry — the renderer must
+    never crash on old runs."""
+    if v is None:
+        return "—"
+    try:
+        return f"{float(v) * 1e3:.2f}"
+    except (TypeError, ValueError):
+        return "—"
+
+
+def _latency_table(timing: dict) -> list[str]:
+    """The "Request latency" section (docs/OBSERVABILITY.md): one row
+    per (lifecycle segment, QoS rung) from `timing["latency"]` — the
+    same schema the serve `metrics` verb exports. Absent on pre-plane
+    artifacts (rendered as nothing, not a crash)."""
+    lat = timing.get("latency")
+    if not isinstance(lat, dict):
+        return []
+    segments = lat.get("segments")
+    if not isinstance(segments, dict) or not segments:
+        return []
+    lines = ["Request latency (per lifecycle segment; ms):"]
+    lines.append(
+        f"  {'segment':<22} {'rung':<9} {'count':>8} {'p50':>9}"
+        f" {'p90':>9} {'p99':>9} {'max':>9}"
+    )
+    for seg in sorted(segments):
+        rungs = segments[seg]
+        if not isinstance(rungs, dict):
+            continue
+        for rung in sorted(rungs):
+            s = rungs[rung] or {}
+            lines.append(
+                f"  {seg:<22} {rung:<9} {s.get('count', 0):>8}"
+                f" {_fmt_ms(s.get('p50_s')):>9}"
+                f" {_fmt_ms(s.get('p90_s')):>9}"
+                f" {_fmt_ms(s.get('p99_s')):>9}"
+                f" {_fmt_ms(s.get('max_s')):>9}"
             )
     return lines
 
@@ -460,12 +505,17 @@ def _json_summary(run: dict, top: int) -> dict:
                 f"p{p}": float(v)
                 for p, v in zip(_PCTS, np.percentile(vals, _PCTS))
             }
+    timing = run.get("timing")
     return {
         "source": run.get("source"),
         "n_frames": len(records),
         "manifest": run.get("manifest"),
-        "timing": run.get("timing"),
+        "timing": timing,
         "robustness": run.get("robustness"),
+        # the request-latency section, surfaced top-level with the
+        # SAME schema as the serve `metrics` verb (one schema,
+        # asserted in tests); None on pre-latency-plane artifacts
+        "latency": (timing or {}).get("latency"),
         "metrics": metrics,
         "worst_frames": [
             r.get("frame") for r in _worst_frames(records, top)

@@ -1,0 +1,178 @@
+"""`kcmc_tpu top`: a live terminal dashboard over one serve replica.
+
+Polls the `metrics` and `stats` verbs every refresh interval and
+renders a compact view — per-session frames/fps/queue depth, the
+supervisor state (strikes, rebuild, scheduler-wedge age), and the
+plane's per-segment latency p50/p99 — so an operator watching a
+replica sees queue pressure and tail latency move in real time
+without Prometheus in the loop. `--once` renders a single frame and
+exits (the CI smoke and scripting hook).
+
+Pure stdlib + the bundled ServeClient: no accelerator imports, no
+extra threads (the poll loop IS the program), safe to point at a
+production replica — both verbs are read-only.
+"""
+
+from __future__ import annotations
+
+import time
+
+# ANSI: clear screen + home. Plain writes otherwise — no curses, so
+# output also behaves piped into a file or CI log.
+_CLEAR = "\x1b[2J\x1b[H"
+
+# Render order for the segment table: the lifecycle ladder first, the
+# durability spans last. Anything else (future segments) sorts after.
+_SEGMENT_ORDER = (
+    "request.admission",
+    "request.queue_wait",
+    "request.batch_form",
+    "request.dispatch",
+    "request.device",
+    "request.drain",
+    "request.delivery",
+    "request.total",
+    "journal.save",
+    "journal.resume",
+)
+
+
+def parse_addr(addr: str, default_port: int = 7733) -> tuple[str, int]:
+    """'host:port' | 'host' | ':port' -> (host, port)."""
+    addr = (addr or "").strip()
+    if ":" in addr:
+        host, _, port = addr.rpartition(":")
+        return host or "127.0.0.1", int(port)
+    return addr or "127.0.0.1", default_port
+
+
+def _ms(v) -> str:
+    if v is None:
+        return "—"
+    return f"{float(v) * 1e3:.1f}ms"
+
+
+def _seg_rank(seg: str) -> tuple[int, str]:
+    try:
+        return (_SEGMENT_ORDER.index(seg), seg)
+    except ValueError:
+        return (len(_SEGMENT_ORDER), seg)
+
+
+def render(metrics: dict, stats: dict, addr: str) -> str:
+    """One dashboard frame (pure dict -> str; unit-testable)."""
+    lines: list[str] = []
+    g = metrics.get("gauges") or {}
+    c = metrics.get("counters") or {}
+    sup = stats.get("supervisor") or {}
+    lines.append(
+        f"kcmc_tpu top — {addr}   "
+        f"{time.strftime('%H:%M:%S')}   "
+        f"sessions={g.get('sessions_open', 0)} "
+        f"inflight={g.get('inflight_batches', 0)} "
+        f"queued={g.get('queued_frames', 0)} "
+        f"occupancy={g.get('batch_occupancy', 0.0)}"
+    )
+    wedge = float(sup.get("loop_beat_age_s", g.get("loop_beat_age_s", 0.0)))
+    sup_bits = [
+        f"frames_done={c.get('frames_done', 0)}",
+        f"strikes={sup.get('backend_strikes', g.get('backend_strikes', 0))}",
+        "rebuilding="
+        + ("yes" if sup.get("backend_rebuilding") else "no"),
+        f"rebuilds={sup.get('backend_rebuilds', 0)}",
+        f"wedge_age={wedge:.1f}s" + (" WEDGED" if wedge > 30.0 else ""),
+    ]
+    if c.get("rejected_frames"):
+        sup_bits.append(f"rejected={c['rejected_frames']}")
+    if c.get("degraded_batches"):
+        sup_bits.append(f"degraded_batches={c['degraded_batches']}")
+    lines.append("supervisor: " + " ".join(sup_bits))
+
+    totals = (metrics.get("plane") or {}).get("totals") or {}
+    lines.append("")
+    if totals:
+        lines.append(
+            f"  {'segment':<22} {'count':>8} {'p50':>10} {'p99':>10}"
+            f" {'max':>10}"
+        )
+        for seg in sorted(totals, key=_seg_rank):
+            s = totals[seg]
+            lines.append(
+                f"  {seg:<22} {s.get('count', 0):>8}"
+                f" {_ms(s.get('p50_s')):>10} {_ms(s.get('p99_s')):>10}"
+                f" {_ms(s.get('max_s')):>10}"
+            )
+    else:
+        lines.append(
+            "  (no request latency yet"
+            + (
+                ""
+                if metrics.get("latency_telemetry", True)
+                else " — latency_telemetry is OFF on this server"
+            )
+            + ")"
+        )
+
+    sessions = metrics.get("sessions") or {}
+    lines.append("")
+    lines.append(
+        f"  {'session':<12} {'tenant':<12} {'frames':>8} {'fps':>8}"
+        f" {'queued':>7} {'deg':>4} {'p50':>10} {'p99':>10}"
+    )
+    for sid in sorted(sessions):
+        s = sessions[sid]
+        tot = (s.get("totals") or {}).get("request.total") or {}
+        lines.append(
+            f"  {sid:<12} {str(s.get('tenant', '?')):<12}"
+            f" {s.get('frames', 0):>8} {s.get('fps', 0.0):>8.1f}"
+            f" {s.get('queued', 0):>7}"
+            f" {'yes' if s.get('degraded') else 'no':>4}"
+            f" {_ms(tot.get('p50_s')):>10} {_ms(tot.get('p99_s')):>10}"
+        )
+    if not sessions:
+        lines.append("  (no live sessions)")
+    return "\n".join(lines) + "\n"
+
+
+def main(args) -> int:
+    """`kcmc_tpu top` body (argparse args from __main__): poll
+    metrics+stats, render, repeat. `--once` prints one frame (exit 1
+    if the server is unreachable); the live loop keeps retrying a
+    flapping server and exits 0 on Ctrl-C."""
+    import sys
+
+    from kcmc_tpu.serve.client import ServeClient, ServeError
+
+    host, port = parse_addr(args.addr)
+    addr = f"{host}:{port}"
+    interval = max(float(args.interval), 0.2)
+    client = None
+    try:
+        while True:
+            try:
+                if client is None:
+                    client = ServeClient(host=host, port=port)
+                frame = render(client.metrics(), client.stats(), addr)
+            except (ServeError, OSError) as e:
+                if client is not None:
+                    client.close()
+                    client = None
+                if args.once:
+                    print(f"kcmc top: {addr} unreachable: {e}",
+                          file=sys.stderr)
+                    return 1
+                frame = (
+                    f"kcmc_tpu top — {addr}   (unreachable: {e}; "
+                    "retrying)\n"
+                )
+            if args.once:
+                print(frame, end="")
+                return 0
+            print(_CLEAR + frame, end="", flush=True)
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        print()
+        return 0
+    finally:
+        if client is not None:
+            client.close()

@@ -500,6 +500,13 @@ class ServeClient:
     def stats(self) -> dict:
         return self._call("stats")["stats"]
 
+    def metrics(self) -> dict:
+        """The request-latency telemetry payload (`metrics` verb):
+        per-segment latency summaries, mergeable histogram state,
+        counters and gauges — see docs/OBSERVABILITY.md "Request
+        latency". Idempotent read, replayed across reconnects."""
+        return self._call("metrics")["metrics"]
+
     def shutdown(self) -> dict:
         """Ask the server process to exit cleanly; returns final stats.
         Not replayed across reconnects — a lost reply after a

@@ -28,6 +28,12 @@ Ops (docs/SERVING.md has the full field tables):
   frames from ``cursor`` (docs/ROBUSTNESS.md "Serve-plane failures")
 * ``stats`` — scheduler gauges (sessions, queues, occupancy, admission,
   supervisor/resilience counters)
+* ``metrics`` — the request-latency telemetry plane
+  (docs/OBSERVABILITY.md "Request latency"): per-(segment, QoS rung)
+  latency summaries, full mergeable histogram state, and the serve
+  counters/gauges — the machine-readable health surface routers and
+  Prometheus scrapers poll (`kcmc_tpu metrics --text` renders it as
+  text exposition, `kcmc_tpu top` as a live dashboard)
 * ``ping`` / ``shutdown``
 """
 

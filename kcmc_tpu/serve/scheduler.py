@@ -42,6 +42,7 @@ from collections import deque
 
 import numpy as np
 
+from kcmc_tpu.obs.latency import SegmentLatencies
 from kcmc_tpu.obs.log import advise
 
 
@@ -146,6 +147,13 @@ class StreamScheduler:
         # the wedge watchdog read its age — a large age with pending
         # work means the loop is wedged, not idle.
         self._loop_beat = time.monotonic()
+        # Plane-wide request-latency rollup of CLOSED sessions
+        # (obs/latency.py): each session's histograms fold in exactly
+        # once at close (`_record_closed_locked`), so `metrics()`'s
+        # plane view = this accumulator merged with the live sessions
+        # — an EXACT merge, bit-identical to recording every sample
+        # into one histogram (the fleet-aggregation contract).
+        self._lat_closed = SegmentLatencies()
         self._stats = {
             "accepted_frames": 0,
             "rejected_submits": 0,
@@ -448,6 +456,7 @@ class StreamScheduler:
         # boundary template blend (device-frame-sized host compute must
         # not stall other tenants); _resume_lock + the restore guard
         # keep the gap safe
+        t_restore = time.perf_counter()
         try:
             sess.restore_from_journal(
                 meta, segments, arrays, journal=sess.journal
@@ -464,14 +473,20 @@ class StreamScheduler:
             with self._wake:
                 self._wake.notify_all()
             raise
+        restore_dur = time.perf_counter() - t_restore
         with self._wake:
             self._stats["sessions_resumed"] += 1
             self._wake.notify_all()
+        # resume cost is a DURATION span (trace) + latency segment
+        # (`metrics` verb) — rehydration is real work (array decode,
+        # boundary re-roll), not an instant
         if sess.telemetry is not None and sess.telemetry.tracer is not None:
-            sess.telemetry.tracer.instant(
-                "journal_resume", cat="journal",
+            sess.telemetry.tracer.complete(
+                "journal.resume", t_restore, restore_dur, cat="journal",
                 args={"done": int(meta["done"])},
             )
+        if sess.lat is not None:
+            sess.lat.observe("journal.resume", restore_dur)
         advise(
             f"kcmc serve: session {session_id} resumed from its "
             f"journal at frame {int(meta['done'])}",
@@ -493,6 +508,7 @@ class StreamScheduler:
         gap (lost frames) and is rejected so a stream can never
         silently skip. Without `first` (legacy callers) frames append
         unconditionally."""
+        t_call = time.perf_counter()  # request.total's anchor
         frames = np.asarray(frames)
         if frames.ndim == 2:
             frames = frames[None]
@@ -544,6 +560,17 @@ class StreamScheduler:
             # permanently degraded by load it never added.
             sess.add_frames(frames)
             self._stats["accepted_frames"] += n
+            if sess.lat is not None and n:
+                # Per-request lifecycle tracing (obs/latency.py): each
+                # admitted frame's clock starts at the submit call;
+                # admission covers the lock wait + decision, and the
+                # (t_call, t_admitted) stamps seed queue_wait/total.
+                t_adm = time.perf_counter()
+                sess._t_submit.extend([(t_call, t_adm)] * n)
+                sess.lat.observe(
+                    "request.admission", t_adm - t_call, n=n,
+                    rung="degraded" if sess.degraded else "full",
+                )
             # Dedup counts only once the trimmed remainder is ADMITTED:
             # a rejected/raising submit will be retried verbatim, and
             # counting its overlap on every attempt would inflate the
@@ -635,6 +662,13 @@ class StreamScheduler:
             self._closed_ids.discard(self._closed_order[0])
         self._closed_order.append(sess.sid)
         self._closed_ids.add(sess.sid)
+        # Fold the stream's latency histograms into the plane rollup
+        # exactly once — finalize has already closed its delivery
+        # segments, so nothing records into `sess.lat` after this and
+        # the plane view stays an exact merge.
+        if sess.lat is not None and not sess._lat_folded:
+            sess._lat_folded = True
+            self._lat_closed.merge_from(sess.lat)
         # Retention must not pin pixels: an emit session's final result
         # holds the whole corrected stack, so once a client has RECEIVED
         # it (delivered flag — an undelivered result stays whole for the
@@ -746,6 +780,107 @@ class StreamScheduler:
                 pass
         return out
 
+    def metrics(self) -> dict:
+        """The scrapeable request-latency/health payload behind the
+        `metrics` serve verb (docs/OBSERVABILITY.md "Request
+        latency"): plane-wide per-(segment, rung) latency summaries +
+        full mergeable histogram state, per-live-session summaries,
+        and the serve counters/gauges a router or Prometheus scraper
+        health-checks replicas on. The plane view is an EXACT merge of
+        the closed-session rollup and every live session — merging the
+        per-session histograms yourself reproduces it bit for bit
+        (the fleet-aggregation contract, pinned in tests)."""
+        per_session: dict = {}
+        plane = SegmentLatencies()
+        with self._lock:
+            sessions = list(self._sessions.values())
+            st = dict(self._stats)
+            inflight = len(self._window)
+            queues = {s.sid: s.backlog() for s in sessions}
+            degraded = {s.sid: s.degraded for s in sessions}
+            strikes = self._strikes
+            rebuilding = self._rebuilding
+            beat_age = time.monotonic() - self._loop_beat
+            # Merge INSIDE the plane lock: a session folding into
+            # _lat_closed (close happens under this lock) between the
+            # live-session snapshot and these merges would otherwise be
+            # counted twice, breaking the bit-exact merge contract a
+            # scrape relies on. The lock is reentrant, so s.snapshot()
+            # is fine here; merges are ~100 integer adds per source.
+            plane.merge_from(self._lat_closed)
+            for s in sessions:
+                snap = s.snapshot()
+                entry = {
+                    "tenant": s.tenant,
+                    "frames": snap.get("frames", 0),
+                    "fps": round(float(snap.get("fps", 0.0)), 2),
+                    "queued": queues.get(s.sid, 0),
+                    "degraded": bool(degraded.get(s.sid)),
+                }
+                if s.lat is not None:
+                    plane.merge_from(s.lat)
+                    rep = s.lat.report()
+                    entry["segments"] = rep["segments"]
+                    entry["totals"] = rep["totals"]
+                    entry["histograms"] = s.lat.hist_dicts()
+                per_session[s.sid] = entry
+        plane_rep = plane.report()
+        batches = max(st["batches"], 1)
+        return {
+            "schema": "kcmc_metrics/1",
+            "latency_telemetry": bool(self.mc.config.latency_telemetry),
+            "plane": {
+                "segments": plane_rep["segments"],
+                "totals": plane_rep["totals"],
+                "histograms": plane.hist_dicts(),
+            },
+            "sessions": per_session,
+            "counters": {
+                "frames_done": st["frames_done"],
+                "accepted_frames": st["accepted_frames"],
+                "rejected_submits": st["rejected_submits"],
+                "rejected_frames": st["rejected_frames"],
+                "deduped_frames": st["deduped_frames"],
+                "degrade_events": st["degrade_events"],
+                "degraded_batches": st["degraded_batches"],
+                "batches": st["batches"],
+                "backend_rebuilds": st["backend_rebuilds"],
+                "sessions_resumed": st["sessions_resumed"],
+                "sessions_reaped": st["sessions_reaped"],
+            },
+            "gauges": {
+                "sessions_open": len(sessions),
+                "inflight_batches": inflight,
+                "batch_size": self.B,
+                "batch_occupancy": round(
+                    st["occupied_frames"] / (batches * self.B), 4
+                ),
+                "queued_frames": sum(queues.values()),
+                "backend_strikes": strikes,
+                "backend_rebuilding": int(rebuilding),
+                "loop_beat_age_s": round(max(beat_age, 0.0), 3),
+                "queues": queues,
+            },
+        }
+
+    def _latency_beat(self) -> dict | None:
+        """End-to-end p50/p99 for the heartbeat line: the plane's
+        `request.total` across closed + live sessions (exact merge;
+        ~100 integer adds per source — beat-cheap)."""
+        with self._lock:
+            # under the plane lock for the same close-fold consistency
+            # as metrics() — a folding session must never count twice
+            h = self._lat_closed.segment_total("request.total")
+            for s in self._sessions.values():
+                if s.lat is not None:
+                    h.merge(s.lat.segment_total("request.total"))
+        if not h.count:
+            return None
+        return {
+            "p50_ms": round((h.quantile(50) or 0.0) * 1e3, 1),
+            "p99_ms": round((h.quantile(99) or 0.0) * 1e3, 1),
+        }
+
     def snapshot(self) -> dict:
         """Aggregate-heartbeat snapshot (obs.heartbeat.aggregate_sampler)."""
         with self._lock:
@@ -785,6 +920,9 @@ class StreamScheduler:
             "extra": extra,
             "loop_beat_age_s": round(max(beat_age, 0.0), 3),
         }
+        lat = self._latency_beat()
+        if lat is not None:
+            out["latency"] = lat
         if any(rb_total.values()):
             out["robustness"] = rb_total
         if self.session_timeout_s > 0:
@@ -979,7 +1117,7 @@ class StreamScheduler:
         with self._wake:
             picked = self._pick_locked() if self._running else None
         if picked is not None:
-            sess, (n, batch, idx, ref), degraded = picked
+            sess, (n, batch, idx, ref, clock), degraded = picked
             backend = self.mc.backend
             if degraded:
                 try:
@@ -987,7 +1125,7 @@ class StreamScheduler:
                 except Exception:
                     pass  # prewarm already advised; full budgets
             entry = self._dispatch(
-                sess, backend, n, batch, idx, ref, degraded
+                sess, backend, n, batch, idx, ref, degraded, clock
             )
             if entry is not None:
                 with self._lock:
@@ -1153,12 +1291,15 @@ class StreamScheduler:
             if done:
                 self._rebuild_order()
 
-    def _dispatch(self, sess, backend, n, batch, idx, ref, degraded):
+    def _dispatch(
+        self, sess, backend, n, batch, idx, ref, degraded, clock=None
+    ):
         """Dispatch one session batch; on a dispatch-time error, flush
         the window first (ordering + the ladder's synthesis template),
         then walk the session's degradation ladder. Returns a window
         entry, or None when the error path already accounted the
-        batch."""
+        batch. `clock` is the batch's RequestClock (take_batch) — the
+        dispatch segment closes here, device/drain close at drain."""
         if (
             not getattr(backend, "accepts_native_dtype", False)
             and batch.dtype != np.float32
@@ -1210,11 +1351,20 @@ class StreamScheduler:
         except Exception as e:
             while self._window:
                 self._drain_one()
-            self._ladder(sess, e, backend, batch, ref, idx, n, kept, step)
+            self._ladder(
+                sess, e, backend, batch, ref, idx, n, kept, step, clock
+            )
             return None
+        if clock is not None and sess.lat is not None:
+            clock.rung = "degraded" if degraded else "full"
+            clock.t_dispatched = time.perf_counter()
+            sess.lat.observe(
+                "request.dispatch", clock.t_dispatched - clock.t_formed,
+                n=n, rung=clock.rung,
+            )
         if warm and "transform" in out:
             sess.warm_seed = out["transform"][n - 1]
-        return (sess, n, out, kept, batch, idx, ref, backend)
+        return (sess, n, out, kept, batch, idx, ref, backend, clock)
 
     def _drain_one(self) -> None:
         """Drain the oldest in-flight entry: materialize to host (where
@@ -1223,7 +1373,7 @@ class StreamScheduler:
         with self._lock:
             if not self._window:
                 return
-            sess, n, out, kept, batch, idx, ref, backend = (
+            sess, n, out, kept, batch, idx, ref, backend, clock = (
                 self._window.popleft()
             )
         try:
@@ -1239,16 +1389,21 @@ class StreamScheduler:
             }
             sess.mc._note_out_template(host)
         except Exception as e:
-            self._ladder(sess, e, backend, batch, ref, idx, n, kept)
+            self._ladder(sess, e, backend, batch, ref, idx, n, kept,
+                         clock=clock)
             return
+        if clock is not None:
+            # device-execution segment ends when host arrays exist
+            clock.t_host = time.perf_counter()
         if backend is self.mc.backend:
             with self._lock:
                 # a clean primary drain resets the supervisor's strikes
                 self._strikes = 0
-        self._account_done(sess, n, host, kept, ref)
+        self._account_done(sess, n, host, kept, ref, clock)
 
     def _ladder(
-        self, sess, exc, backend, batch, ref, idx, n, kept, step=None
+        self, sess, exc, backend, batch, ref, idx, n, kept, step=None,
+        clock=None,
     ) -> None:
         """Walk the session's degradation ladder for a failed batch and
         feed the backend supervisor. Transient errors walk the PR-2
@@ -1315,7 +1470,11 @@ class StreamScheduler:
             if sess.wants_pixels() or k != "corrected"
         }
         kept = sess.mc._failed_kept(host, kept, failed)
-        self._account_done(sess, n, host, kept, ref)
+        if clock is not None:
+            # laddered batches close their device segment here — the
+            # retry/failover walk is honest device-side time
+            clock.t_host = time.perf_counter()
+        self._account_done(sess, n, host, kept, ref, clock)
 
     # -- backend supervision (quarantine + off-path rebuild) ----------------
 
@@ -1447,9 +1606,9 @@ class StreamScheduler:
             stacklevel=2,
         )
 
-    def _account_done(self, sess, n, host, kept, ref) -> None:
+    def _account_done(self, sess, n, host, kept, ref, clock=None) -> None:
         try:
-            sess.on_drained(n, host, kept, ref)
+            sess.on_drained(n, host, kept, ref, clock=clock)
         except BaseException as e:
             sess.fail(e)
         finally:

@@ -121,6 +121,12 @@ class ServeServer:
             return {"ok": True}
         if op == "stats":
             return {"ok": True, "stats": self.scheduler.stats()}
+        if op == "metrics":
+            # machine-readable health/latency surface (line-JSON like
+            # stats): per-segment latency summaries + mergeable
+            # histograms + counters/gauges — what `kcmc_tpu metrics`
+            # scrapes and `kcmc_tpu top` polls (docs/OBSERVABILITY.md)
+            return {"ok": True, "metrics": self.scheduler.metrics()}
         if op == "open_session":
             ref = msg.get("reference")
             sess = self.scheduler.open_session(

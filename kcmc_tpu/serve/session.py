@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 import numpy as np
 
@@ -175,6 +176,25 @@ class Session:
         # heartbeat/stats readers (the report object itself is only
         # touched by the scheduler thread mid-run).
         self._rb: dict = {}
+
+        # Request-latency telemetry (obs/latency.py; docs/
+        # OBSERVABILITY.md "Request latency"): per-(segment, QoS rung)
+        # mergeable histograms this stream's lifecycle seams record
+        # into. `_t_submit` carries (t_call, t_admitted) perf_counter
+        # stamps per pending frame (aligned with `pending`);
+        # `_t_done` carries (t_call, t_accounted) per drained,
+        # not-yet-fetched frame so `fetch`/finalize can close the
+        # delivery and end-to-end segments. The scheduler folds `lat`
+        # into the plane-wide rollup exactly once, at close
+        # (`_lat_folded`).
+        self.lat = None
+        self._lat_folded = False
+        if cfg.latency_telemetry:
+            from kcmc_tpu.obs.latency import SegmentLatencies
+
+            self.lat = SegmentLatencies()
+        self._t_submit: deque = deque()
+        self._t_done: deque = deque()
 
         # Per-session telemetry (trace + frame records) through the
         # run-id machinery: concurrent sessions configured with the same
@@ -385,12 +405,20 @@ class Session:
             meta["tail_lens"] = [int(len(t["corrected"])) for t in tail]
         else:
             meta["tail_lens"] = []
-        if j.save(meta, new_outs, arrays):
+        t0 = time.perf_counter()
+        saved = j.save(meta, new_outs, arrays)
+        dur = time.perf_counter() - t0
+        if saved:
+            # durability cost is a first-class span: a DURATION on the
+            # trace (where the old instant hid the write time) and a
+            # latency segment in the `metrics` verb
             if self.telemetry is not None and self.telemetry.tracer is not None:
-                self.telemetry.tracer.instant(
-                    "journal_save", cat="journal",
+                self.telemetry.tracer.complete(
+                    "journal.save", t0, dur, cat="journal",
                     args={"done": int(meta["done"])},
                 )
+            if self.lat is not None:
+                self.lat.observe("journal.save", dur)
             with self._cond:
                 self._outs_journaled = outs_high
                 self._rb = self._rb_snapshot()
@@ -523,19 +551,48 @@ class Session:
 
     def take_batch(self, B: int):
         """Pop up to min(ready, B) frames as a padded dispatch batch:
-        (n_valid, frames (B, ...), global indices (B,), ref). Indices
-        are the session's own frame numbers — the RANSAC keys fold them
-        in, so stream results match a one-shot run of the same frames
-        regardless of how submits were sliced into batches."""
+        (n_valid, frames (B, ...), global indices (B,), ref, clock).
+        Indices are the session's own frame numbers — the RANSAC keys
+        fold them in, so stream results match a one-shot run of the
+        same frames regardless of how submits were sliced into
+        batches. `clock` (a RequestClock, None with latency telemetry
+        off) carries each frame's submit stamp forward; the
+        queue-wait and batch-formation segments are recorded here."""
         n = min(self.ready_count(), B)
         if n <= 0:
             return None
+        t_take = time.perf_counter()
         frames = np.stack(self.pending[:n])
         del self.pending[:n]
         idx = np.arange(self.dispatched, self.dispatched + n)
         self.dispatched += n
         self.inflight += 1
-        return self.mc._pad_batch(frames, idx, B) + (self.ref,)
+        clock = None
+        if self.lat is not None:
+            from kcmc_tpu.obs.latency import RequestClock
+
+            rung = "degraded" if self.degraded else "full"
+            stamps = [
+                self._t_submit.popleft()
+                if self._t_submit
+                # defensive alignment for frames enqueued outside the
+                # scheduler's submit path (no stamp = zero queue wait)
+                else (t_take, t_take)
+                for _ in range(n)
+            ]
+            for _, t_adm in stamps:
+                self.lat.observe(
+                    "request.queue_wait", t_take - t_adm, rung=rung
+                )
+            padded = self.mc._pad_batch(frames, idx, B)
+            t_formed = time.perf_counter()
+            self.lat.observe(
+                "request.batch_form", t_formed - t_take, n=n, rung=rung
+            )
+            clock = RequestClock([t0 for t0, _ in stamps], t_formed)
+            clock.rung = rung
+            return padded + (self.ref, clock)
+        return self.mc._pad_batch(frames, idx, B) + (self.ref, clock)
 
     def wants_pixels(self) -> bool:
         """Whether drains need the corrected frames materialized: the
@@ -545,11 +602,15 @@ class Session:
 
     # -- drain side (scheduler thread; takes the lock itself) -------------
 
-    def on_drained(self, n: int, host: dict, kept, ref_used: dict) -> None:
+    def on_drained(
+        self, n: int, host: dict, kept, ref_used: dict, clock=None
+    ) -> None:
         """Account one drained batch (host arrays already sliced [:n]).
         Mirrors the one-shot drain: exact-warp rescue of flagged frames
         (when their input pixels were kept), QC NaN-ing otherwise,
-        rolling-template tail collection, writer append, telemetry."""
+        rolling-template tail collection, writer append, telemetry.
+        `clock` (the batch's RequestClock) closes the device/drain
+        latency segments and stages per-frame stamps for delivery."""
         with self._cond:
             # error can be set off-thread (a client thread's failed
             # journal restore, a ladder fail) — read it under the lock
@@ -617,6 +678,22 @@ class Session:
             self._outs.append(host)
             if self.telemetry is not None:
                 self.telemetry.note_batch(self.done, n, host)
+            if clock is not None and self.lat is not None:
+                t_acct = time.perf_counter()
+                t_host = clock.t_host if clock.t_host is not None else t_acct
+                t_disp = (
+                    clock.t_dispatched
+                    if clock.t_dispatched is not None
+                    else clock.t_formed
+                )
+                self.lat.observe(
+                    "request.device", t_host - t_disp, n=n, rung=clock.rung
+                )
+                self.lat.observe(
+                    "request.drain", t_acct - t_host, n=n, rung=clock.rung
+                )
+                for t0f in clock.t_submit[:n]:
+                    self._t_done.append((t0f, t_acct))
             self.done += n
             # plane-locked robustness snapshot for the heartbeat/stats
             # readers (the report object is scheduler-thread-only)
@@ -683,6 +760,7 @@ class Session:
                 self.error = exc
             self.closing = True
             self.pending.clear()
+            self._t_submit.clear()  # stays aligned with `pending`
             self._cond.notify_all()
 
     def finalize(self) -> None:
@@ -695,6 +773,23 @@ class Session:
             if self._finalizing or self.closed:
                 return
             self._finalizing = True
+            if self.lat is not None and self._t_done:
+                # frames never fetched incrementally (close-only
+                # clients): their delivery segment closes at finalize —
+                # the moment the final result becomes available. Keep
+                # the session's QoS rung, like the fetch path — a
+                # degraded stream's tail must not land in the healthy
+                # series.
+                t_now = time.perf_counter()
+                rung = "degraded" if self.degraded else "full"
+                while self._t_done:
+                    t0f, t_acct = self._t_done.popleft()
+                    self.lat.observe(
+                        "request.delivery", t_now - t_acct, rung=rung
+                    )
+                    self.lat.observe(
+                        "request.total", t_now - t0f, rung=rung
+                    )
             # Shallow-copy each batch dict: the merge below runs
             # OUTSIDE the lock, and a concurrent fetch() pops delivered
             # pixels from the shared dicts mid-merge otherwise. The
@@ -722,6 +817,12 @@ class Session:
             "frames_per_sec": done / elapsed if elapsed else None,
             "elapsed_s": elapsed,
         }
+        if self.lat is not None and self.lat.count:
+            # the stream's own latency section — same schema as the
+            # `metrics` verb (docs/OBSERVABILITY.md "Request latency"),
+            # carried through the close_session payload and the
+            # frame-records run summary
+            timing["latency"] = self.lat.report()
         merged = merge_outputs(outs)
         corrected = merged.pop("corrected", None)
         transforms = merged.pop("transform", None)
@@ -807,6 +908,19 @@ class Session:
             self._outs_delivered = len(self._outs)
             n = sum(len(next(iter(o.values()))) for o in new if o)
             self._frames_delivered += n
+            if self.lat is not None and self._t_done:
+                # close the delivery + end-to-end segments for every
+                # frame this fetch hands over
+                t_now = time.perf_counter()
+                rung = "degraded" if self.degraded else "full"
+                for _ in range(min(n, len(self._t_done))):
+                    t0f, t_acct = self._t_done.popleft()
+                    self.lat.observe(
+                        "request.delivery", t_now - t_acct, rung=rung
+                    )
+                    self.lat.observe(
+                        "request.total", t_now - t0f, rung=rung
+                    )
             merged = merge_outputs(new)
             # Release delivered pixels — frames dominate memory; the
             # final merge stays key-uniform because fetch always

@@ -1,0 +1,280 @@
+"""Unit suite for the request-latency histogram layer (obs/latency.py).
+
+Pins the properties the telemetry plane is built on:
+
+* deterministic bucket edges (a pure function of the index — the
+  cross-process merge contract);
+* EXACT mergeability: associative, commutative, split-independent
+  (bit-identical dicts), round-trippable through JSON;
+* quantile accuracy: estimates within the documented ~9% relative
+  bound of exact percentiles on known distributions;
+* concurrent-record safety through `SegmentLatencies` (run under
+  `--sanitize` in CI);
+* the shared summary schema and the Prometheus text exposition.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from kcmc_tpu.obs.latency import (
+    _EDGES_NS,
+    PER_OCTAVE,
+    T0_NS,
+    LatencyHistogram,
+    SegmentLatencies,
+    merge_histograms,
+    render_prometheus,
+)
+
+SUMMARY_KEYS = {"count", "sum_s", "p50_s", "p90_s", "p99_s", "max_s"}
+
+
+# -- bucket-edge determinism -------------------------------------------------
+
+
+def test_edges_are_deterministic_integer_geometric_ladder():
+    # recomputing the ladder from the scheme constants reproduces it
+    # exactly — the property that makes cross-process merges line up
+    recomputed = tuple(
+        round(T0_NS * 2.0 ** (i / PER_OCTAVE)) for i in range(len(_EDGES_NS))
+    )
+    assert recomputed == _EDGES_NS
+    assert all(isinstance(e, int) for e in _EDGES_NS)
+    assert all(b > a for a, b in zip(_EDGES_NS, _EDGES_NS[1:]))
+    assert _EDGES_NS[0] == T0_NS
+    assert _EDGES_NS[PER_OCTAVE] == 2 * T0_NS  # one octave doubles
+
+
+def test_record_is_order_independent_bit_identical():
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(-7, 2.0, 500)
+    h1, h2 = LatencyHistogram(), LatencyHistogram()
+    for v in vals:
+        h1.record(v)
+    for v in reversed(vals):
+        h2.record(v)
+    assert h1.to_dict() == h2.to_dict()
+
+
+def test_to_from_dict_round_trip_and_scheme_guard():
+    h = LatencyHistogram()
+    for v in (1e-7, 3e-4, 0.5, 2.0, 500.0):  # incl. under/overflow
+        h.record(v)
+    d = h.to_dict()
+    assert LatencyHistogram.from_dict(json.loads(json.dumps(d))).to_dict() == d
+    bad = dict(d, scheme={"t0_ns": 1, "per_octave": 1, "octaves": 1})
+    with pytest.raises(ValueError, match="scheme"):
+        LatencyHistogram.from_dict(bad)
+
+
+# -- exact mergeability ------------------------------------------------------
+
+
+def _hist_of(vals) -> LatencyHistogram:
+    h = LatencyHistogram()
+    for v in vals:
+        h.record(float(v))
+    return h
+
+
+def test_merge_equals_single_stream_bit_identical():
+    rng = np.random.default_rng(0)
+    vals = rng.lognormal(-6, 1.5, 3000)
+    merged = merge_histograms(
+        _hist_of(vals[:1000]), _hist_of(vals[1000:1700]),
+        _hist_of(vals[1700:]),
+    )
+    assert merged.to_dict() == _hist_of(vals).to_dict()
+
+
+def test_merge_associative_and_commutative():
+    rng = np.random.default_rng(1)
+    parts = [
+        _hist_of(rng.lognormal(-6 + i, 1.0, 200)) for i in range(3)
+    ]
+    a, b, c = parts
+    ab_c = merge_histograms(merge_histograms(a, b), c).to_dict()
+    a_bc = merge_histograms(a, merge_histograms(b, c)).to_dict()
+    cba = merge_histograms(c, b, a).to_dict()
+    assert ab_c == a_bc == cba
+
+
+def test_merge_with_empty_is_identity():
+    h = _hist_of([0.01, 0.02])
+    assert merge_histograms(h, LatencyHistogram()).to_dict() == h.to_dict()
+    assert merge_histograms(LatencyHistogram()).count == 0
+
+
+# -- quantile accuracy -------------------------------------------------------
+
+# documented bound: geometric-midpoint estimate within 2^(1/8)-1 of the
+# exact value (plus a hair for edge rounding)
+REL_BOUND = 2 ** (1 / 8) - 1 + 0.01
+
+
+@pytest.mark.parametrize(
+    "dist",
+    [
+        lambda rng: rng.lognormal(-6, 1.5, 5000),
+        lambda rng: rng.uniform(1e-4, 5e-2, 5000),
+        lambda rng: rng.exponential(3e-3, 5000),
+    ],
+)
+def test_quantile_accuracy_bound_vs_exact(dist):
+    rng = np.random.default_rng(42)
+    vals = dist(rng)
+    h = _hist_of(vals)
+    for q in (50, 90, 99):
+        exact = float(np.percentile(vals, q))
+        est = h.quantile(q)
+        assert est is not None
+        assert abs(est - exact) / exact <= REL_BOUND, (q, est, exact)
+
+
+def test_quantile_edge_cases():
+    assert LatencyHistogram().quantile(50) is None
+    h = _hist_of([0.25])  # single sample: every quantile is ~it
+    for q in (1, 50, 99):
+        est = h.quantile(q)
+        assert abs(est - 0.25) / 0.25 <= REL_BOUND
+    # estimates are clamped to the observed max (p99 can never exceed
+    # the largest recorded value)
+    h2 = _hist_of([1e-3] * 99 + [7.0])
+    assert h2.quantile(100) <= 7.0 + 1e-9
+    # negative/zero durations clamp into the first bucket, not a crash
+    h3 = LatencyHistogram()
+    h3.record(-1.0)
+    h3.record(0.0)
+    assert h3.count == 2 and h3.sum_ns == 0
+
+
+def test_summary_schema_is_the_shared_one():
+    s = _hist_of([1e-3, 2e-3, 3e-3]).summary()
+    assert set(s) == SUMMARY_KEYS
+    assert s["count"] == 3
+    assert s["max_s"] == pytest.approx(3e-3, rel=1e-6)
+    empty = LatencyHistogram().summary()
+    assert set(empty) == SUMMARY_KEYS
+    assert empty["p99_s"] is None and empty["count"] == 0
+
+
+# -- SegmentLatencies (concurrent recorder) ----------------------------------
+
+
+def test_concurrent_observe_loses_nothing():
+    """8 threads × 2000 records through the one recorder lock: total
+    counts and integer sums must be exact (runs under --sanitize in
+    the CI observability lane — the lock is the contract)."""
+    lat = SegmentLatencies()
+    N, T = 2000, 8
+
+    def worker(i):
+        for k in range(N):
+            lat.observe(
+                "request.total", 1e-4 * ((i + k) % 13 + 1),
+                rung="full" if i % 2 else "degraded",
+            )
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert lat.count == N * T
+    rep = lat.report()
+    rungs = rep["segments"]["request.total"]
+    assert rungs["full"]["count"] + rungs["degraded"]["count"] == N * T
+    assert rep["totals"]["request.total"]["count"] == N * T
+
+
+def test_merge_from_and_segment_total_are_exact():
+    a, b = SegmentLatencies(), SegmentLatencies()
+    a.observe("request.device", 0.01, n=3)
+    a.observe("request.device", 0.02, rung="degraded")
+    b.observe("request.device", 0.04)
+    b.observe("request.total", 0.05)
+    plane = SegmentLatencies()
+    plane.merge_from(a)
+    plane.merge_from(b)
+    assert plane.hist_dicts()["request.device"]["full"]["count"] == 4
+    tot = plane.segment_total("request.device")
+    assert tot.count == 5  # both rungs merged
+    # bit-identity vs recording everything into one recorder
+    one = SegmentLatencies()
+    one.observe("request.device", 0.01, n=3)
+    one.observe("request.device", 0.02, rung="degraded")
+    one.observe("request.device", 0.04)
+    one.observe("request.total", 0.05)
+    assert plane.hist_dicts() == one.hist_dicts()
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+
+def _fake_metrics():
+    lat = SegmentLatencies()
+    for v in (1e-4, 5e-4, 2e-3, 2e-3, 0.5):
+        lat.observe("request.total", v)
+    lat.observe("request.queue_wait", 3e-4, n=2, rung="degraded")
+    return {
+        "plane": {"histograms": lat.hist_dicts()},
+        "counters": {"frames_done": 42, "rejected_frames": 0},
+        "gauges": {
+            "sessions_open": 2,
+            "loop_beat_age_s": 0.25,
+            "queues": {"s0001": 3, 'we"ird': 1},
+        },
+    }
+
+
+def test_render_prometheus_format_and_cumulative_buckets():
+    text = render_prometheus(_fake_metrics())
+    lines = text.strip().splitlines()
+    assert text.endswith("\n")
+    # exposition shape: TYPE lines precede their series
+    assert "# TYPE kcmc_request_latency_seconds histogram" in lines
+    assert "# TYPE kcmc_serve_frames_done_total counter" in lines
+    assert "kcmc_serve_frames_done_total 42" in lines
+    assert "kcmc_serve_sessions_open 2" in lines
+    assert 'kcmc_serve_queue_frames{session="s0001"} 3' in lines
+    assert 'session="we\\"ird"' in text  # label escaping
+    # cumulative bucket counts are monotone and +Inf == count per series
+    series: dict[str, list[tuple[float | None, int]]] = {}
+    for ln in lines:
+        if ln.startswith("kcmc_request_latency_seconds_bucket"):
+            labels = ln[ln.index("{") + 1 : ln.index("}")]
+            le = [
+                kv.split("=")[1].strip('"')
+                for kv in labels.split(",")
+                if kv.startswith("le=")
+            ][0]
+            key = ",".join(
+                kv for kv in labels.split(",") if not kv.startswith("le=")
+            )
+            series.setdefault(key, []).append(
+                (None if le == "+Inf" else float(le), int(ln.split()[-1]))
+            )
+    assert series, "no bucket series rendered"
+    for key, buckets in series.items():
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), (key, buckets)
+        assert buckets[-1][0] is None, f"{key} missing +Inf"
+        count_line = [
+            ln
+            for ln in lines
+            if ln.startswith(f"kcmc_request_latency_seconds_count{{{key}}}")
+        ]
+        assert count_line and int(count_line[0].split()[-1]) == counts[-1]
+
+
+def test_render_prometheus_empty_payload():
+    text = render_prometheus({})
+    assert text == "\n"
+    # and a payload with only counters still renders
+    text = render_prometheus({"counters": {"frames_done": 0}})
+    assert "kcmc_serve_frames_done_total 0" in text

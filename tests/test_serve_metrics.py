@@ -1,0 +1,395 @@
+"""Integration suite for the request-latency telemetry plane.
+
+Serve-side (docs/OBSERVABILITY.md "Request latency"):
+
+* two concurrent streams → the `metrics` verb reports per-segment
+  p50/p99 for EVERY lifecycle segment, over a real socket;
+* per-frame segment durations telescope: the segment sums equal the
+  end-to-end sum (≈ wall time per request);
+* merging the per-session histograms reproduces the plane-wide
+  rollup bit for bit (the fleet-aggregation contract);
+* the latency section schema is ONE schema across the `metrics`
+  verb, `close_session` timing, and `kcmc_tpu report --json`;
+* `kcmc_tpu top --once` renders a live server; `kcmc_tpu metrics
+  --text` renders exposition from a live server and a dumped
+  snapshot;
+* journal.save / journal.resume are DURATION spans (tracer) and
+  latency segments;
+* one-shot `correct` records the shared dispatch/device/drain subset;
+* `latency_telemetry=False` disables every record site.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.obs.latency import LatencyHistogram, merge_histograms
+from kcmc_tpu.serve.scheduler import StreamScheduler
+from kcmc_tpu.utils.synthetic import make_drift_stack
+
+MC_KW = dict(
+    model="translation", backend="numpy", batch_size=8,
+    max_keypoints=64, n_hypotheses=32,
+)
+
+SUMMARY_KEYS = {"count", "sum_s", "p50_s", "p90_s", "p99_s", "max_s"}
+LIFECYCLE_SEGMENTS = {
+    "request.admission", "request.queue_wait", "request.batch_form",
+    "request.dispatch", "request.device", "request.drain",
+    "request.delivery", "request.total",
+}
+
+
+def _stack(n=16, seed=0, shape=(48, 48)):
+    d = make_drift_stack(
+        n_frames=n, shape=shape, model="translation", max_drift=3.0,
+        seed=seed,
+    )
+    return d.stack.astype(np.float32)
+
+
+def _drain_fully(sess, total):
+    seen = 0
+    while seen < total:
+        got = sess.fetch(timeout=60)
+        assert got is not None
+        seen += got["n"]
+    return seen
+
+
+def _wait_idle(sched, total, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = sched.stats()
+        if (
+            st["frames_done"] >= total
+            and st["inflight_batches"] == 0
+            and not any(st["queues"].values())
+        ):
+            return st
+        time.sleep(0.02)
+    raise AssertionError("scheduler never went idle")
+
+
+# -- two concurrent streams over the real socket -----------------------------
+
+
+def test_metrics_verb_reports_every_segment_two_streams():
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    mc = MotionCorrector(**MC_KW)
+    with ServeServer(mc, port=0) as srv:
+        def drive(i):
+            with ServeClient(port=srv.port) as c:
+                sid = c.open_session(tenant=f"t{i}")
+                stack = _stack(12, seed=i)
+                for lo in range(0, 12, 5):
+                    c.submit(sid, stack[lo : lo + 5])
+                seen = 0
+                while seen < 12:
+                    span = c.results(sid, timeout=60.0)
+                    assert span is not None
+                    seen += span["n"]
+                c.close_session(sid)
+
+        ts = [threading.Thread(target=drive, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        with ServeClient(port=srv.port) as c:
+            m = c.metrics()
+    assert m["schema"] == "kcmc_metrics/1"
+    assert m["latency_telemetry"] is True
+    segs = m["plane"]["segments"]
+    assert LIFECYCLE_SEGMENTS <= set(segs), sorted(segs)
+    for seg in LIFECYCLE_SEGMENTS:
+        for rung, s in segs[seg].items():
+            assert set(s) == SUMMARY_KEYS, (seg, rung)
+            assert s["count"] > 0 and s["p50_s"] is not None
+            assert s["p99_s"] >= s["p50_s"] - 1e-9
+    # both streams' frames flowed through the plane rollup
+    assert m["plane"]["totals"]["request.total"]["count"] == 24
+    assert m["counters"]["frames_done"] == 24
+
+
+def test_segment_sums_telescope_to_end_to_end_and_wall_time():
+    mc = MotionCorrector(**MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        t0 = time.perf_counter()
+        s = sched.open_session(tenant="w")
+        sched.submit(s.sid, _stack(16))
+        _drain_fully(s, 16)
+        res = sched.close_session(s.sid, timeout=120)
+        wall = time.perf_counter() - t0
+    finally:
+        sched.stop()
+    totals = res.timing["latency"]["totals"]
+    parts = sum(
+        totals[seg]["sum_s"]
+        for seg in LIFECYCLE_SEGMENTS
+        if seg != "request.total"
+    )
+    e2e = totals["request.total"]["sum_s"]
+    # per-frame segments tile [submit call, fetch] exactly — the sums
+    # agree to histogram ns truncation + summary rounding
+    assert parts == pytest.approx(e2e, rel=0.02, abs=1e-3), (parts, e2e)
+    # and no request outlives the run
+    assert totals["request.total"]["max_s"] <= wall + 0.05
+    assert totals["request.total"]["count"] == 16
+
+
+def test_cross_session_merge_is_bit_identical_to_plane_rollup():
+    mc = MotionCorrector(**MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        a = sched.open_session(tenant="A")
+        b = sched.open_session(tenant="B")
+        sched.submit(a.sid, _stack(8, seed=0))
+        sched.submit(b.sid, _stack(8, seed=1))
+        _drain_fully(a, 8)
+        _drain_fully(b, 8)
+        _wait_idle(sched, 16)
+        m = sched.metrics()
+        # quiesced: merging the two sessions' exported histograms must
+        # reproduce the plane rollup EXACTLY (the fleet aggregator's
+        # contract — integer state, no float drift)
+        sessions = m["sessions"]
+        assert set(sessions) == {a.sid, b.sid}
+        merged: dict = {}
+        for sid in sorted(sessions):
+            for seg, rungs in sessions[sid]["histograms"].items():
+                for rung, d in rungs.items():
+                    h = LatencyHistogram.from_dict(d)
+                    key = (seg, rung)
+                    merged[key] = (
+                        h if key not in merged
+                        else merge_histograms(merged[key], h)
+                    )
+        plane = m["plane"]["histograms"]
+        rebuilt = {}
+        for (seg, rung), h in merged.items():
+            rebuilt.setdefault(seg, {})[rung] = h.to_dict()
+        assert rebuilt == plane
+        # closing folds the sessions into the rollup without changing it
+        ra = sched.close_session(a.sid, timeout=120)
+        sched.close_session(b.sid, timeout=120)
+        m2 = sched.metrics()
+        assert m2["plane"]["histograms"] == plane
+        # one schema: the close timing's latency section carries the
+        # same summary keys as the metrics verb
+        for seg, rungs in ra.timing["latency"]["segments"].items():
+            for rung, s in rungs.items():
+                assert set(s) == SUMMARY_KEYS, (seg, rung)
+    finally:
+        sched.stop()
+
+
+def test_heartbeat_snapshot_carries_latency_pulse():
+    from kcmc_tpu.obs.heartbeat import aggregate_sampler
+
+    mc = MotionCorrector(**MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="hb")
+        sched.submit(s.sid, _stack(8))
+        _drain_fully(s, 8)
+        snap = sched.snapshot()
+        assert "latency" in snap
+        assert snap["latency"]["p99_ms"] >= snap["latency"]["p50_ms"]
+        line = aggregate_sampler(sched.snapshot)()
+        assert "latency p50=" in line and "p99=" in line
+        sched.close_session(s.sid, timeout=120)
+    finally:
+        sched.stop()
+
+
+# -- CLI surfaces ------------------------------------------------------------
+
+
+def test_top_once_and_metrics_cli_live_and_snapshot(tmp_path, capsys):
+    from kcmc_tpu.__main__ import main as cli_main
+    from kcmc_tpu.obs.top import main as top_main
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    mc = MotionCorrector(**MC_KW)
+    with ServeServer(mc, port=0) as srv:
+        with ServeClient(port=srv.port) as c:
+            sid = c.open_session(tenant="cli")
+            c.submit(sid, _stack(8))
+            seen = 0
+            while seen < 8:
+                span = c.results(sid, timeout=60.0)
+                assert span is not None
+                seen += span["n"]
+            snap_path = tmp_path / "metrics.json"
+            snap_path.write_text(json.dumps(c.metrics()))
+        addr = f"127.0.0.1:{srv.port}"
+        # live one-shot dashboard render
+        rc = top_main(
+            argparse.Namespace(addr=addr, interval=2.0, once=True)
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "kcmc_tpu top" in out
+        assert "request.total" in out
+        assert "cli" in out  # the session row
+        # live scrape, text exposition
+        assert cli_main(["metrics", addr, "--text"]) == 0
+        text = capsys.readouterr().out
+        assert "kcmc_request_latency_seconds_bucket" in text
+        assert "kcmc_serve_frames_done_total" in text
+    # snapshot re-render (no server needed)
+    assert cli_main(["metrics", str(snap_path), "--text"]) == 0
+    text = capsys.readouterr().out
+    assert "kcmc_request_latency_seconds_count" in text
+    # JSON passthrough keeps the schema
+    assert cli_main(["metrics", str(snap_path)]) == 0
+    m = json.loads(capsys.readouterr().out)
+    assert m["schema"] == "kcmc_metrics/1"
+
+
+def test_top_once_unreachable_exits_nonzero(capsys):
+    from kcmc_tpu.obs.top import main as top_main
+
+    rc = top_main(
+        argparse.Namespace(addr="127.0.0.1:1", interval=2.0, once=True)
+    )
+    assert rc == 1
+
+
+# -- journal durability spans ------------------------------------------------
+
+
+def test_journal_save_and_resume_are_duration_spans(tmp_path):
+    trace = tmp_path / "t.json"
+    kw = dict(
+        MC_KW, serve_journal_dir=str(tmp_path / "j"),
+        serve_journal_every=4, trace_path=str(trace),
+    )
+    mc = MotionCorrector(**kw)
+    sched = StreamScheduler(mc).start()
+    sid = None
+    try:
+        s = sched.open_session(tenant="jr")
+        sid = s.sid
+        sched.submit(s.sid, _stack(12))
+        _drain_fully(s, 12)
+        _wait_idle(sched, 12)
+        # journal.save landed as a latency segment...
+        assert "journal.save" in s.lat.report()["segments"]
+        # ...and as DURATION spans on the session trace (the old
+        # instants carried dur 0 and hid the write cost)
+        evs = [
+            e
+            for e in s.telemetry.tracer.events()
+            if e["name"] == "journal.save"
+        ]
+        assert evs and all(e["ph"] == "X" for e in evs)
+        assert any(e["dur"] > 0 for e in evs)
+    finally:
+        sched.stop()  # journals the still-open session (keep_journal)
+    # restart: resume_session rehydrates and records journal.resume
+    mc2 = MotionCorrector(**kw)
+    sched2 = StreamScheduler(mc2).start()
+    try:
+        sess, cursor, resumed = sched2.resume_session(sid)
+        assert resumed and cursor == 12
+        rep = sess.lat.report()["segments"]
+        assert "journal.resume" in rep
+        assert rep["journal.resume"]["full"]["count"] == 1
+        evs = [
+            e
+            for e in sess.telemetry.tracer.events()
+            if e["name"] == "journal.resume"
+        ]
+        assert evs and evs[0]["ph"] == "X"
+        # the plane rollup sees it too (metrics verb surface)
+        assert "journal.resume" in sched2.metrics()["plane"]["segments"]
+        sched2.close_session(sid, timeout=120)
+    finally:
+        sched2.stop()
+
+
+# -- one shared vocabulary: one-shot runs + report ---------------------------
+
+
+def test_one_shot_correct_records_shared_subset(tmp_path):
+    records = tmp_path / "fr.jsonl"
+    mc = MotionCorrector(frame_records_path=str(records), **MC_KW)
+    res = mc.correct(_stack(16))
+    lat = res.timing["latency"]
+    # sync backends (numpy) execute inside the dispatch call: that
+    # interval is request.device, and request.dispatch is skipped so
+    # the kernel time is never double-counted (async backends record
+    # all three — the CI observability smoke covers the jax path)
+    assert {"request.device", "request.drain"} <= set(lat["segments"])
+    assert "request.dispatch" not in lat["segments"]
+    for seg, rungs in lat["segments"].items():
+        assert seg in LIFECYCLE_SEGMENTS
+        for s in rungs.values():
+            assert set(s) == SUMMARY_KEYS
+            assert s["count"] == 16 or s["count"] > 0
+    # report --json surfaces the section with the SAME schema
+    from kcmc_tpu.obs.report import _json_summary, load_run, render_report
+
+    run = load_run(str(records))
+    summary = _json_summary(run, top=5)
+    assert summary["latency"] == lat
+    text = render_report(run)
+    assert "Request latency" in text
+    assert "request.device" in text
+
+
+def test_report_renders_dash_on_pre_plane_artifacts():
+    # artifacts from before this PR carry no latency section: the
+    # renderer must skip gracefully and --json must carry None
+    from kcmc_tpu.obs.report import _json_summary, render_report
+
+    run = {
+        "source": "old.jsonl",
+        "records": [],
+        "timing": {
+            "stages_s": {"warp": 1.0},
+            "stage_counts": {"warp": 1},
+            "stage_mean_s": {"warp": 1.0},
+            "total_s": 1.0,
+        },
+    }
+    text = render_report(run)
+    assert "Request latency" not in text  # no crash, no empty table
+    assert _json_summary(run, top=5)["latency"] is None
+    # and a partial section with missing stats renders the em dash
+    run["timing"]["latency"] = {
+        "segments": {"request.total": {"full": {"count": 1}}},
+        "totals": {},
+    }
+    text = render_report(run)
+    assert "Request latency" in text and "—" in text
+
+
+def test_latency_telemetry_off_disables_every_site():
+    mc = MotionCorrector(latency_telemetry=False, **MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        s = sched.open_session(tenant="off")
+        assert s.lat is None
+        sched.submit(s.sid, _stack(8))
+        res = sched.close_session(s.sid, timeout=120)
+        assert "latency" not in res.timing
+        m = sched.metrics()
+        assert m["latency_telemetry"] is False
+        assert m["plane"]["segments"] == {}
+        assert m["counters"]["frames_done"] == 8  # health surface intact
+    finally:
+        sched.stop()
