@@ -471,6 +471,18 @@ def _cmd_serve(args) -> int:
     return serve_main(args)
 
 
+def _cmd_router(args) -> int:
+    """Fleet front door (docs/SERVING.md "Running a fleet"): spawn
+    and/or adopt serve replicas, health-check them by scraping their
+    metrics/stats verbs, place sessions by rendezvous hashing, and
+    live-migrate streams off dead or draining replicas through the
+    shared journal directory. Speaks the same protocol as `serve` —
+    point any ServeClient (or `kcmc_tpu top`) at the router."""
+    from kcmc_tpu.serve.router import router_main
+
+    return router_main(args)
+
+
 def _cmd_warmup(args) -> int:
     """Pre-populate the execution-plan caches for a config set: AOT
     compile every hot program per declared shape bucket (and dtype),
@@ -896,6 +908,95 @@ def main(argv=None) -> int:
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser(
+        "router",
+        help="fleet front door over N serve replicas: speaks the same "
+        "line-JSON protocol, places sessions by rendezvous hashing "
+        "over health-checked replicas, live-migrates streams off dead "
+        "or draining replicas via the shared journal dir, and "
+        "optionally autoscales (docs/SERVING.md 'Running a fleet')",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument(
+        "--port", type=int, default=7744,
+        help="router TCP port (0 = ephemeral; the ready line reports "
+        "the bound port)",
+    )
+    p.add_argument(
+        "--spawn", type=int, default=0, metavar="N",
+        help="spawn N `kcmc_tpu serve` replicas (ephemeral ports, "
+        "shared --journal-dir) and supervise them",
+    )
+    p.add_argument(
+        "--replicas", default="", metavar="HOST:PORT,...",
+        help="adopt externally managed replicas (comma-separated "
+        "host:port list); adopted replicas are health-checked and "
+        "routed to but never stopped or drained by the autoscaler",
+    )
+    p.add_argument(
+        "--serve-args", default="", metavar="ARGS",
+        help="extra `kcmc_tpu serve` flags for spawned replicas, one "
+        "shell-quoted string (e.g. \"--backend numpy --batch-size 8\")",
+    )
+    p.add_argument(
+        "--journal-dir", default="", metavar="DIR",
+        help="SHARED session-journal directory — the migration "
+        "substrate; defaults to a fresh temp dir when spawning "
+        "(migration needs every replica to see every journal)",
+    )
+    p.add_argument(
+        "--probe-interval", type=float, default=None, metavar="SECS",
+        help="health-scrape period AND per-scrape budget "
+        "(fleet_probe_interval_s; default 1)",
+    )
+    p.add_argument(
+        "--suspect-probes", type=int, default=None, metavar="N",
+        help="consecutive bad scrapes before HEALTHY -> SUSPECT "
+        "(fleet_suspect_probes; default 2)",
+    )
+    p.add_argument(
+        "--dead-probes", type=int, default=None, metavar="N",
+        help="consecutive hard-bad scrapes before SUSPECT -> DEAD "
+        "and migration (fleet_dead_probes; default 4)",
+    )
+    p.add_argument(
+        "--wedge-threshold", type=float, default=None, metavar="SECS",
+        help="loop_beat_age_s above which a reachable replica counts "
+        "as wedged (fleet_wedge_threshold_s; default 30)",
+    )
+    p.add_argument(
+        "--watermark", type=float, default=None, metavar="FRAC",
+        help="fleet-wide admission watermark: reject new sessions "
+        "429-style once global queued frames pass FRAC of aggregate "
+        "capacity (fleet_queue_watermark; default 0.9; 1.0 = off)",
+    )
+    p.add_argument(
+        "--autoscale", action="store_true",
+        help="run the autoscaler control loop (spawn on backlog, "
+        "drain on idle, within --min/--max-replicas)",
+    )
+    p.add_argument(
+        "--min-replicas", type=int, default=0, metavar="N",
+        help="autoscale floor (default: the initial fleet size)",
+    )
+    p.add_argument(
+        "--max-replicas", type=int, default=0, metavar="N",
+        help="autoscale ceiling (default: the initial fleet size)",
+    )
+    p.add_argument(
+        "--scale-cooldown", type=float, default=None, metavar="SECS",
+        help="minimum seconds between autoscale actions "
+        "(fleet_scale_cooldown_s; default 30)",
+    )
+    p.add_argument(
+        "--inject-faults", default="", metavar="SPEC",
+        help="deterministic fleet chaos: the fault-plan grammar with "
+        "the `fleet` surface — a raising clause blackholes a "
+        "router->replica call, stall= stalls a health scrape past "
+        "its budget; also via KCMC_FAULT_PLAN",
+    )
+    p.set_defaults(fn=_cmd_router)
+
+    p = sub.add_parser(
         "warmup",
         help="pre-populate the execution-plan caches for a config set: "
         "AOT compile every hot program per shape bucket and stamp the "
@@ -1047,14 +1148,16 @@ def main(argv=None) -> int:
 
     p = sub.add_parser(
         "top",
-        help="live terminal dashboard over a serve replica: "
-        "per-session fps and queue depth, per-segment latency "
-        "p50/p99, supervisor state and wedge age (polls the "
-        "metrics/stats verbs)",
+        help="live terminal dashboard over serve replicas: per-session "
+        "fps and queue depth, per-segment latency p50/p99, supervisor "
+        "state and wedge age (polls the metrics/stats verbs); several "
+        "targets — or one router — render a fleet-merged view",
     )
     p.add_argument(
-        "addr", nargs="?", default="127.0.0.1:7733",
-        help="host:port of the serve replica (default 127.0.0.1:7733)",
+        "addrs", nargs="*", default=["127.0.0.1:7733"], metavar="ADDR",
+        help="one or more host:port targets (default 127.0.0.1:7733): "
+        "one replica or router renders directly; several replicas are "
+        "scraped and exact-merged into one fleet dashboard",
     )
     p.add_argument(
         "--interval", type=float, default=2.0, metavar="SECS",

@@ -346,6 +346,41 @@ class CorrectorConfig:
     # the rebuild warm-boots through the persistent compile cache when
     # configured). 0 = never quarantine.
     serve_backend_strikes: int = 2
+    # -- fleet router (serve/fleet.py + serve/router.py; CLI
+    # `kcmc_tpu router` — docs/SERVING.md "Running a fleet"). All
+    # resume-signature neutral: they schedule WHERE sessions run and
+    # WHEN the fleet reacts, never what a stream computes.
+    # Health-scrape cadence, seconds: the router probes every
+    # replica's `metrics` verb this often; each probe's whole
+    # round-trip is hard-capped at this budget too, so a wedged
+    # replica can never stall the prober past one period.
+    fleet_probe_interval_s: float = 1.0
+    # Consecutive bad probes (missed scrape, wedge gauge over
+    # fleet_wedge_threshold_s, or supervisor quarantine in progress)
+    # before a HEALTHY replica is marked SUSPECT (excluded from new
+    # placements), and consecutive GOOD probes a SUSPECT replica needs
+    # to recover to HEALTHY — the hysteresis half-width of the health
+    # state machine.
+    fleet_suspect_probes: int = 2
+    # Consecutive HARD-bad probes (unreachable/stalled scrapes; soft
+    # signals like the wedge gauge only suspend placement) before a
+    # SUSPECT replica is declared DEAD and its sessions are migrated
+    # to survivors via `resume_session`.
+    fleet_dead_probes: int = 4
+    # `loop_beat_age_s` (the PR-14 scheduler-wedge gauge) above which
+    # a scrape counts as a bad probe even when the transport answered.
+    fleet_wedge_threshold_s: float = 30.0
+    # Fleet-wide admission watermark: fraction of the fleet's
+    # aggregate queue capacity (healthy replicas x serve_queue_depth)
+    # past which the router rejects NEW sessions 429-style with a
+    # predicted-wait hint from the fleet-merged latency histograms.
+    # Layered over the per-replica degradation ladder; 1.0 = never
+    # reject at the router.
+    fleet_queue_watermark: float = 0.9
+    # Autoscaler cooldown, seconds: minimum spacing between scale
+    # actions (spawn or drain), so one burst never staircases the
+    # fleet — the same pacing idea as the backend-rebuild cooldown.
+    fleet_scale_cooldown_s: float = 30.0
 
     @property
     def observability_enabled(self) -> bool:
@@ -753,6 +788,37 @@ class CorrectorConfig:
                 "serve_backend_strikes must be >= 0 failures (0 = "
                 f"never quarantine), got {self.serve_backend_strikes}"
             )
+        if self.fleet_probe_interval_s <= 0:
+            raise ValueError(
+                "fleet_probe_interval_s must be positive seconds, got "
+                f"{self.fleet_probe_interval_s}"
+            )
+        if self.fleet_suspect_probes < 1:
+            raise ValueError(
+                "fleet_suspect_probes must be >= 1 probe, got "
+                f"{self.fleet_suspect_probes}"
+            )
+        if self.fleet_dead_probes < self.fleet_suspect_probes:
+            raise ValueError(
+                "fleet_dead_probes must be >= fleet_suspect_probes "
+                f"(a replica is SUSPECT before it is DEAD), got "
+                f"{self.fleet_dead_probes} < {self.fleet_suspect_probes}"
+            )
+        if self.fleet_wedge_threshold_s <= 0:
+            raise ValueError(
+                "fleet_wedge_threshold_s must be positive seconds, got "
+                f"{self.fleet_wedge_threshold_s}"
+            )
+        if not 0.0 < self.fleet_queue_watermark <= 1.0:
+            raise ValueError(
+                "fleet_queue_watermark must be in (0, 1] (1.0 = never "
+                f"reject at the router), got {self.fleet_queue_watermark}"
+            )
+        if self.fleet_scale_cooldown_s < 0:
+            raise ValueError(
+                "fleet_scale_cooldown_s must be >= 0 seconds, got "
+                f"{self.fleet_scale_cooldown_s}"
+            )
         if self.heartbeat_s < 0:
             raise ValueError(
                 f"heartbeat_s must be >= 0 seconds (0 = off), got "
@@ -907,6 +973,16 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "serve_session_timeout_s",
         "serve_io_timeout_s",
         "serve_backend_strikes",
+        # Fleet router (PR 16): placement/health/autoscale knobs move
+        # sessions BETWEEN replicas — the migration contract already
+        # guarantees a moved stream computes the same frames, so none
+        # of these can affect results.
+        "fleet_probe_interval_s",
+        "fleet_suspect_probes",
+        "fleet_dead_probes",
+        "fleet_wedge_threshold_s",
+        "fleet_queue_watermark",
+        "fleet_scale_cooldown_s",
         "compile_cache_dir",
         "donate_buffers",
         # Tile autotuning changes WHICH blocking a kernel compiles

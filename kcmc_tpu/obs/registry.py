@@ -102,6 +102,13 @@ REQUEST_SEGMENTS = frozenset(
 # the PR-14 `journal_save`/`journal_resume` instants.
 JOURNAL_SPANS = frozenset({"journal.save", "journal.resume"})
 
+# Fleet-router DURATION spans (serve/router.py): latency segments the
+# router records into its own SegmentLatencies — `fleet.migrate` is
+# one whole session migration (pick survivor -> resume_session ->
+# tail replay -> rebind), surfaced through the router's `metrics`
+# rollup so migration cost is visible fleet-wide.
+FLEET_SPANS = frozenset({"fleet.migrate"})
+
 SPAN_NAMES = (
     STAGE_SPANS
     | STALL_SPANS
@@ -113,6 +120,7 @@ SPAN_NAMES = (
     | COUNTER_NAMES
     | REQUEST_SEGMENTS
     | JOURNAL_SPANS
+    | FLEET_SPANS
 )
 
 # -- timing payload keys ---------------------------------------------------

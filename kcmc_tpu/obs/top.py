@@ -1,4 +1,4 @@
-"""`kcmc_tpu top`: a live terminal dashboard over one serve replica.
+"""`kcmc_tpu top`: a live terminal dashboard over serve replicas.
 
 Polls the `metrics` and `stats` verbs every refresh interval and
 renders a compact view — per-session frames/fps/queue depth, the
@@ -7,6 +7,11 @@ plane's per-segment latency p50/p99 — so an operator watching a
 replica sees queue pressure and tail latency move in real time
 without Prometheus in the loop. `--once` renders a single frame and
 exits (the CI smoke and scripting hook).
+
+Fleet mode: pass several `host:port` targets (or one router address —
+a router's `metrics` payload is already fleet-merged) and top scrapes
+each and exact-merges the payloads via the serve/fleet.py histogram
+contract into ONE dashboard, with a per-replica health block.
 
 Pure stdlib + the bundled ServeClient: no accelerator imports, no
 extra threads (the poll loop IS the program), safe to point at a
@@ -34,6 +39,7 @@ _SEGMENT_ORDER = (
     "request.total",
     "journal.save",
     "journal.resume",
+    "fleet.migrate",
 )
 
 
@@ -88,6 +94,24 @@ def render(metrics: dict, stats: dict, addr: str) -> str:
         sup_bits.append(f"degraded_batches={c['degraded_batches']}")
     lines.append("supervisor: " + " ".join(sup_bits))
 
+    # Fleet block: present when the payload came from a router (or
+    # was merged from several replicas by the multi-target poll).
+    fleet = metrics.get("fleet")
+    if fleet and fleet.get("replicas"):
+        lines.append(
+            f"fleet: {fleet.get('n_replicas', 0)} replicas, "
+            f"{fleet.get('n_healthy', 0)} healthy"
+        )
+        for rid in sorted(fleet["replicas"]):
+            r = fleet["replicas"][rid]
+            rg = r.get("gauges") or {}
+            lines.append(
+                f"  {rid:<22} {str(r.get('state', '?')):<10}"
+                f" sessions={rg.get('sessions_open', 0)}"
+                f" queued={rg.get('queued_frames', 0)}"
+                f" inflight={rg.get('inflight_batches', 0)}"
+            )
+
     totals = (metrics.get("plane") or {}).get("totals") or {}
     lines.append("")
     if totals:
@@ -134,37 +158,87 @@ def render(metrics: dict, stats: dict, addr: str) -> str:
     return "\n".join(lines) + "\n"
 
 
+def _merge_stats(stats_by: dict) -> dict:
+    """Fleet view of N replicas' `stats` supervisor blocks: worst-case
+    rollup (max wedge age, summed strikes/rebuilds, any rebuilding) —
+    the dashboard header should show the sickest replica's numbers."""
+    sup = {
+        "backend_strikes": 0,
+        "backend_rebuilds": 0,
+        "backend_rebuilding": False,
+        "loop_beat_age_s": 0.0,
+    }
+    for st in stats_by.values():
+        s = (st or {}).get("supervisor") or {}
+        sup["backend_strikes"] += int(s.get("backend_strikes", 0))
+        sup["backend_rebuilds"] += int(s.get("backend_rebuilds", 0))
+        sup["backend_rebuilding"] |= bool(s.get("backend_rebuilding"))
+        sup["loop_beat_age_s"] = max(
+            sup["loop_beat_age_s"], float(s.get("loop_beat_age_s", 0.0))
+        )
+    return {"supervisor": sup}
+
+
 def main(args) -> int:
     """`kcmc_tpu top` body (argparse args from __main__): poll
-    metrics+stats, render, repeat. `--once` prints one frame (exit 1
-    if the server is unreachable); the live loop keeps retrying a
-    flapping server and exits 0 on Ctrl-C."""
+    metrics+stats, render, repeat. One target renders that replica
+    (or router — a router's payload already carries the fleet block);
+    several targets are scraped individually and exact-merged
+    client-side (serve/fleet.py merge contract) into one fleet
+    dashboard. `--once` prints one frame (exit 1 when every target is
+    unreachable); the live loop keeps retrying flapping targets and
+    exits 0 on Ctrl-C."""
     import sys
 
     from kcmc_tpu.serve.client import ServeClient, ServeError
 
-    host, port = parse_addr(args.addr)
-    addr = f"{host}:{port}"
+    raw = getattr(args, "addrs", None) or [args.addr]
+    targets = [parse_addr(a) for a in raw]
+    addrs = [f"{h}:{p}" for h, p in targets]
+    label = addrs[0] if len(addrs) == 1 else (
+        f"fleet({len(addrs)}): " + ",".join(addrs)
+    )
     interval = max(float(args.interval), 0.2)
-    client = None
+    clients: dict[str, ServeClient] = {}
     try:
         while True:
-            try:
-                if client is None:
-                    client = ServeClient(host=host, port=port)
-                frame = render(client.metrics(), client.stats(), addr)
-            except (ServeError, OSError) as e:
-                if client is not None:
-                    client.close()
-                    client = None
+            payloads: dict[str, dict] = {}
+            stats_by: dict[str, dict] = {}
+            down: dict[str, str] = {}
+            for (host, port), addr in zip(targets, addrs):
+                try:
+                    c = clients.get(addr)
+                    if c is None:
+                        c = clients[addr] = ServeClient(
+                            host=host, port=port
+                        )
+                    payloads[addr] = c.metrics()
+                    stats_by[addr] = c.stats()
+                except (ServeError, OSError) as e:
+                    c = clients.pop(addr, None)
+                    if c is not None:
+                        c.close()
+                    down[addr] = str(e)
+            if not payloads:
+                err = "; ".join(f"{a}: {e}" for a, e in down.items())
                 if args.once:
-                    print(f"kcmc top: {addr} unreachable: {e}",
+                    print(f"kcmc top: unreachable: {err}",
                           file=sys.stderr)
                     return 1
                 frame = (
-                    f"kcmc_tpu top — {addr}   (unreachable: {e}; "
+                    f"kcmc_tpu top — {label}   (unreachable: {err}; "
                     "retrying)\n"
                 )
+            elif len(addrs) == 1:
+                addr = addrs[0]
+                frame = render(payloads[addr], stats_by[addr], addr)
+            else:
+                from kcmc_tpu.serve.fleet import merge_fleet_metrics
+
+                states = {a: "HEALTHY" for a in payloads}
+                states.update({a: "UNREACHABLE" for a in down})
+                merged = merge_fleet_metrics(payloads, states=states)
+                frame = render(merged, _merge_stats(stats_by), label)
             if args.once:
                 print(frame, end="")
                 return 0
@@ -174,5 +248,5 @@ def main(args) -> int:
         print()
         return 0
     finally:
-        if client is not None:
-            client.close()
+        for c in clients.values():
+            c.close()

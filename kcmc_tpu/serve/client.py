@@ -38,6 +38,7 @@ from __future__ import annotations
 import os
 import socket
 import threading
+import time
 
 import numpy as np
 
@@ -131,9 +132,14 @@ class ServeClient:
 
     # -- plumbing ----------------------------------------------------------
 
-    def _connect_locked(self) -> None:
+    def _connect_locked(self, timeout: float | None = None) -> None:
         self._sock = socket.create_connection(
-            self._addr, timeout=self._connect_timeout
+            self._addr,
+            timeout=(
+                self._connect_timeout
+                if timeout is None
+                else min(self._connect_timeout, max(timeout, 0.001))
+            ),
         )
         self._sock.settimeout(self._io_timeout)
         self._rfile = self._sock.makefile("rb")
@@ -158,6 +164,7 @@ class ServeClient:
         op: str,
         _deadline: float | None = None,
         _idempotent: bool = True,
+        _budget: float | None = None,
         **fields,
     ) -> dict:
         """One request/response round-trip with the resilience layer.
@@ -169,8 +176,18 @@ class ServeClient:
         that still fires means a dead or half-open transport, not a
         slow result. Idempotent requests are replayed across
         reconnects; non-idempotent ones surface the transport error
-        after the first send attempt."""
+        after the first send attempt.
+
+        `_budget` is a hard wall-clock cap on the WHOLE call —
+        connects, reads, backoff sleeps, and every reconnect replay
+        together. The first attempt always runs (with its socket
+        deadlines clipped to the budget), later attempts are skipped
+        once the budget is spent, so a wedged server can never hold a
+        budgeted caller (a router health probe) past its budget."""
         deadline = max(self._io_timeout, _deadline or 0.0) + self._io_timeout
+        t_end = (
+            None if _budget is None else time.monotonic() + float(_budget)
+        )
         msg = {"op": op, **fields}
         last: Exception | None = None
         resp: dict | None = None
@@ -183,11 +200,21 @@ class ServeClient:
             tried = 0
             for attempt in range(self._reconnect_attempts):
                 if attempt:
-                    self._reconnect_policy.sleep(
-                        self._reconnect_policy.delay(attempt - 1)
-                    )
+                    if t_end is not None and time.monotonic() >= t_end:
+                        break  # budget spent: no more replays
+                    sleep_s = self._reconnect_policy.delay(attempt - 1)
+                    if t_end is not None:
+                        sleep_s = min(
+                            sleep_s, max(t_end - time.monotonic(), 0.0)
+                        )
+                    self._reconnect_policy.sleep(sleep_s)
                 try:
                     tried = attempt + 1
+                    remaining = (
+                        None
+                        if t_end is None
+                        else max(t_end - time.monotonic(), 0.001)
+                    )
                     if self._sock is None:
                         # Entering with no socket means a PREVIOUS call
                         # (or disconnect()) tore the transport down —
@@ -197,8 +224,12 @@ class ServeClient:
                         # must see it as a reconnect even when the
                         # connect itself succeeds first try.
                         self._last_call_reconnected = True
-                        self._connect_locked()
-                    self._sock.settimeout(deadline)
+                        self._connect_locked(timeout=remaining)
+                    self._sock.settimeout(
+                        deadline
+                        if remaining is None
+                        else min(deadline, remaining)
+                    )
                     proto.send_msg(self._wfile, msg)
                     resp = proto.recv_msg(self._rfile, max_line=None)
                     if resp is None:
@@ -350,6 +381,16 @@ class ServeClient:
         the resume cursor: the index of the first frame the server
         does NOT have durably. Re-submit frames from there (the
         automatic `first` indices make overlap harmless)."""
+        return int(self.resume_session_info(session_id)["cursor"])
+
+    def resume_session_info(self, session_id: str) -> dict:
+        """`resume_session` returning the FULL response record:
+        ``cursor``, ``resumed``, and — when the server rehydrated the
+        stream from a journal — ``plan_cache`` (the rehydrating
+        replica's plan-cache hit/miss counts for the session's live
+        shapes), so a migrating router can tell a warm landing from a
+        cold one. Updates the client's idempotency cursors exactly
+        like `resume_session`."""
         with self._lock:
             resp = self._call("resume_session", session=str(session_id))
             cursor = int(resp["cursor"])
@@ -365,7 +406,7 @@ class ServeClient:
             # to any span released to the dropped connection. Keep the
             # existing delivery cursor (or stay unguarded if this
             # client never tracked one).
-        return cursor
+        return {k: v for k, v in resp.items() if k != "ok"}
 
     def submit(self, session: str, frames: np.ndarray) -> dict:
         """Submit frames; returns the admission decision
@@ -497,15 +538,43 @@ class ServeClient:
             out["diagnostics"] = proto.decode_arrays(out["diagnostics"])
         return out
 
-    def stats(self) -> dict:
-        return self._call("stats")["stats"]
+    def stats(self, timeout: float | None = None) -> dict:
+        """Scheduler gauges. `timeout` is a hard cap on the WHOLE
+        round-trip (connects + reads + reconnect backoff together) —
+        a health prober's budget, not a per-socket-op deadline."""
+        return self._call("stats", _budget=timeout)["stats"]
 
-    def metrics(self) -> dict:
+    def metrics(self, timeout: float | None = None) -> dict:
         """The request-latency telemetry payload (`metrics` verb):
         per-segment latency summaries, mergeable histogram state,
         counters and gauges — see docs/OBSERVABILITY.md "Request
-        latency". Idempotent read, replayed across reconnects."""
-        return self._call("metrics")["metrics"]
+        latency". Idempotent read, replayed across reconnects.
+        `timeout` hard-caps the whole round-trip like `stats`."""
+        return self._call("metrics", _budget=timeout)["metrics"]
+
+    def call(
+        self,
+        op: str,
+        *,
+        deadline: float | None = None,
+        idempotent: bool = True,
+        budget: float | None = None,
+        **fields,
+    ) -> dict:
+        """Raw protocol passthrough: one `op` round-trip with `fields`
+        sent VERBATIM (already-encoded arrays included) under the full
+        resilience layer. The fleet router forwards client requests
+        with this — re-decoding and re-encoding every frames payload
+        at the hop would double the router's CPU cost for nothing.
+        Does NOT touch the idempotency/delivery cursors; callers that
+        need them use the typed ops above."""
+        return self._call(
+            op,
+            _deadline=deadline,
+            _idempotent=idempotent,
+            _budget=budget,
+            **fields,
+        )
 
     def shutdown(self) -> dict:
         """Ask the server process to exit cleanly; returns final stats.

@@ -157,12 +157,44 @@ class ServeServer:
             sess, cursor, resumed = self.scheduler.resume_session(
                 msg["session"]
             )
-            return {
+            resp = {
                 "ok": True,
                 "session": sess.sid,
                 "cursor": int(cursor),
                 "resumed": bool(resumed),
             }
+            # Migration-cost observability (docs/SERVING.md "Running a
+            # fleet"): the rehydrating replica's plan-cache hit/miss
+            # counts, narrowed to the session's live frame shape when
+            # known, so a migrating router can tell a warm landing
+            # (stamp hits, zero new compiles) from a cold one.
+            stats_fn = getattr(
+                self.scheduler.mc.backend, "plan_cache_stats", None
+            )
+            if resumed and stats_fn is not None:
+                try:
+                    ps = stats_fn()
+                    shape = sess.frame_shape
+                    key = (
+                        "x".join(str(s) for s in shape) if shape else None
+                    )
+                    resp["plan_cache"] = {
+                        "stamp_hits": int(ps.get("stamp_hits", 0)),
+                        "stamp_misses": int(ps.get("stamp_misses", 0)),
+                        "programs_compiled": int(
+                            ps.get("programs_compiled", 0)
+                        ),
+                        "session_shape_compiles": {
+                            k: int(v)
+                            for k, v in (
+                                ps.get("compile_counts") or {}
+                            ).items()
+                            if key is not None and f"|{key}|" in k
+                        },
+                    }
+                except Exception:
+                    pass  # observability must never fail a resume
+            return resp
         if op == "results":
             try:
                 # lookup_session also finds recently reaped sessions, so

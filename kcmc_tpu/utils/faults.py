@@ -17,8 +17,12 @@ dying at frame 800k. This module provides both halves of that story:
   handler: a raising clause drops the connection, a ``stall=`` clause
   half-opens it), the scheduler loop (``scheduler``: a ``stall=``
   clause wedges one loop iteration, a raising clause exercises the
-  loop's error backstop), and session journaling (``journal``, in
-  `serve.journal.SessionJournal.save`).
+  loop's error backstop), session journaling (``journal``, in
+  `serve.journal.SessionJournal.save`), and the fleet router
+  (``fleet``, in `serve.router`/`serve.fleet`: a raising clause
+  blackholes the router's next replica call — forward, health scrape,
+  or migration `resume_session` — and a ``stall=`` clause stalls a
+  health scrape past its probe budget).
   Activated via `CorrectorConfig(fault_plan=...)`, the
   ``KCMC_FAULT_PLAN`` environment variable, or the CLI's
   ``--inject-faults`` (``correct``, ``apply``, and ``serve``) — so
@@ -38,7 +42,7 @@ Spec grammar (see docs/ROBUSTNESS.md for the full reference)::
     plan    := clause ("," clause)*
     clause  := surface (":" token)*
     surface := io_read | device | failover | checkpoint
-              | transport | scheduler | journal
+              | transport | scheduler | journal | fleet
     token   := key "=" value | action
     action  := transient (default) | fatal | raise (alias of fatal)
               | always (alias of times=inf)
@@ -50,10 +54,11 @@ Spec grammar (see docs/ROBUSTNESS.md for the full reference)::
                                probability F (seeded, deterministic)
                corrupt_part=N  checkpoint surface only: corrupt part
                                file N on disk before it is loaded
-               stall=SECS      transport/scheduler surfaces only: the
-                               matched operation STALLS for SECS
-                               seconds instead of raising (half-open
-                               socket / wedged scheduler simulation;
+               stall=SECS      transport/scheduler/fleet surfaces
+                               only: the matched operation STALLS for
+                               SECS seconds instead of raising
+                               (half-open socket / wedged scheduler /
+                               stalled health scrape simulation;
                                consumed via `take_stall`)
 
 Example — the chaos trifecta::
@@ -85,10 +90,14 @@ SURFACES = (
     "transport",
     "scheduler",
     "journal",
+    # fleet-router surface (PR 16): router-side replica calls —
+    # raising = replica blackhole / migration failure, stall= =
+    # health-scrape stall
+    "fleet",
 )
 
 # Surfaces whose clauses may carry stall=SECS (wedge, don't raise).
-_STALL_SURFACES = ("transport", "scheduler")
+_STALL_SURFACES = ("transport", "scheduler", "fleet")
 
 
 class FaultError(RuntimeError):

@@ -1,0 +1,565 @@
+"""Fleet-scale serve resilience (ISSUE 16; docs/SERVING.md "Running a
+fleet", docs/ROBUSTNESS.md "Fleet failures").
+
+The contract under test:
+
+* **placement** — rendezvous hashing: the same session key always
+  lands on the same replica under a stable ring, and a replica
+  join/leave moves only the minimal key share (keys on the departed
+  replica / keys won by the new one);
+* **health** — the HEALTHY -> SUSPECT -> DEAD machine with
+  hysteresis: soft evidence (supervisor rebuild) suspends placement
+  but never kills, only consecutive HARD evidence (missed scrapes,
+  scheduler wedge) reaches DEAD, and DEAD is sticky;
+* **merge exactness** — fleet-merged metrics equal what one process
+  observing every request would have recorded (the PR-15 histogram
+  contract), so fleet p99s are real percentiles, not averages of
+  averages;
+* **migration** — a session whose replica dies mid-stream resumes on
+  a survivor from the shared journal + the router's tail buffer, with
+  outputs parity-equal (<= 1e-4) to an uninterrupted run and zero
+  lost or duplicated frames (the slow subprocess canary SIGKILLs a
+  replica under a live client);
+* **admission + autoscaling** — the fleet-wide watermark rejects new
+  sessions 429-style with a predicted-wait hint, and the autoscaler
+  spawns on backlog / drains on idle under a cooldown.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.config import CorrectorConfig
+from kcmc_tpu.obs.latency import SegmentLatencies
+from kcmc_tpu.serve.client import ServeClient, ServeError
+from kcmc_tpu.serve.fleet import (
+    DEAD,
+    HEALTHY,
+    SUSPECT,
+    Replica,
+    ReplicaHealth,
+    merge_fleet_metrics,
+    place,
+    predicted_wait_s,
+    rank,
+)
+from kcmc_tpu.serve.journal import journal_path, load_session_journal
+from kcmc_tpu.serve.router import FleetRouter
+from kcmc_tpu.serve.server import ServeServer
+from kcmc_tpu.utils.faults import FatalFaultError, FaultPlan
+from kcmc_tpu.utils.synthetic import make_drift_stack
+
+TOL = 1e-4
+MC_KW = dict(
+    model="translation", backend="numpy", batch_size=8,
+    max_keypoints=64, n_hypotheses=32,
+)
+
+
+def _stack(n=24, seed=0, shape=(48, 48)):
+    d = make_drift_stack(
+        n_frames=n, shape=shape, model="translation", max_drift=3.0,
+        seed=seed,
+    )
+    return d.stack.astype(np.float32)
+
+
+# -- fault grammar: the fleet surface ---------------------------------------
+
+
+def test_fleet_fault_surface_grammar():
+    plan = FaultPlan.from_spec("fleet:step=1:raise, fleet:stall=2")
+    plan.maybe_fail("fleet", 0)
+    with pytest.raises(FatalFaultError):
+        plan.maybe_fail("fleet", 1)
+    # stall clauses are consumed (scrape-stall injection)
+    assert plan.take_stall("fleet", 5) == 2.0
+    assert plan.take_stall("fleet", 6) == 0.0
+
+
+# -- rendezvous placement ---------------------------------------------------
+
+
+def test_placement_deterministic_and_order_independent():
+    rids = [f"10.0.0.{i}:7733" for i in range(4)]
+    keys = [f"sess-{i}" for i in range(64)]
+    got = {k: place(k, rids) for k in keys}
+    assert got == {k: place(k, list(reversed(rids))) for k in keys}
+    # every replica should win SOME keys (64 keys over 4 replicas)
+    assert set(got.values()) == set(rids)
+
+
+def test_placement_leave_moves_only_departed_share():
+    rids = [f"10.0.0.{i}:7733" for i in range(4)]
+    keys = [f"sess-{i}" for i in range(200)]
+    before = {k: place(k, rids) for k in keys}
+    after = {k: place(k, rids[:3]) for k in keys}
+    for k in keys:
+        if before[k] != rids[3]:
+            # keys NOT on the departed replica must not move
+            assert after[k] == before[k]
+        else:
+            assert after[k] in rids[:3]
+
+
+def test_placement_join_moves_only_won_share():
+    rids = [f"10.0.0.{i}:7733" for i in range(4)]
+    keys = [f"sess-{i}" for i in range(200)]
+    before = {k: place(k, rids) for k in keys}
+    after = {k: place(k, rids + ["10.0.0.9:7733"]) for k in keys}
+    moved = [k for k in keys if after[k] != before[k]]
+    assert moved, "a join should win some keys"
+    assert all(after[k] == "10.0.0.9:7733" for k in moved)
+    # roughly 1/5 of keys should move, never a wholesale reshuffle
+    assert len(moved) < len(keys) // 2
+
+
+def test_rank_is_a_full_deterministic_order():
+    rids = [f"r{i}" for i in range(5)]
+    order = rank("some-session", rids)
+    assert sorted(order) == sorted(rids)
+    assert order == rank("some-session", list(reversed(rids)))
+
+
+# -- replica health machine -------------------------------------------------
+
+
+def test_health_hard_evidence_ladder_and_hysteresis():
+    h = ReplicaHealth(suspect_probes=2, dead_probes=3)
+    assert h.state == HEALTHY
+    h.observe(False, hard=True)
+    assert h.state == HEALTHY  # one bad scrape is not evidence
+    h.observe(False, hard=True)
+    assert h.state == SUSPECT
+    # recovery needs suspect_probes consecutive GOOD scrapes
+    h.observe(True)
+    assert h.state == SUSPECT
+    h.observe(True)
+    assert h.state == HEALTHY
+    # and a single good scrape resets the bad streak
+    h.observe(False, hard=True)
+    h.observe(True)
+    h.observe(True)
+    for _ in range(3):
+        h.observe(False, hard=True)
+    assert h.state == DEAD
+    h.observe(True)
+    assert h.state == DEAD  # sticky: a dead replica never self-heals
+
+
+def test_health_soft_evidence_never_kills():
+    h = ReplicaHealth(suspect_probes=2, dead_probes=3)
+    for _ in range(20):
+        h.observe(False, hard=False)
+    # a replica mid-rebuild is suspended from placement, not killed
+    assert h.state == SUSPECT
+
+
+# -- merge exactness --------------------------------------------------------
+
+
+def test_merge_fleet_metrics_is_exact():
+    """Merging per-replica exports must reproduce what ONE process
+    observing every request would have recorded — summaries equal to
+    the digit."""
+    rng = np.random.default_rng(3)
+    a, b, union = (
+        SegmentLatencies(), SegmentLatencies(), SegmentLatencies(),
+    )
+    for i, v in enumerate(rng.uniform(1e-4, 0.5, size=200)):
+        (a if i % 2 else b).observe("request.total", float(v))
+        union.observe("request.total", float(v))
+    merged = merge_fleet_metrics(
+        {
+            "r-a:1": {"plane": {"histograms": a.hist_dicts()},
+                      "counters": {"frames_done": 100},
+                      "gauges": {"queued_frames": 3}},
+            "r-b:2": {"plane": {"histograms": b.hist_dicts()},
+                      "counters": {"frames_done": 50},
+                      "gauges": {"queued_frames": 4}},
+        }
+    )
+    want = union.report()["totals"]["request.total"]
+    assert merged["plane"]["totals"]["request.total"] == want
+    assert merged["counters"]["frames_done"] == 150
+    assert merged["gauges"]["queued_frames"] == 7
+    assert merged["fleet"]["n_replicas"] == 2
+    assert merged["fleet"]["n_healthy"] == 2
+
+
+def test_predicted_wait_hint():
+    sl = SegmentLatencies()
+    for _ in range(10):
+        sl.observe("request.total", 0.1)
+    merged = merge_fleet_metrics(
+        {"r:1": {"plane": {"histograms": sl.hist_dicts()}}}
+    )
+    hint = predicted_wait_s(merged, queued=100, capacity=100)
+    assert hint is not None and hint > 0.1  # scaled by backlog
+    assert predicted_wait_s({}, 10, 100) is None  # no history -> None
+    assert predicted_wait_s(merged, 10, 0) is None
+
+
+def test_top_renders_fleet_block():
+    from kcmc_tpu.obs.top import _merge_stats, render
+
+    sl = SegmentLatencies()
+    sl.observe("request.total", 0.02)
+    merged = merge_fleet_metrics(
+        {"10.0.0.1:7733": {"plane": {"histograms": sl.hist_dicts()},
+                           "gauges": {"sessions_open": 2}}},
+        states={"10.0.0.1:7733": HEALTHY, "10.0.0.2:7733": DEAD},
+    )
+    out = render(merged, _merge_stats({}), "fleet(2)")
+    assert "fleet: 2 replicas, 1 healthy" in out
+    assert "10.0.0.2:7733" in out and "DEAD" in out
+
+
+# -- in-process fleet: proxying + migration ---------------------------------
+
+
+def _inproc_fleet(tmp_path, n=2, **cfg_kw):
+    jdir = str(tmp_path / "journals")
+    servers = []
+    for _ in range(n):
+        mc = MotionCorrector(
+            serve_journal_dir=jdir, serve_journal_every=4, **MC_KW
+        )
+        servers.append(ServeServer(mc, port=0).start())
+    reps = [Replica("127.0.0.1", s.port) for s in servers]
+    cfg = CorrectorConfig(
+        fleet_probe_interval_s=cfg_kw.pop("probe_interval", 0.2),
+        **cfg_kw,
+    )
+    router = FleetRouter(reps, port=0, config=cfg, journal_dir=jdir).start()
+    return servers, reps, router, jdir
+
+
+def test_router_proxies_a_full_stream_parity_exact(tmp_path):
+    stack = _stack(24, seed=4)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+    servers, reps, router, _ = _inproc_fleet(tmp_path, n=2)
+    try:
+        with ServeClient(port=router.port) as c:
+            assert c.ping()
+            sid = c.open_session(tenant="t", session_id="P1")
+            c.submit(sid, stack[:12])
+            c.submit(sid, stack[12:])
+            out = c.close_session(sid)
+            m = c.metrics()
+            st = c.stats()
+        assert out["frames"] == 24
+        assert np.abs(out["transforms"] - truth.transforms).max() < TOL
+        assert st["router"] is True and st["sessions_routed"] == 1
+        assert m["fleet"]["n_replicas"] == 2
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_migration_on_replica_death_is_parity_exact(tmp_path):
+    """Kill (gracefully drain, which journals) the bound replica
+    mid-stream; the router must migrate the session to the survivor
+    on the next forward and the stream finishes parity-exact with
+    zero client-visible errors."""
+    stack = _stack(24, seed=5)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+    servers, reps, router, jdir = _inproc_fleet(tmp_path, n=2)
+    by_rid = {r.rid: s for r, s in zip(reps, servers)}
+    try:
+        with ServeClient(port=router.port) as c:
+            sid = c.open_session(tenant="t", session_id="M1")
+            c.submit(sid, stack[:12])
+            jp = journal_path(jdir, sid)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 30.0:
+                if os.path.exists(jp):
+                    got = load_session_journal(jp)
+                    if got and int(got[0]["done"]) >= 4:
+                        break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("journal never became durable")
+            victim_rid = router.stats()["sessions"][sid]
+            by_rid[victim_rid].stop()  # drains + journals, then dies
+            c.submit(sid, stack[12:])  # forward fails -> migrate
+            out = c.close_session(sid)
+        st = router.stats()
+        assert out["frames"] == 24
+        assert np.abs(out["transforms"] - truth.transforms).max() < TOL
+        assert st["migrations_total"] == 1
+        assert st["sessions"] == {}  # closed sessions unbind
+        # the migration span reached the router's own telemetry
+        mig = router.fleet_metrics()["plane"]["totals"]["fleet.migrate"]
+        assert mig["count"] == 1
+    finally:
+        router.stop()
+        for s in servers:
+            s.stop()
+
+
+def test_admission_watermark_rejects_with_hint():
+    r1 = Replica("127.0.0.1", 1, ready={"queue_depth": 64})
+    sl = SegmentLatencies()
+    sl.observe("request.total", 0.2)
+    r1.last_metrics = {
+        "plane": {"histograms": sl.hist_dicts()},
+        "gauges": {"queued_frames": 60},
+    }
+    router = FleetRouter(
+        [r1], port=0,
+        config=CorrectorConfig(fleet_queue_watermark=0.5),
+    )
+    try:
+        resp = router._admission_reject()
+        assert resp is not None and resp["code"] == 429
+        assert resp["queued"] == 60 and resp["limit"] == 32
+        assert resp["predicted_wait_s"] > 0
+        assert router.stats()["sessions_rejected"] == 1
+        # watermark 1.0 disables admission control entirely
+        router.config = CorrectorConfig(fleet_queue_watermark=1.0)
+        assert router._admission_reject() is None
+    finally:
+        router._tcp.server_close()  # never started; close the socket
+
+
+def test_client_budget_caps_whole_round_trip(tmp_path):
+    """The metrics/stats `timeout=` satellite: the budget bounds the
+    WHOLE verb round-trip (reconnect attempts included), so a prober
+    can never be held past its scrape budget by a dead replica."""
+    mc = MotionCorrector(**MC_KW)
+    srv = ServeServer(mc, port=0).start()
+    c = ServeClient(port=srv.port, reconnect_backoff_s=2.0)
+    try:
+        assert c.metrics(timeout=5.0)["schema"] == "kcmc_metrics/1"
+        srv.stop()
+        c.disconnect()  # force the reconnect path against a dead addr
+        t0 = time.monotonic()
+        with pytest.raises(ServeError) as ei:
+            c.metrics(timeout=0.5)
+        assert ei.value.code == 503
+        # budget 0.5s must beat the un-budgeted backoff schedule (2s+)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        c.close()
+        srv.stop()
+
+
+# -- autoscaler -------------------------------------------------------------
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.config = CorrectorConfig()
+        self.load = {
+            "queued_frames": 0, "capacity": 100, "n_live": 1,
+            "n_owned": 1, "e2e_p99_s": None,
+        }
+        self.added: list = []
+        self.drained: list = []
+
+    def fleet_load(self):
+        return dict(self.load)
+
+    def add_replica(self, r):
+        self.added.append(r)
+        self.load["n_live"] += 1
+        self.load["n_owned"] += 1
+
+    def drain_replica(self, rid):
+        self.drained.append(rid)
+        self.load["n_live"] -= 1
+        self.load["n_owned"] -= 1
+        return {"replica": rid, "migrated": [], "failed": []}
+
+    def stats(self):
+        return {
+            "replicas": {
+                f"r{i}": {
+                    "spawned": True, "state": HEALTHY, "sessions": i
+                }
+                for i in range(self.load["n_owned"])
+            }
+        }
+
+
+def test_autoscaler_spawns_on_backlog_drains_on_idle():
+    from types import SimpleNamespace
+
+    from kcmc_tpu.serve.autoscale import Autoscaler
+
+    router = _FakeRouter()
+    scaler = Autoscaler(
+        router, spawn_fn=lambda: SimpleNamespace(rid="new"),
+        min_replicas=1, max_replicas=2, cooldown_s=0.0,
+    )
+    router.load["queued_frames"] = 80  # 0.8 > scale_up_at=0.5
+    act = scaler.tick()
+    assert act["action"] == "spawn" and len(router.added) == 1
+    # at the ceiling: hot load does nothing more
+    router.load["queued_frames"] = 90
+    assert scaler.tick() is None
+    # idle: drain the emptiest spawned replica, down to the floor
+    router.load["queued_frames"] = 0
+    act = scaler.tick()
+    assert act["action"] == "drain" and router.drained == ["r0"]
+    assert scaler.tick() is None  # at the floor
+
+
+def test_autoscaler_cooldown_blocks_flapping():
+    from types import SimpleNamespace
+
+    from kcmc_tpu.serve.autoscale import Autoscaler
+
+    router = _FakeRouter()
+    scaler = Autoscaler(
+        router, spawn_fn=lambda: SimpleNamespace(rid="new"),
+        min_replicas=1, max_replicas=4, cooldown_s=300.0,
+    )
+    router.load["queued_frames"] = 80
+    assert scaler.tick() is not None
+    assert scaler.tick() is None  # cooldown armed
+    assert len(router.added) == 1
+
+
+def test_autoscaler_validates_bounds():
+    from kcmc_tpu.serve.autoscale import Autoscaler
+
+    with pytest.raises(ValueError, match="min_replicas"):
+        Autoscaler(_FakeRouter(), spawn_fn=None, min_replicas=3,
+                   max_replicas=2)
+    with pytest.raises(ValueError, match="scale_down_at"):
+        Autoscaler(_FakeRouter(), spawn_fn=None, scale_up_at=0.2,
+                   scale_down_at=0.3)
+
+
+# -- fleet config knobs -----------------------------------------------------
+
+
+def test_fleet_config_validation():
+    CorrectorConfig(fleet_probe_interval_s=0.5, fleet_suspect_probes=1,
+                    fleet_dead_probes=1)
+    with pytest.raises(ValueError, match="fleet_probe_interval_s"):
+        CorrectorConfig(fleet_probe_interval_s=0.0)
+    with pytest.raises(ValueError, match="fleet_dead_probes"):
+        CorrectorConfig(fleet_suspect_probes=3, fleet_dead_probes=2)
+    with pytest.raises(ValueError, match="fleet_queue_watermark"):
+        CorrectorConfig(fleet_queue_watermark=1.5)
+    with pytest.raises(ValueError, match="fleet_scale_cooldown_s"):
+        CorrectorConfig(fleet_scale_cooldown_s=-1.0)
+
+
+# -- subprocess canary: SIGKILL under a live client -------------------------
+
+
+def _spawn_replica_proc(jdir):
+    from kcmc_tpu.serve.fleet import spawn_replica
+
+    return spawn_replica(
+        [
+            "--port", "0", "--backend", "numpy",
+            "--batch-size", "8", "--max-keypoints", "64",
+            "--hypotheses", "32",
+            "--journal-dir", jdir, "--journal-every", "4",
+        ],
+        env={"JAX_PLATFORMS": "cpu"},
+    )
+
+
+@pytest.mark.slow
+def test_kill9_replica_mid_stream_migrates_parity_exact(tmp_path):
+    """THE fleet acceptance canary: SIGKILL 1 of 3 replicas while a
+    client is mid-stream through the router. The router must detect
+    the death, migrate the session to a survivor (journal + tail
+    replay), and the stream finishes with zero lost/duplicated frames
+    and parity <= 1e-4 against an uninterrupted run — the client sees
+    only a bounded retry."""
+    stack = _stack(24, seed=10)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+    jdir = str(tmp_path / "journals")
+    os.makedirs(jdir, exist_ok=True)
+    replicas = [_spawn_replica_proc(jdir) for _ in range(3)]
+    router = FleetRouter(replicas, port=0, journal_dir=jdir).start()
+    try:
+        with ServeClient(port=router.port) as c:
+            sid = c.open_session(tenant="canary", session_id="K1")
+            c.submit(sid, stack[:16])
+            jp = journal_path(jdir, sid)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60.0:
+                if os.path.exists(jp):
+                    got = load_session_journal(jp)
+                    if got and int(got[0]["done"]) >= 4:
+                        break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("journal never became durable")
+            victim_rid = router.stats()["sessions"][sid]
+            victim = next(r for r in replicas if r.rid == victim_rid)
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            victim.proc.wait(timeout=30)
+            c.submit(sid, stack[16:])
+            delivered = 0
+            while delivered < 24:
+                span = c.results(sid, timeout=60.0)
+                assert span is not None
+                assert int(span["first_frame"]) == delivered, (
+                    "lost or duplicated frames across the migration"
+                )
+                delivered += int(span["n"])
+            out = c.close_session(sid)
+        st = router.stats()
+        assert out["frames"] == 24
+        assert np.abs(out["transforms"] - truth.transforms).max() < TOL
+        assert st["migrations_total"] >= 1
+        assert st["migration_failures"] == 0
+    finally:
+        router.stop(stop_owned=True)
+
+
+@pytest.mark.slow
+def test_router_cli_ready_line_and_clean_shutdown(tmp_path):
+    """`kcmc_tpu router --spawn 2` boots a fleet, prints a machine-
+    readable ready line, serves a stream end to end, and SIGTERM
+    drains to a final `{"routed": true}` record."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "kcmc_tpu", "router",
+            "--port", "0", "--spawn", "2",
+            "--journal-dir", str(tmp_path / "journals"),
+            "--serve-args",
+            "--backend numpy --batch-size 8 "
+            "--max-keypoints 64 --hypotheses 32",
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        env=env,
+    )
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["routing"] is True and len(ready["replicas"]) == 2
+        stack = _stack(16, seed=11)
+        with ServeClient(port=ready["port"]) as c:
+            sid = c.open_session(tenant="cli", session_id="C1")
+            c.submit(sid, stack)
+            out = c.close_session(sid)
+            assert out["frames"] == 16
+        proc.send_signal(signal.SIGTERM)
+        final = json.loads(proc.stdout.readline())
+        assert final["routed"] is True
+        assert final["stats"]["sessions_routed"] == 1
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
