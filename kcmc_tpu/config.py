@@ -488,6 +488,26 @@ class CorrectorConfig:
     # e.g. "io_read:step=3:raise, device:step=7:transient,
     # checkpoint:corrupt_part=1". Injection is seeded by `seed`.
     fault_plan: str | None = None
+    # -- object-store I/O (io/objectstore.py; ``emu://``/registered
+    # scheme URLs as source or output). All four shape WHEN and HOW
+    # bytes move, never what a run computes — SIG_NEUTRAL.
+    # Per-attempt deadline cap on every object-store op, seconds: a
+    # wedged GET/PUT can cost at most this before the retry/hedge
+    # machinery takes over (becomes RetryPolicy.deadline_s via
+    # utils/faults.default_io_retry_policy).
+    object_timeout_s: float = 30.0
+    # Hedged-read floor, milliseconds: once the live per-URL latency
+    # histogram is warm, a ranged GET outlasting max(p95, this) fires
+    # one duplicate GET (first-wins, loser cancelled). 0 disables
+    # hedging.
+    object_hedge_ms: float = 50.0
+    # Egress chunking: frames per chunk object. Resume reads the value
+    # from the durable manifest, so changing it mid-run cannot tear a
+    # resumed store.
+    object_chunk_frames: int = 64
+    # Multipart threshold/part size, bytes: chunk blobs larger than
+    # this upload as staged multipart parts of this size.
+    object_part_bytes: int = 8 << 20
 
     # -- execution ---------------------------------------------------------
     batch_size: int = 32  # frames per jitted device step
@@ -753,6 +773,26 @@ class CorrectorConfig:
             from kcmc_tpu.utils.faults import FaultPlan
 
             FaultPlan.from_spec(self.fault_plan)
+        if self.object_timeout_s <= 0.0:
+            raise ValueError(
+                f"object_timeout_s must be positive seconds, got "
+                f"{self.object_timeout_s}"
+            )
+        if self.object_hedge_ms < 0.0:
+            raise ValueError(
+                "object_hedge_ms must be >= 0 milliseconds (0 disables "
+                f"hedging), got {self.object_hedge_ms}"
+            )
+        if self.object_chunk_frames < 1:
+            raise ValueError(
+                f"object_chunk_frames must be >= 1 frame, got "
+                f"{self.object_chunk_frames}"
+            )
+        if self.object_part_bytes < 1:
+            raise ValueError(
+                f"object_part_bytes must be >= 1 byte, got "
+                f"{self.object_part_bytes}"
+            )
         if self.serve_queue_depth < 1:
             raise ValueError(
                 f"serve_queue_depth must be >= 1 frame, got "
@@ -951,6 +991,13 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "retry_jitter",
         "failover_backend",
         "degrade_mark_failed",
+        # Object-store I/O (PR 17): deadline/hedge/chunking knobs move
+        # bytes differently, never change the frames; egress chunking
+        # is pinned by the durable manifest across resumes.
+        "object_timeout_s",
+        "object_hedge_ms",
+        "object_chunk_frames",
+        "object_part_bytes",
         "writer_depth",
         "io_workers",
         "io_prefetch",

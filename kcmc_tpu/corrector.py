@@ -97,7 +97,19 @@ def _input_fingerprint(path) -> list:
     stat is a filesystem constant (size fixed, mtime untouched by
     in-place chunk rewrites), so fingerprint the entries instead —
     total bytes and the newest mtime across the tree — which changes
-    whenever any chunk is rewritten."""
+    whenever any chunk is rewritten. Object-store URLs have no stat
+    identity; their manifest checksum is the content fingerprint."""
+    from kcmc_tpu.io.objectstore import is_object_url
+
+    if is_object_url(path):
+        from kcmc_tpu.io.objectstore import (
+            MANIFEST_KEY,
+            client_for_url,
+            sha256_hex,
+        )
+
+        client = client_for_url(path)
+        return ["object", sha256_hex(client.get(MANIFEST_KEY))]
     st = os.stat(path)
     if not os.path.isdir(path):
         return [int(st.st_size), int(st.st_mtime_ns)]
@@ -832,32 +844,25 @@ class MotionCorrector:
         or KCMC_FAULT_PLAN env var), the retry policy, and a fresh
         RobustnessReport. Called at the top of correct/correct_file so
         injection counters and telemetry are run-scoped."""
-        from kcmc_tpu.utils.faults import RetryPolicy, resolve_fault_plan
+        from kcmc_tpu.utils.faults import (
+            default_io_retry_policy,
+            resolve_fault_plan,
+        )
         from kcmc_tpu.utils.metrics import RobustnessReport
 
         cfg = self.config
         self._fault_plan = resolve_fault_plan(cfg.fault_plan, seed=cfg.seed)
-
-        def policy(seed):
-            return RetryPolicy(
-                attempts=cfg.retry_attempts,
-                backoff_s=cfg.retry_backoff_s,
-                backoff_max_s=cfg.retry_backoff_max_s,
-                jitter=cfg.retry_jitter,
-                seed=seed,
-            )
-
-        if cfg.retry_attempts > 1:
-            # Separate instances per surface: the device policy runs in
-            # the main thread, the io policy in the prefetch thread —
-            # numpy Generators are not thread-safe, and per-surface
-            # streams keep the jitter sequences seed-deterministic
-            # regardless of thread interleaving.
-            self._retry_policy = policy(cfg.seed)
-            self._io_retry_policy = policy(cfg.seed + 1)
-        else:
-            self._retry_policy = None
-            self._io_retry_policy = None
+        # Separate instances per surface: the device policy runs in the
+        # main thread, the io policy in the prefetch thread — numpy
+        # Generators are not thread-safe, and per-surface streams keep
+        # the jitter sequences seed-deterministic regardless of thread
+        # interleaving. Both come from default_io_retry_policy, THE
+        # single construction point shared with reader/feeder/object
+        # paths, so backoff/jitter/deadline semantics cannot drift
+        # between ingest surfaces (the device surface reuses it with
+        # offset 0 — same policy shape, its own jitter stream).
+        self._retry_policy = default_io_retry_policy(cfg, seed_offset=0)
+        self._io_retry_policy = default_io_retry_policy(cfg, seed_offset=1)
         self._robustness = RobustnessReport()
         self._out_template = None
         # Drop the previous run's cached failover reference — it pins a
@@ -2120,6 +2125,21 @@ class MotionCorrector:
             n_threads=n_threads if n_threads else cfg.io_workers,
             **(reader_options or {}),
         ) as ts:
+            if hasattr(ts, "arm") and hasattr(ts, "stats_snapshot"):
+                # object-store source: push the run's robustness wiring
+                # into the client — the shared fault plan, the io retry
+                # policy (deadline-capped), retry/quarantine accounting
+                # into the RobustnessReport, and the hedge knobs
+                ts.arm(
+                    fault_plan=self._fault_plan,
+                    retry=self._io_retry_policy,
+                    report=self._robustness,
+                    tracer=(
+                        telemetry.tracer if telemetry is not None else None
+                    ),
+                    hedge_ms=cfg.object_hedge_ms,
+                    timeout_s=cfg.object_timeout_s,
+                )
             if telemetry is not None:
                 telemetry.set_total(len(ts))
             with timer.stage("prepare_reference"):
@@ -2156,6 +2176,26 @@ class MotionCorrector:
             writer = None
             start = 0
             ckpt_sig = None
+            from kcmc_tpu.io.objectstore import is_object_url
+
+            object_opts = None
+            if output is not None and is_object_url(output):
+                from kcmc_tpu.utils.faults import default_io_retry_policy
+
+                # Egress-side robustness wiring: its OWN retry policy
+                # instance (seed_offset=2) — uploads run on the
+                # AsyncBatchWriter worker thread, and numpy Generators
+                # are not thread-safe across the read-side policy.
+                object_opts = {
+                    "chunk_frames": cfg.object_chunk_frames,
+                    "part_bytes": cfg.object_part_bytes,
+                    "fault_plan": self._fault_plan,
+                    "retry": default_io_retry_policy(cfg, seed_offset=2),
+                    "report": self._robustness,
+                    "tracer": (
+                        telemetry.tracer if telemetry is not None else None
+                    ),
+                }
             if checkpoint is not None:
                 from kcmc_tpu.utils.checkpoint import load_stream_checkpoint
 
@@ -2178,7 +2218,12 @@ class MotionCorrector:
                     # mismatched rerun restarts instead of silently
                     # mixing two runs' frames.
                     "backend": self.backend_name,
-                    "output": os.path.abspath(output),
+                    # object URLs are already absolute identities;
+                    # abspath would glue the cwd onto the scheme
+                    "output": (
+                        str(output) if object_opts is not None
+                        else os.path.abspath(output)
+                    ),
                     "reference": _fingerprint(self.reference),
                     "reference_window": self.reference_window,
                     "template_iters": self.template_iters,
@@ -2201,7 +2246,8 @@ class MotionCorrector:
                         from kcmc_tpu.io.formats import resume_writer
 
                         writer = resume_writer(
-                            output, meta["writer"], compression=compression
+                            output, meta["writer"], compression=compression,
+                            object_opts=object_opts,
                         )
                         start = int(meta["done"])
                         outs = segments
@@ -2240,6 +2286,7 @@ class MotionCorrector:
                     output, len(ts), ts.frame_shape, out_dt,
                     compression=compression,
                     bigtiff=_wants_bigtiff(len(ts), ts.frame_shape, out_dt),
+                    object_opts=object_opts,
                 )
             if writer is not None and cfg.writer_depth > 0:
                 # Overlapped writeback: encode+write runs on a bounded
@@ -2635,13 +2682,25 @@ class MotionCorrector:
             "template_updates": n_updates,
             "device_templates": bool(dev_tmpl),
         }
-        if feed_stats.get("chunks"):
+        obj_stats = {}
+        if hasattr(ts, "stats_snapshot") and hasattr(ts, "arm"):
+            # object-store ingest counters (hedges, retries, throttles,
+            # live p95) — aggregated module-wide per URL, so thread-
+            # flavor pool workers and the consumer land in one snapshot
+            obj_stats["ingest"] = ts.stats_snapshot()
+        if object_opts is not None:
+            from kcmc_tpu.io.objectstore import stats_snapshot as _obj_snap
+
+            obj_stats["egress"] = _obj_snap(str(output))
+        if feed_stats.get("chunks") or obj_stats:
             # pooled-ingest accounting (io/feeder.py): rendered by the
             # CLI summary, `kcmc_tpu report`, and bench --hostfed
             feed_stats.pop("single_core_advised", None)
             timing["feeder"] = dict(
                 feed_stats, prefetch_chunks=feed_prefetch
             )
+            if obj_stats:
+                timing["feeder"]["object"] = obj_stats
         if checkpoint is not None:
             timing["restored_frames"] = restored
         transforms = merged.pop("transform", None)

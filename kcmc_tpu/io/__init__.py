@@ -13,6 +13,12 @@ from kcmc_tpu.io.formats import (
     ZarrStack,
     open_stack,
 )
+from kcmc_tpu.io.objectstore import (
+    EmulatedObjectStore,
+    ObjectStack,
+    ObjectStoreWriter,
+    put_stack,
+)
 from kcmc_tpu.io.reader import ChunkedStackLoader
 from kcmc_tpu.io.tiff import TiffStack, read_stack, write_stack
 
@@ -21,13 +27,17 @@ __all__ = [
     "AsyncBatchWriter",
     "ChunkedStackLoader",
     "DecodePool",
+    "EmulatedObjectStore",
     "HDF5Stack",
     "NpyStack",
+    "ObjectStack",
+    "ObjectStoreWriter",
     "RawStack",
     "TiffStack",
     "ZarrStack",
     "feeder",
     "open_stack",
+    "put_stack",
     "read_stack",
     "write_stack",
 ]

@@ -5,7 +5,12 @@ callback on the consumer thread, so TIFF/Zarr/HDF5 encode+write
 serialized with device dispatch — every page written was a page the
 accelerator waited for. `AsyncBatchWriter` wraps any streaming writer
 (the TiffWriter protocol: `append_batch` / `checkpoint_state` /
-`close`) with a bounded FIFO queue and one worker thread:
+`close`) with a bounded FIFO queue and one worker thread. The
+object-store egress path (io/objectstore.py ObjectStoreWriter) rides
+this unchanged — its multipart uploads and retry backoff run on the
+worker thread here, overlapping network time with device dispatch, and
+`checkpoint_state`'s flush-first contract is exactly what makes its
+manifest a durable high-water mark:
 
 * appends ENQUEUE and return immediately; a full queue blocks the
   caller (backpressure — bounded memory, and the blocked time is

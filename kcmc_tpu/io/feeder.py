@@ -133,6 +133,7 @@ def classify_source(source) -> str | None:
     not thread-safe, and memmap-backed sources are one memcpy.
     """
     from kcmc_tpu.io.formats import ZarrStack, _MiniZarr
+    from kcmc_tpu.io.objectstore import ObjectStack
     from kcmc_tpu.io.tiff import TiffStack
 
     if isinstance(source, TiffStack):
@@ -143,6 +144,13 @@ def classify_source(source) -> str | None:
         inner = source.source
         if isinstance(inner, _MiniZarr):
             return "process" if inner._zlib else "thread"
+    if isinstance(source, ObjectStack):
+        # ranged GETs block on I/O (GIL released in socket/file ops);
+        # deflate chunks add GIL-bound zlib decode on top, so they pay
+        # for real interpreters. Thread workers also share the per-URL
+        # hedge/latency state with the consumer; process workers keep
+        # their own (documented in PERFORMANCE.md).
+        return "process" if source.compression == "deflate" else "thread"
     return None
 
 
@@ -151,7 +159,13 @@ def source_spec(source, source_path, reader_options: dict | None):
     the source has no cross-process identity (in-memory arrays, reader
     objects without a path). Python-decode TIFF sources pin
     ``force_python=True`` so no worker races to build (or silently
-    switches to) the native decoder mid-run."""
+    switches to) the native decoder mid-run. Object-store sources
+    respec by URL — each worker's `open_stack` builds a per-worker
+    client connection (and self-arms any ``KCMC_FAULT_PLAN``)."""
+    from kcmc_tpu.io.objectstore import ObjectStack
+
+    if isinstance(source, ObjectStack):
+        return ("stack", source.path, ())
     if source_path is None:
         return None
     from kcmc_tpu.io.tiff import TiffStack
@@ -314,8 +328,20 @@ def pooled_chunks(
     `report.io_retries`. `on_wait(seconds)` fires when the consumer
     actually blocked on the head chunk (the `prefetch_wait` stall);
     `tracer` records one `feeder.decode` span per chunk.
+
+    `retry` is a utils/faults.RetryPolicy, the string ``"default"``
+    (resolved through `utils.faults.default_io_retry_policy` — THE
+    shared ingest-surface construction point, so backoff/jitter/
+    classification cannot drift between reader, feeder, and the
+    object-store path), or None (read exactly once).
     """
-    from kcmc_tpu.utils.faults import classify_transient
+    from kcmc_tpu.utils.faults import (
+        classify_transient,
+        default_io_retry_policy,
+    )
+
+    if retry == "default":
+        retry = default_io_retry_policy(None)
 
     if stats is not None:
         stats["chunks"] = stats.get("chunks", 0)

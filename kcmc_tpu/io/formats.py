@@ -530,9 +530,21 @@ class HDF5Writer:
 def make_writer(
     output, n_frames: int, frame_shape: tuple, dtype,
     compression: str = "none", bigtiff: bool = False,
+    object_opts: dict | None = None,
 ):
     """Streaming-writer factory: dispatch on the output extension
-    (.zarr -> ZarrWriter, .h5/.hdf5 -> HDF5Writer, else TiffWriter)."""
+    (.zarr -> ZarrWriter, .h5/.hdf5 -> HDF5Writer, object-store URLs
+    -> ObjectStoreWriter, else TiffWriter). `object_opts` carries the
+    object-path robustness wiring (chunk_frames/part_bytes/fault_plan/
+    retry/report/tracer/client) and applies to URL outputs only."""
+    from kcmc_tpu.io import objectstore
+
+    if objectstore.is_object_url(output):
+        opts = dict(object_opts or {})
+        return objectstore.ObjectStoreWriter(
+            output, n_frames, frame_shape, dtype,
+            compression=compression, **opts,
+        )
     out = os.fspath(output).lower()
     if out.endswith(".zarr"):
         return ZarrWriter(
@@ -547,8 +559,17 @@ def make_writer(
     return TiffWriter(output, compression=compression, bigtiff=bigtiff)
 
 
-def resume_writer(output, state: dict, compression: str = "none"):
+def resume_writer(
+    output, state: dict, compression: str = "none",
+    object_opts: dict | None = None,
+):
     """Resume-side counterpart of `make_writer`."""
+    from kcmc_tpu.io import objectstore
+
+    if objectstore.is_object_url(output):
+        return objectstore.ObjectStoreWriter.resume(
+            output, state, compression=compression, object_opts=object_opts
+        )
     out = os.fspath(output).lower()
     if out.endswith(".zarr"):
         return ZarrWriter.resume(output, state, compression=compression)
@@ -564,11 +585,12 @@ def open_stack(source, n_threads: int = 0, **reader_options):
     protocol.
 
     source: a path (dispatched on extension: .tif/.tiff, .zarr
-    directory, .h5/.hdf5, .npy, .raw/.bin/.dat), an object already
-    implementing the protocol (returned as-is), or an array-like
-    (wrapped in ArrayStack). reader_options are format-specific
-    (HDF5Stack's ``dataset``, RawStack's ``shape``/``dtype``/
-    ``offset``).
+    directory, .h5/.hdf5, .npy, .raw/.bin/.dat), an object-store URL
+    (``emu://...`` -> ObjectStack over the chunked bucket layout), an
+    object already implementing the protocol (returned as-is), or an
+    array-like (wrapped in ArrayStack). reader_options are
+    format-specific (HDF5Stack's ``dataset``, RawStack's ``shape``/
+    ``dtype``/``offset``).
     """
     def no_options(fmt):
         # Silently absorbing options a format doesn't take would let a
@@ -585,6 +607,11 @@ def open_stack(source, n_threads: int = 0, **reader_options):
         if hasattr(source, "read") and hasattr(source, "frame_shape"):
             return source  # already a protocol reader
         return ArrayStack(source)
+    from kcmc_tpu.io import objectstore
+
+    if objectstore.is_object_url(source):
+        no_options("object-store")
+        return objectstore.ObjectStack(source, n_threads=n_threads)
     path = os.fspath(source)
     ext = os.path.splitext(path)[1].lower()
     if ext in (".tif", ".tiff"):

@@ -39,7 +39,12 @@ class ChunkedStackLoader:
     (utils/faults.FaultPlan, utils/faults.RetryPolicy,
     utils/metrics.RobustnessReport) — chunk reads are retried per the
     policy, injected faults fire per the plan, retries are counted in
-    the report. All None by default: the bare loader reads exactly once.
+    the report. All None by default: the bare loader reads exactly
+    once. ``retry="default"`` resolves through
+    `utils.faults.default_io_retry_policy`, the shared ingest-surface
+    construction point (corrector runs, the feeder, and the
+    object-store path all build theirs there, so backoff/jitter/
+    classification cannot drift between surfaces).
 
     on_wait: optional callback(seconds) invoked whenever the CONSUMER
     blocks waiting for the prefetch thread — the pipeline-stall
@@ -92,6 +97,10 @@ class ChunkedStackLoader:
         self.chunk_size = chunk_size
         self.prefetch = max(1, prefetch)
         self._fault_plan = fault_plan
+        if retry == "default":
+            from kcmc_tpu.utils.faults import default_io_retry_policy
+
+            retry = default_io_retry_policy(None)
         self._retry = retry
         self._report = report
         self._on_wait = on_wait

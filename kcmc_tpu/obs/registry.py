@@ -102,6 +102,12 @@ REQUEST_SEGMENTS = frozenset(
 # the PR-14 `journal_save`/`journal_resume` instants.
 JOURNAL_SPANS = frozenset({"journal.save", "journal.resume"})
 
+# Object-store I/O spans (io/objectstore.py, cat "object"): one
+# `object.get` per ObjectStack.read (covers every chunk GET it issued,
+# hedges included — args carry lo/hi), one `object.put` per verified
+# chunk/manifest upload (args carry key/bytes).
+OBJECT_SPANS = frozenset({"object.get", "object.put"})
+
 # Fleet-router DURATION spans (serve/router.py): latency segments the
 # router records into its own SegmentLatencies — `fleet.migrate` is
 # one whole session migration (pick survivor -> resume_session ->
@@ -121,6 +127,7 @@ SPAN_NAMES = (
     | REQUEST_SEGMENTS
     | JOURNAL_SPANS
     | FLEET_SPANS
+    | OBJECT_SPANS
 )
 
 # -- timing payload keys ---------------------------------------------------

@@ -42,10 +42,18 @@ Spec grammar (see docs/ROBUSTNESS.md for the full reference)::
     plan    := clause ("," clause)*
     clause  := surface (":" token)*
     surface := io_read | device | failover | checkpoint
-              | transport | scheduler | journal | fleet
+              | transport | scheduler | journal | fleet | object
     token   := key "=" value | action
     action  := transient (default) | fatal | raise (alias of fatal)
               | always (alias of times=inf)
+              | drop (object only; alias of transient — a dropped
+                connection)
+              | truncate (object only: the op returns a TRUNCATED
+                body instead of raising)
+              | flip (object only: one bit of the returned body is
+                flipped — checksum/etag-mismatch simulation)
+              | throttle (object only: the op raises a 429/503-style
+                ObjectStoreThrottled, retried with backoff)
     keys    := step=N          which operation of that surface fails
                                (0-based; omitted = every operation)
                times=N|inf     how many matching ATTEMPTS fail before
@@ -54,12 +62,21 @@ Spec grammar (see docs/ROBUSTNESS.md for the full reference)::
                                probability F (seeded, deterministic)
                corrupt_part=N  checkpoint surface only: corrupt part
                                file N on disk before it is loaded
-               stall=SECS      transport/scheduler/fleet surfaces
-                               only: the matched operation STALLS for
-                               SECS seconds instead of raising
-                               (half-open socket / wedged scheduler /
-                               stalled health scrape simulation;
-                               consumed via `take_stall`)
+               stall=SECS      transport/scheduler/fleet/object
+                               surfaces only: the matched operation
+                               STALLS for SECS seconds instead of
+                               raising (half-open socket / wedged
+                               scheduler / stalled health scrape /
+                               slow object GET simulation; consumed
+                               via `take_stall`)
+
+The ``object`` surface (PR 17) is armed inside the object-store
+client (`io/objectstore.py` — the emulator and any real client built
+on it): every GET/PUT/multipart op draws one op index, and the client
+interprets the clause action itself via `take_action` — raising
+actions become dropped-connection/throttle errors, ``truncate`` and
+``flip`` mangle the returned/stored body so the checksum layer has
+something real to catch.
 
 Example — the chaos trifecta::
 
@@ -94,10 +111,19 @@ SURFACES = (
     # raising = replica blackhole / migration failure, stall= =
     # health-scrape stall
     "fleet",
+    # object-store surface (PR 17): client GET/PUT/multipart ops in
+    # io/objectstore.py — drop/throttle raise, truncate/flip mangle
+    # bodies, stall= simulates a slow ranged GET (what hedged reads
+    # absorb)
+    "object",
 )
 
 # Surfaces whose clauses may carry stall=SECS (wedge, don't raise).
-_STALL_SURFACES = ("transport", "scheduler", "fleet")
+_STALL_SURFACES = ("transport", "scheduler", "fleet", "object")
+
+# Actions only the object-store client knows how to apply (consumed
+# via `take_action`, never raised by `maybe_fail`).
+_OBJECT_ACTIONS = ("truncate", "flip", "throttle")
 
 
 class FaultError(RuntimeError):
@@ -213,16 +239,21 @@ def _parse_clause(text: str) -> _Clause:
                     f"unknown fault-clause key {key!r} in {text!r} "
                     "(known: step, times, p, corrupt_part, stall)"
                 )
-        elif tok in ("transient",):
+        elif tok in ("transient", "drop"):
+            # "drop" reads as a dropped connection on the object
+            # surface; both classify transient and retry identically
             c.action = "transient"
         elif tok in ("fatal", "raise"):
             c.action = "fatal"
+        elif tok in _OBJECT_ACTIONS:
+            c.action = tok
         elif tok == "always":
             c.times = math.inf
         else:
             raise ValueError(
                 f"unknown fault-clause token {tok!r} in {text!r} "
-                "(actions: transient, fatal/raise, always)"
+                "(actions: transient/drop, fatal/raise, always, "
+                "truncate, flip, throttle)"
             )
     if c.corrupt_part is not None and c.surface != "checkpoint":
         raise ValueError(
@@ -236,6 +267,10 @@ def _parse_clause(text: str) -> _Clause:
         raise ValueError(
             f"stall= applies to the {'/'.join(_STALL_SURFACES)} surfaces "
             f"only ({text!r})"
+        )
+    if c.action in _OBJECT_ACTIONS and c.surface != "object":
+        raise ValueError(
+            f"{c.action} applies to the object surface only ({text!r})"
         )
     return c
 
@@ -327,6 +362,18 @@ class FaultPlan:
             c = self._take_clause(surface, step, stall=True)
             return float(c.stall) if c is not None else 0.0
 
+    def take_action(self, surface: str, step: int | None = None) -> str | None:
+        """Consume a matching non-stall clause and return its ACTION
+        string instead of raising (None = nothing fired). The object
+        surface consumes its clauses this way: the object-store client
+        interprets the action itself (transient/fatal -> raise,
+        truncate/flip -> mangle the body, throttle -> a 429-style
+        error) — injection stays inside the client, so every consumer
+        of the client exercises the same failure modes."""
+        with self._lock:
+            c = self._take_clause(surface, step, stall=False)
+            return c.action if c is not None else None
+
     # -- checkpoint surface ------------------------------------------------
 
     def take_checkpoint_corruption(self, part_index: int) -> bool:
@@ -374,6 +421,14 @@ class RetryPolicy:
     ``backoff_s * 2**k`` clipped to `backoff_max_s`, multiplied by a
     uniform jitter in ``[1 - jitter, 1 + jitter]`` so a fleet of
     workers retrying a shared dependency doesn't thundering-herd it.
+
+    `deadline_s` is the PER-ATTEMPT deadline cap for operations that
+    can wedge rather than fail (a stalled object-store GET): clients
+    that can enforce it (io/objectstore.py passes it into every
+    client op) time the attempt out as a transient error, so one
+    wedged request costs at most deadline_s before the retry/hedge
+    machinery takes over. None = no cap (local-file reads fail fast
+    on their own).
     """
 
     attempts: int = 3
@@ -381,6 +436,7 @@ class RetryPolicy:
     backoff_max_s: float = 2.0
     jitter: float = 0.25
     seed: int = 0
+    deadline_s: float | None = None  # per-attempt cap (object I/O)
     sleep: object = time.sleep  # injectable for tests
 
     def __post_init__(self):
@@ -392,3 +448,32 @@ class RetryPolicy:
             return base
         lo, hi = 1.0 - self.jitter, 1.0 + self.jitter
         return base * float(self._rng.uniform(lo, hi))
+
+
+def default_io_retry_policy(cfg=None, seed_offset: int = 1):
+    """THE ingest-surface retry policy — the single construction point
+    shared by `corrector._begin_robust_run` (which hands it to
+    `io/reader.py` and the feeder), and the object-store path
+    (`io/objectstore.py` builds one for standalone readers/writers).
+    One construction site means backoff/jitter/deadline semantics
+    cannot drift between ingest surfaces.
+
+    `cfg` is any object with the CorrectorConfig retry fields
+    (duck-typed — no config import, so standalone io users can pass
+    None for the defaults). Returns None when retries are disabled
+    (``retry_attempts <= 1``), mirroring the corrector's contract.
+    `seed_offset` keeps the io jitter stream distinct from the device
+    policy's (separate instances per thread: numpy Generators are not
+    thread-safe)."""
+    if cfg is None:
+        return RetryPolicy(seed=seed_offset)
+    if int(cfg.retry_attempts) <= 1:
+        return None
+    return RetryPolicy(
+        attempts=cfg.retry_attempts,
+        backoff_s=cfg.retry_backoff_s,
+        backoff_max_s=cfg.retry_backoff_max_s,
+        jitter=cfg.retry_jitter,
+        seed=int(cfg.seed) + seed_offset,
+        deadline_s=getattr(cfg, "object_timeout_s", None),
+    )

@@ -73,6 +73,67 @@ def test_fault_plan_step_and_times_semantics():
     assert plan.op_index("io_read") == 0  # per-surface counters
 
 
+def test_object_surface_grammar():
+    plan = FaultPlan.from_spec(
+        "object:step=0:drop, object:step=1:truncate, object:step=2:flip, "
+        "object:step=3:throttle, object:step=4:stall=0.25"
+    )
+    drop, trunc, flip, thr, stall = plan.clauses
+    # drop is an alias of transient: a dropped connection classifies
+    # transient and retries like any wire fault
+    assert (drop.surface, drop.action) == ("object", "transient")
+    assert trunc.action == "truncate"
+    assert flip.action == "flip"
+    assert thr.action == "throttle"
+    assert stall.stall == pytest.approx(0.25)
+
+
+def test_object_actions_rejected_on_other_surfaces():
+    for act in ("truncate", "flip", "throttle"):
+        with pytest.raises(ValueError, match="object surface only"):
+            FaultPlan.from_spec(f"io_read:step=0:{act}")
+
+
+def test_take_action_consumes_matching_clause():
+    plan = FaultPlan.from_spec("object:step=1:flip:times=2")
+    assert plan.take_action("object", 0) is None  # wrong step
+    assert plan.take_action("object", 1) == "flip"
+    assert plan.take_action("object", 1) == "flip"
+    assert plan.take_action("object", 1) is None  # spent after times=2
+    assert plan.injected == 2
+    # raising clauses stay on the maybe_fail path: take_action returns
+    # their action string for the client to raise itself
+    plan2 = FaultPlan.from_spec("object:step=0:drop")
+    assert plan2.take_action("object", 0) == "transient"
+
+
+def test_object_stall_rides_take_stall():
+    plan = FaultPlan.from_spec("object:step=2:stall=0.5")
+    assert plan.take_stall("object", 1) == 0.0
+    assert plan.take_stall("object", 2) == pytest.approx(0.5)
+    assert plan.take_stall("object", 2) == 0.0  # consumed
+
+
+def test_default_io_retry_policy_single_construction_point():
+    from kcmc_tpu.config import CorrectorConfig
+    from kcmc_tpu.utils.faults import default_io_retry_policy
+
+    # cfg-less: standalone readers get the stock policy shape
+    p = default_io_retry_policy(None)
+    assert p.attempts == 3 and p.deadline_s is None
+    # cfg-driven: knobs + the per-attempt deadline cap flow through,
+    # and the seed offset keeps per-surface jitter streams distinct
+    cfg = CorrectorConfig(
+        retry_attempts=5, retry_backoff_s=0.01, retry_backoff_max_s=0.1,
+        retry_jitter=0.5, seed=11, object_timeout_s=7.5,
+    )
+    p2 = default_io_retry_policy(cfg, seed_offset=2)
+    assert (p2.attempts, p2.backoff_s, p2.backoff_max_s) == (5, 0.01, 0.1)
+    assert p2.seed == 13 and p2.deadline_s == 7.5
+    # retry disabled -> None, same contract the corrector relied on
+    assert default_io_retry_policy(cfg.replace(retry_attempts=1)) is None
+
+
 def test_config_validates_fault_plan_eagerly():
     with pytest.raises(ValueError, match="unknown fault surface"):
         MotionCorrector(model="translation", fault_plan="nope:1")

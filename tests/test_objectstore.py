@@ -1,0 +1,517 @@
+"""Cloud-ingest/egress resilience (io/objectstore.py): the emulated
+object store, hedged/retried/verified range reads, the `object` fault
+surface, and crash-resumable sharded egress with the durable
+high-water-mark manifest — the chaos contract is byte-identity: a
+fault-storm run and a kill+resume run must both produce exactly the
+chunk set of an uninterrupted run, with zero lost or duplicated
+frames."""
+
+import hashlib
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.io import ChunkedStackLoader, open_stack, put_stack
+from kcmc_tpu.io import objectstore
+from kcmc_tpu.io.formats import make_writer, resume_writer
+from kcmc_tpu.io.objectstore import (
+    MANIFEST_KEY,
+    PREV_MANIFEST_KEY,
+    _HEDGE_WARMUP,
+    EmulatedObjectStore,
+    ObjectIntegrityError,
+    ObjectNotFound,
+    ObjectStack,
+    ObjectStoreThrottled,
+    ObjectStoreWriter,
+    client_for_url,
+    load_manifest,
+    reset_url_state,
+    stats_snapshot,
+)
+from kcmc_tpu.utils import synthetic
+from kcmc_tpu.utils.faults import FaultPlan, RetryPolicy, classify_transient
+from kcmc_tpu.utils.metrics import (
+    RobustnessReport,
+    relative_transforms,
+    transform_rmse,
+)
+
+SHAPE = (128, 128)
+T = 24
+# near-zero backoff: these tests exercise retry LOGIC, not the sleeps
+FAST = RetryPolicy(seed=0, backoff_s=1e-4, backoff_max_s=2e-4)
+FAST_CFG = dict(retry_backoff_s=1e-4, retry_backoff_max_s=2e-4)
+
+
+@pytest.fixture(scope="module")
+def drift():
+    return synthetic.make_drift_stack(
+        n_frames=T, shape=SHAPE, model="translation", max_drift=5.0, seed=7
+    )
+
+
+@pytest.fixture(scope="module")
+def arr(drift):
+    return np.clip(drift.stack * 40000, 0, 65535).astype(np.uint16)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_url_state():
+    # hedge histograms / counters are module-global per URL; isolate
+    # tests from each other's latency history.  Joining the lazy hedge
+    # pool keeps kcmc-objget workers from outliving the test (the
+    # --sanitize leak checker would flag them).
+    reset_url_state()
+    yield
+    objectstore._shutdown_hedge_pool(wait=True)
+    reset_url_state()
+
+
+def _fast(url, **arm):
+    return ObjectStack(url).arm(retry=FAST, **arm)
+
+
+def _chunkset(client, prefix=""):
+    """{key: sha} of a stack's data objects + current manifest — the
+    byte-identity unit (the .prev generation is a rewind artifact)."""
+    return {
+        k: hashlib.sha256(client.get(k)).hexdigest()
+        for k in client.list(prefix)
+        if not k.endswith(PREV_MANIFEST_KEY)
+    }
+
+
+# -- emulator + layout -----------------------------------------------------
+
+
+def test_roundtrip_and_ranged_reads(tmp_path):
+    rng = np.random.default_rng(0)
+    stack = rng.integers(0, 60000, (50, 8, 9), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=7)
+    with open_stack(url) as ts:
+        assert len(ts) == 50
+        assert ts.frame_shape == (8, 9)
+        assert ts.dtype == np.uint16
+        np.testing.assert_array_equal(ts.read(0, 50), stack)
+        # spans crossing chunk boundaries, single frames, tails
+        np.testing.assert_array_equal(ts.read(3, 23), stack[3:23])
+        np.testing.assert_array_equal(ts.read(6, 8), stack[6:8])
+        np.testing.assert_array_equal(ts.read(49, 50), stack[49:50])
+    # raw layout: sub-chunk spans move as genuine range requests (one
+    # GET per touched chunk, not per frame)
+    snap = stats_snapshot(url)
+    assert snap["gets"] >= 4
+
+
+def test_deflate_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    stack = rng.integers(0, 60000, (30, 8, 9), dtype=np.uint16)
+    url = f"emu://{tmp_path}/bz"
+    put_stack(url, stack, chunk_frames=7, compression="deflate")
+    with open_stack(url) as ts:
+        assert ts.compression == "deflate"
+        np.testing.assert_array_equal(ts.read(5, 26), stack[5:26])
+
+
+def test_multipart_staging_invisible_until_complete(tmp_path):
+    store = EmulatedObjectStore(tmp_path / "b")
+    uid = store.multipart_begin("big")
+    store.multipart_put_part("big", uid, 0, b"aaaa")
+    store.multipart_put_part("big", uid, 1, b"bbbb")
+    # staged parts are not listable objects — a kill here leaves no
+    # torn "big"
+    assert store.list("") == []
+    with pytest.raises(ObjectNotFound):
+        store.head("big")
+    etag = store.multipart_complete("big", uid, 2)
+    assert store.get("big") == b"aaaabbbb"
+    assert etag == hashlib.sha256(b"aaaabbbb").hexdigest()
+    # a missing part fails complete instead of assembling garbage
+    uid2 = store.multipart_begin("torn")
+    store.multipart_put_part("torn", uid2, 0, b"x")
+    with pytest.raises(OSError, match="missing part"):
+        store.multipart_complete("torn", uid2, 2)
+    store.multipart_abort("torn", uid2)
+    assert store.list("") == ["big"]
+
+
+def test_unregistered_scheme_points_at_the_seam(tmp_path):
+    with pytest.raises(ValueError, match="register_scheme"):
+        client_for_url("s3://bucket/stack")
+
+
+# -- fault surface: drop / stall / truncate / flip / throttle --------------
+
+
+def test_drop_is_retried_and_counted(tmp_path, request):
+    rng = np.random.default_rng(2)
+    stack = rng.integers(0, 60000, (40, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=8)
+    rep = RobustnessReport()
+    plan = FaultPlan.from_spec("object:step=2:drop", seed=1)
+    ts = _fast(url, fault_plan=plan, report=rep)
+    np.testing.assert_array_equal(ts.read(0, 40), stack)
+    assert rep.io_retries == 1
+    assert stats_snapshot(url)["retries"] == 1
+
+
+def test_throttle_retried_and_advises_once(tmp_path):
+    rng = np.random.default_rng(3)
+    stack = rng.integers(0, 60000, (40, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=8)
+    rep = RobustnessReport()
+    plan = FaultPlan.from_spec("object:step=1:throttle", seed=1)
+    ts = _fast(url, fault_plan=plan, report=rep)
+    with pytest.warns(RuntimeWarning, match="object-store path degrading"):
+        np.testing.assert_array_equal(ts.read(0, 40), stack)
+    assert stats_snapshot(url)["throttled"] == 1
+    # once per run: further reads must not re-warn
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ts.read(0, 8)
+    # the exception class itself classifies transient (OSError family)
+    assert classify_transient(ObjectStoreThrottled("429"))
+
+
+def test_bitflip_in_flight_refetches(tmp_path):
+    """A flipped body whose STORED copy is intact is wire corruption:
+    refetch, never quarantine."""
+    rng = np.random.default_rng(4)
+    stack = rng.integers(0, 60000, (40, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=8)
+    rep = RobustnessReport()
+    # step=2: ops 0/1 are the constructor's manifest GET+HEAD draw-free
+    # ops; the armed plan sees the first whole-chunk GET at index 0, so
+    # read a middle span whose second GET (index 1... ) — simplest: hit
+    # every chunk and let the clause land on one of the 5 GETs
+    plan = FaultPlan.from_spec("object:step=2:flip", seed=1)
+    ts = _fast(url, fault_plan=plan, report=rep)
+    np.testing.assert_array_equal(ts.read(0, 40), stack)
+    snap = stats_snapshot(url)
+    assert snap["refetched"] == 1
+    assert rep.quarantined_parts == []  # stored copy was fine
+
+
+def test_truncated_body_retried_on_ranged_get(tmp_path):
+    rng = np.random.default_rng(5)
+    stack = rng.integers(0, 60000, (40, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=8)
+    plan = FaultPlan.from_spec("object:step=0:truncate", seed=1)
+    ts = _fast(url, fault_plan=plan)
+    # sub-chunk span -> ranged GET; the exact-length check catches the
+    # short body and retries
+    np.testing.assert_array_equal(ts.read(3, 5), stack[3:5])
+    assert stats_snapshot(url)["retries"] == 1
+
+
+def test_stall_capped_by_per_attempt_deadline(tmp_path):
+    rng = np.random.default_rng(6)
+    stack = rng.integers(0, 60000, (16, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=4)
+    plan = FaultPlan.from_spec("object:step=1:stall=30", seed=1)
+    ts = ObjectStack(url).arm(
+        fault_plan=plan,
+        retry=RetryPolicy(seed=0, backoff_s=1e-4, deadline_s=0.05),
+    )
+    t0 = time.perf_counter()
+    np.testing.assert_array_equal(ts.read(0, 8), stack[:8])
+    # the wedged GET cost one deadline, not the 30 s stall
+    assert time.perf_counter() - t0 < 2.0
+
+
+def test_at_rest_corruption_quarantines_and_aborts(tmp_path):
+    rng = np.random.default_rng(7)
+    stack = rng.integers(0, 60000, (40, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=8)
+    client = client_for_url(url)
+    body = bytearray(client.get("chunk-00000001"))
+    body[4] ^= 0xFF
+    client.put("chunk-00000001", bytes(body))
+    rep = RobustnessReport()
+    ts = _fast(url, report=rep)
+    with pytest.raises(ObjectIntegrityError, match="quarantined"):
+        ts.read(0, 40)
+    assert client.list("chunk-00000001.corrupt") == [
+        "chunk-00000001.corrupt"
+    ]
+    assert len(rep.quarantined_parts) == 1
+
+
+# -- hedged reads ----------------------------------------------------------
+
+
+def test_hedge_fires_past_p95_and_first_wins(tmp_path):
+    rng = np.random.default_rng(8)
+    stack = rng.integers(0, 60000, (64, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=4)
+    ts = ObjectStack(url).arm(
+        retry=RetryPolicy(seed=0, backoff_s=1e-4, deadline_s=10.0),
+        hedge_ms=30.0,
+    )
+    # warm the live histogram with fast reads — hedging is disabled
+    # until p95 means something
+    for i in range(_HEDGE_WARMUP + 2):
+        ts.read(i % 60, i % 60 + 1)
+    assert stats_snapshot(url)["hedged"] == 0
+    # stall the next primary GET below the deadline but way past p95:
+    # the hedge fires, finishes first, and the read returns without
+    # waiting out the stalled primary
+    ts._client.fault_plan = FaultPlan.from_spec(
+        "object:step=0:stall=1.5", seed=1
+    )
+    t0 = time.perf_counter()
+    np.testing.assert_array_equal(ts.read(0, 4), stack[0:4])
+    assert time.perf_counter() - t0 < 1.4
+    snap = stats_snapshot(url)
+    assert snap["hedged"] >= 1
+    assert snap["hedge_wins"] >= 1
+    assert snap["p95_ms"] is not None
+
+
+def test_hedge_disabled_at_zero(tmp_path):
+    rng = np.random.default_rng(9)
+    stack = rng.integers(0, 60000, (64, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    put_stack(url, stack, chunk_frames=4)
+    ts = _fast(url, hedge_ms=0.0)
+    for i in range(_HEDGE_WARMUP + 4):
+        ts.read(i % 60, i % 60 + 1)
+    assert ts._hedge_threshold() is None
+    assert stats_snapshot(url)["hedged"] == 0
+
+
+# -- manifest durability ---------------------------------------------------
+
+
+def test_corrupt_manifest_quarantined_prev_generation_used(tmp_path):
+    rng = np.random.default_rng(10)
+    stack = rng.integers(0, 60000, (20, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    w = ObjectStoreWriter(url, 20, (6, 5), np.uint16, chunk_frames=8)
+    w.append_batch(stack)
+    w.close()
+    client = client_for_url(url)
+    good = load_manifest(client)
+    # mangle the CURRENT generation on disk; the prev generation (one
+    # chunk behind) must take over, quarantining the torn one
+    client.put(MANIFEST_KEY, client.get(MANIFEST_KEY)[:-20] + b"garbage!")
+    rep = RobustnessReport()
+    man = load_manifest(client, report=rep)
+    assert man["format"] == good["format"]
+    assert man["n_frames"] < good["n_frames"]  # rewound, not guessed
+    assert len(rep.quarantined_parts) == 1
+    assert client.list(MANIFEST_KEY + ".corrupt") == [
+        MANIFEST_KEY + ".corrupt"
+    ]
+    # both generations gone -> ObjectNotFound, never a fabricated stack
+    client.delete(MANIFEST_KEY)
+    client.delete(PREV_MANIFEST_KEY)
+    with pytest.raises(ObjectNotFound):
+        load_manifest(client)
+
+
+def test_torn_multipart_upload_retries_to_clean_copy(tmp_path):
+    """An injected truncate on a multipart part mangles the assembled
+    object; the writer's etag-verify catches it and re-uploads — the
+    durable copy is never the torn one."""
+    rng = np.random.default_rng(11)
+    stack = rng.integers(0, 60000, (8, 32, 32), dtype=np.uint16)
+    url = f"emu://{tmp_path}/b"
+    plan = FaultPlan.from_spec("object:step=1:truncate", seed=1)
+    w = ObjectStoreWriter(
+        url, 8, (32, 32), np.uint16, chunk_frames=8,
+        part_bytes=4096,  # 16 KiB chunk -> 4 multipart parts
+        fault_plan=plan, retry=FAST,
+    )
+    w.append_batch(stack)
+    w.close()
+    assert stats_snapshot(url)["retries"] >= 1
+    with open_stack(url) as ts:
+        np.testing.assert_array_equal(ts.read(0, 8), stack)
+
+
+def test_writer_resume_reuploads_only_past_high_water_mark(tmp_path):
+    rng = np.random.default_rng(12)
+    stack = rng.integers(0, 60000, (50, 8, 9), dtype=np.uint16)
+    url = f"emu://{tmp_path}/out"
+    w = make_writer(url, 50, (8, 9), np.uint16,
+                    object_opts={"chunk_frames": 7})
+    w.append_batch(stack[:10])
+    state = w.checkpoint_state()  # flushes the 3-frame partial tail
+    assert state == {"format": "object", "n_pages": 10,
+                     "zlib": state["zlib"]}
+    # abandon w (the kill); resume from the durable manifest
+    w2 = resume_writer(url, state, object_opts={"chunk_frames": 7})
+    assert w2.n_pages == 10
+    puts_before = stats_snapshot(url)["puts"]
+    w2.append_batch(stack[10:50])
+    w2.close()
+    # uninterrupted twin
+    url2 = f"emu://{tmp_path}/ref"
+    w3 = make_writer(url2, 50, (8, 9), np.uint16,
+                     object_opts={"chunk_frames": 7})
+    w3.append_batch(stack)
+    w3.close()
+    c1, c2 = client_for_url(url), client_for_url(url2)
+    assert _chunkset(c1) == _chunkset(c2)
+    # the resume re-uploaded the tail chunk + later chunks, NOT the
+    # full chunks already below the high-water mark
+    resumed_puts = stats_snapshot(url)["puts"] - puts_before
+    full_puts = stats_snapshot(url2)["puts"]
+    assert resumed_puts < full_puts
+    with open_stack(url) as ts:
+        np.testing.assert_array_equal(ts.read(0, 50), stack)
+
+
+def test_writer_resume_refuses_store_behind_cursor(tmp_path):
+    rng = np.random.default_rng(13)
+    stack = rng.integers(0, 60000, (20, 6, 5), dtype=np.uint16)
+    url = f"emu://{tmp_path}/out"
+    w = ObjectStoreWriter(url, 20, (6, 5), np.uint16, chunk_frames=8)
+    w.append_batch(stack[:8])
+    state = w.checkpoint_state()
+    # corrupt a durable chunk below the cursor: the frames are gone, so
+    # resume must refuse (OSError -> the corrector restarts from
+    # scratch) and quarantine the evidence
+    client = client_for_url(url)
+    client.put("chunk-00000000", b"not the chunk")
+    rep = RobustnessReport()
+    with pytest.raises(OSError, match="corrupt at resume"):
+        ObjectStoreWriter.resume(
+            url, state, object_opts={"report": rep, "retry": FAST}
+        )
+    assert rep.quarantined_parts
+    # a manifest behind the checkpoint cursor is equally unresumable
+    url2 = f"emu://{tmp_path}/out2"
+    w2 = ObjectStoreWriter(url2, 20, (6, 5), np.uint16, chunk_frames=8)
+    w2.append_batch(stack[:8])
+    w2.checkpoint_state()
+    with pytest.raises(OSError, match="behind the checkpoint cursor"):
+        ObjectStoreWriter.resume(url2, {"format": "object", "n_pages": 16})
+
+
+# -- end-to-end: correct_file over the emulator ----------------------------
+
+
+@pytest.fixture()
+def bucket(tmp_path, arr):
+    url = f"emu://{tmp_path}/in"
+    put_stack(url, arr, chunk_frames=8)
+    return url
+
+
+def _mk(**kw):
+    return MotionCorrector(
+        model="translation", backend="jax", batch_size=8, **kw
+    )
+
+
+@pytest.mark.slow
+def test_correct_file_emulated_ingest_parity(bucket, drift):
+    res = _mk().correct_file(bucket, chunk_size=8)
+    err = transform_rmse(
+        res.transforms, relative_transforms(drift.transforms), SHAPE
+    )
+    assert err < 0.15
+    obj = res.timing["feeder"]["object"]["ingest"]
+    assert obj["gets"] > 0 and obj["retries"] == 0
+
+
+@pytest.mark.slow
+def test_correct_file_pooled_ingest_parity(bucket, drift):
+    """io_workers >= 2 routes the emu source through the thread-flavor
+    decode pool (per-worker clients via the URL respec) with the same
+    results."""
+    res = _mk(io_workers=2).correct_file(bucket, chunk_size=8)
+    ft = res.timing["feeder"]
+    assert ft["mode"] == "thread"
+    assert ft["chunks"] > 0
+    err = transform_rmse(
+        res.transforms, relative_transforms(drift.transforms), SHAPE
+    )
+    assert err < 0.15
+
+
+@pytest.mark.slow
+def test_fault_storm_zero_loss_byte_identity(tmp_path, bucket):
+    """THE chaos contract: drop + stall + flip + truncate + throttle
+    across a full emulated ingest->egress run completes with zero lost
+    or duplicated frames — the output chunk set is byte-identical to
+    the fault-free run's."""
+    clean_out = f"emu://{tmp_path}/out-clean"
+    _mk(**FAST_CFG).correct_file(bucket, output=clean_out, chunk_size=8)
+    reset_url_state()
+    storm = (
+        "object:step=3:drop, object:step=5:stall=0.2, object:step=7:flip, "
+        "object:step=9:truncate, object:step=11:throttle"
+    )
+    storm_out = f"emu://{tmp_path}/out-storm"
+    res = _mk(
+        fault_plan=storm, object_timeout_s=2.0, **FAST_CFG
+    ).correct_file(bucket, output=storm_out, chunk_size=8)
+    assert res.robustness["faults_injected"] > 0
+    c = client_for_url(f"emu://{tmp_path}")
+    clean = {
+        k.split("/", 1)[1]: v for k, v in _chunkset(c, "out-clean").items()
+    }
+    storm = {
+        k.split("/", 1)[1]: v for k, v in _chunkset(c, "out-storm").items()
+    }
+    assert clean == storm
+
+
+@pytest.mark.slow
+def test_kill_resume_egress_byte_identity(tmp_path, bucket):
+    """Kill mid-run -> restart -> resume: the writer re-uploads only
+    past the durable high-water mark and the final chunk set is
+    byte-identical to an uninterrupted run."""
+    mk = lambda: MotionCorrector(
+        model="translation", backend="jax", batch_size=4,
+        object_chunk_frames=8,
+    )
+    ref_out = f"emu://{tmp_path}/ref"
+    mk().correct_file(bucket, output=ref_out, chunk_size=8)
+
+    calls = {"n": 0}
+    orig = ChunkedStackLoader._read
+
+    def poisoned(self, lo, hi):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise RuntimeError("simulated kill")
+        return orig(self, lo, hi)
+
+    out = f"emu://{tmp_path}/out"
+    ckpt = tmp_path / "run.ckpt.npz"
+    ChunkedStackLoader._read = poisoned
+    try:
+        with pytest.raises(RuntimeError, match="simulated kill"):
+            mk().correct_file(
+                bucket, output=out, chunk_size=8,
+                checkpoint=str(ckpt), checkpoint_every=8,
+            )
+    finally:
+        ChunkedStackLoader._read = orig
+    puts_before = stats_snapshot(out)["puts"]
+    res = mk().correct_file(
+        bucket, output=out, chunk_size=8, checkpoint=str(ckpt)
+    )
+    assert res.timing["restored_frames"] > 0
+    resumed_puts = stats_snapshot(out)["puts"] - puts_before
+    c = client_for_url(f"emu://{tmp_path}")
+    ref = {k.split("/", 1)[1]: v for k, v in _chunkset(c, "ref").items()}
+    got = {k.split("/", 1)[1]: v for k, v in _chunkset(c, "out").items()}
+    assert ref == got
+    assert resumed_puts < stats_snapshot(ref_out)["puts"]
