@@ -1131,6 +1131,22 @@ def run_bench_profile(
         rec["stages"] = stage_breakdown(
             model=model, shape=(size, size), batch_size=b, **gen_kw, **kw
         )
+        # Achieved-rate columns (PR 18): the same roofline cost
+        # vocabulary that prices `--roofline`, divided by each stage's
+        # measured incremental time — one table, two consumers.
+        from kcmc_tpu.analysis.roofline import achieved_rates
+
+        costs = _roofline_costs(model, size, b, kw)
+        rates = achieved_rates(
+            costs,
+            {
+                name: row["incremental_ms"] / 1e3
+                for name, row in rec["stages"].items()
+                if isinstance(row, dict) and "incremental_ms" in row
+            },
+        )
+        for name, r in rates.items():
+            rec["stages"][name].update(r)
     else:
         rec["stages"] = None
 
@@ -1174,6 +1190,143 @@ def run_bench_profile(
     rec["wall_ms"] = round(wall_ms, 1)
     rec["fps"] = round(len(stack) / (wall_ms / 1e3), 1)
     return rec
+
+
+def _roofline_costs(model: str, size: int, batch: int, kw: dict) -> dict:
+    """Resolve a judged config's overrides into the roofline stage-cost
+    table (analysis/roofline.stage_costs) for ONE batch."""
+    from kcmc_tpu.analysis.roofline import stage_costs
+    from kcmc_tpu.config import CorrectorConfig
+
+    cfg_kw = {
+        k: v for k, v in kw.items()
+        if k in CorrectorConfig.__dataclass_fields__
+    }
+    cfg = CorrectorConfig(model=model, **cfg_kw)
+    return stage_costs(
+        model, (size, size), batch,
+        max_keypoints=cfg.max_keypoints,
+        n_octaves=cfg.n_octaves,
+        octave_scale=cfg.octave_scale,
+        oriented=cfg.resolved_oriented(),
+        n_hypotheses=cfg.n_hypotheses,
+        refine_iters=cfg.refine_iters,
+        patch_grid=cfg.patch_grid,
+        patch_hypotheses=cfg.patch_hypotheses,
+    )
+
+
+def run_bench_roofline(
+    n_frames: int, size: int, batch: int, smoke: bool,
+) -> int:
+    """`--roofline`: name each contract config's BINDING resource.
+
+    For every judged config (CONFIG_ROWS + translation) this times the
+    host-fed end-to-end path (`MotionCorrector.correct` — uploads and
+    downloads included, since host-fed rooflines are usually
+    link-bound), prices the run with the first-order bytes/FLOPs model
+    in `analysis/roofline.stage_costs`, and judges which resource the
+    measured time is pinned against at the platform's table peaks
+    (`analysis/roofline.PEAKS` — host/memory classes on CPU, MXU /
+    VMEM / HBM / host-link / interconnect classes on TPU).
+
+    One JSON line per config (metric ``roofline_<label>``) plus a
+    summary line (metric ``roofline``), each self-validated: a line
+    with an unknown binding resource or a fraction outside (0, 1]
+    fails the run (exit 1) — that is the CI render-and-validate hook.
+    """
+    from kcmc_tpu import MotionCorrector
+    from kcmc_tpu.analysis.roofline import (
+        RESOURCE_NAMES,
+        detect_platform,
+        judge,
+    )
+
+    platform = detect_platform()
+    rows = dict(CONFIG_ROWS)
+    rows["translation"] = ("translation", {})
+    failures, summary = [], {}
+    sweeps = 1 if smoke else SWEEPS_JUDGED
+    for label, (model, kw) in sorted(rows.items()):
+        kw = dict(kw)
+        b = min(kw.pop("batch", batch), n_frames)
+        gen_kw = {
+            k: kw.pop(k) for k in ("n_blobs", "sigma_range") if k in kw
+        }
+        if smoke:
+            # Validation run, not a measurement: the affine@2k density
+            # knobs (K=4096 over a 64² frame) cost minutes of CPU
+            # Hamming for no extra coverage of the judge path.
+            if kw.get("max_keypoints", 0) > 256:
+                kw["max_keypoints"] = 256
+            if gen_kw.get("n_blobs", 0) > 2000:
+                gen_kw["n_blobs"] = 2000
+        data = _build_stack(n_frames, size, model, **gen_kw)
+        base = len(data.stack)
+        reps = (n_frames + base - 1) // base
+        tile_dims = (reps,) + (1,) * (data.stack.ndim - 1)
+        stack = np.tile(np.asarray(data.stack, np.float32), tile_dims)[
+            :n_frames
+        ]
+        mc = MotionCorrector(model=model, backend="jax", batch_size=b, **kw)
+        mc.correct(stack[: b * 2])  # warmup/compile
+        times = []
+        for _ in range(sweeps):
+            t0 = time.perf_counter()
+            mc.correct(stack)
+            times.append(time.perf_counter() - t0)
+        measured = float(np.median(times))
+        # Whole-run work = per-batch model at B = n_frames (the model
+        # is linear in B, so one evaluation prices every batch).
+        costs = _roofline_costs(model, size, n_frames, kw)
+        verdict = judge(costs, measured, platform)
+        rec = {
+            "metric": f"roofline_{label}",
+            "model": model,
+            "batch": b,
+            "frames": n_frames,
+            "size": size,
+            "fps": round(n_frames / measured, 1),
+            "smoke": smoke,
+            **verdict,
+        }
+        # Self-validation: a judged line must name a known resource at
+        # a physical fraction — a nonsense line failing silently would
+        # make the CI render step a no-op.
+        if verdict["binding"] not in RESOURCE_NAMES:
+            failures.append(f"{label}: unknown binding {verdict['binding']}")
+        if not (0.0 < verdict["fraction_of_peak"] <= 1.0):
+            failures.append(
+                f"{label}: fraction_of_peak {verdict['fraction_of_peak']} "
+                "outside (0, 1]"
+            )
+        print(json.dumps(rec))
+        print(
+            f"[bench] roofline {label}: bound by "
+            f"{verdict['binding_label']} at "
+            f"{100 * verdict['fraction_of_peak']:.1f}% of peak "
+            f"({verdict['platform_label']})",
+            file=sys.stderr,
+        )
+        summary[label] = {
+            "binding": verdict["binding"],
+            "fraction_of_peak": verdict["fraction_of_peak"],
+        }
+    print(
+        json.dumps(
+            {
+                "metric": "roofline",
+                "value": 0 if failures else 1,
+                "unit": "pass",
+                "platform": platform,
+                "configs": summary,
+                "failures": failures,
+            }
+        )
+    )
+    for msg in failures:
+        print(f"[bench] ROOFLINE INVALID: {msg}", file=sys.stderr)
+    return 1 if failures else 0
 
 
 # -- regression gate (ROADMAP item 4: the BENCH_r* trajectory only
@@ -1343,6 +1496,18 @@ def main() -> None:
         "one JSON record on stdout",
     )
     ap.add_argument(
+        "--roofline", action="store_true",
+        help="roofline-attribution mode (PR 18): time every judged "
+        "config host-fed end to end, price it with the first-order "
+        "bytes/FLOPs model (analysis/roofline.py — the traceflow "
+        "BYTES_HINTS shape vocabulary), and emit one judged JSON line "
+        "per config naming its BINDING resource (MXU, VMEM bandwidth, "
+        "HBM, host, interconnect) and fraction of peak. Runs on CPU "
+        "(host/memory classification); TPU peaks are table-driven. "
+        "With --smoke: tiny CPU run whose lines are self-validated "
+        "(the CI render-and-validate hook)",
+    )
+    ap.add_argument(
         "--streaming", action="store_true",
         help="also time the zero-stall streaming config (correct_file, "
         "rolling template updates, background writeback) and report its "
@@ -1466,6 +1631,11 @@ def main() -> None:
         args.batch = min(args.batch, 16)
         args.flagship_only = True
         args.streaming = not args.coldstart
+
+    if args.roofline:
+        raise SystemExit(
+            run_bench_roofline(args.frames, args.size, args.batch, args.smoke)
+        )
 
     if args.coldstart:
         # Subprocess-based (each measurement is a real process start);

@@ -1,0 +1,324 @@
+"""Roofline attribution (PR 18): which resource binds each config.
+
+The bench trajectory (BENCH_r01–r05) says how fast each judged config
+runs; nothing in the repo could say how fast it COULD run, or which
+resource — matrix units, on-chip memory bandwidth, HBM, the host, the
+interconnect — a config is actually pinned against. This module is
+that missing model: a table-driven peaks catalogue per platform class,
+a first-order bytes/FLOPs cost model per pipeline stage assembled from
+the same shape vocabulary the traceflow pass documents
+(`analysis/traceflow.BYTES_HINTS`), and a judge that combines the
+model with MEASURED wall time into a binding-resource verdict and its
+fraction of peak.
+
+Three consumers share the one table (the "one table, two consumers"
+satellite, plus the checker):
+
+* ``bench.py --roofline`` — a judged per-config line naming the
+  binding resource and fraction of peak;
+* ``bench.py --profile`` — achieved-bytes/s and achieved-FLOP/s
+  columns per measured stage;
+* the traceflow pass — warns when a plan-routed program literal has
+  no entry in `PROGRAM_VOCAB`, so a new jitted program can never be
+  silently under-counted by this model.
+
+Honesty notes. The cost model is FIRST-ORDER: per-stage FLOP and byte
+counts are derived from config+shape with constant per-pixel /
+per-keypoint factors measured once against the XLA cost analysis of
+the compiled programs — trust binding-resource CLASSIFICATION and
+order-of-magnitude fractions, not third-digit precision. The peaks
+table carries spec-sheet class numbers; operators with calibrated
+hardware should edit their row (that is why it is a table). On CPU the
+"device" is the host itself, so the verdict degenerates to the useful
+CPU question: host-compute-bound vs memory-bound vs staging-bound.
+"""
+
+from __future__ import annotations
+
+import math
+
+# -- peaks catalogue (per platform CLASS, table-driven) ---------------------
+#
+# Units: FLOP/s and bytes/s. `compute` is the dense-matmul peak the
+# match/consensus stages can reach (MXU on TPU); `vector` the
+# elementwise/VPU-class peak the detect/warp stages are bounded by;
+# `memory` main-memory bandwidth (HBM on TPU, DRAM on host); `vmem`
+# on-chip SRAM bandwidth (None where the model shouldn't price it);
+# `link` the host<->device staging path (memcpy-class on CPU, PCIe
+# class on TPU hosts); `interconnect` per-chip ICI/DCN bandwidth for
+# the multi-chip gathers (None = single-chip platform class).
+PEAKS: dict[str, dict] = {
+    "cpu": {
+        "label": "host (XLA:CPU)",
+        "compute": 2.0e11,
+        "vector": 1.0e11,
+        "memory": 2.5e10,
+        "vmem": None,
+        "link": 1.2e10,
+        "interconnect": None,
+    },
+    "tpu-v5e": {
+        "label": "TPU v5e (1 chip)",
+        "compute": 3.94e14,  # bf16/int8 MXU class
+        "vector": 2.0e12,
+        "memory": 8.1e11,  # HBM
+        "vmem": 1.0e13,
+        "link": 1.6e10,  # PCIe-class host link
+        "interconnect": 4.5e10,  # ICI per link direction
+    },
+    "tpu-v4": {
+        "label": "TPU v4 (1 chip)",
+        "compute": 2.75e14,
+        "vector": 1.6e12,
+        "memory": 1.2e12,
+        "vmem": 1.0e13,
+        "link": 1.6e10,
+        "interconnect": 5.0e10,
+    },
+}
+
+# Resource key -> operator-facing name in the judged report.
+RESOURCE_NAMES = {
+    "compute": "MXU/compute",
+    "vector": "VPU/vector",
+    "memory": "HBM/memory",
+    "vmem": "VMEM bandwidth",
+    "link": "host link",
+    "interconnect": "interconnect",
+}
+
+# -- program vocabulary (the traceflow "roofline-vocab" rule) ---------------
+#
+# EVERY literal program name routed through the plan machinery
+# (`PlanRuntime.timed` / `maybe_timed` / the backend's
+# `_instrument_program`) must have an entry here describing how the
+# roofline model accounts it — the traceflow pass warns on any
+# plan-routed literal missing from this table, so a new jitted program
+# cannot silently escape the cost model. Values name the BYTES_HINTS
+# rows (analysis/traceflow.py) and cost-model stages that price it.
+PROGRAM_VOCAB: dict[str, str] = {
+    "register": "full batch pipeline: frames upload (BYTES_HINTS "
+    "'frames'), detect/describe/match/consensus/warp stage costs, "
+    "corrected/out download ('corrected', 'out', diagnostics rows)",
+    "reference": "single-frame detect+describe (B=1 detect/describe "
+    "stage costs; no batch transfers)",
+    "reference_pyramid": "fused pyramid detect+describe over one frame "
+    "(detect/describe costs summed over octaves at B=1)",
+    "update_reference": "device rolling-template blend: one "
+    "H*W-sized elementwise pass over the averaging window",
+    "quality": "template correlation + coverage: ~3 elementwise "
+    "passes over 'corrected'",
+    "cast": "round/clip/cast of 'corrected' before D2H (prices as one "
+    "memory pass, halves the 'corrected' link bytes)",
+    "apply": "warp-only application pass: warp stage cost plus "
+    "'frames'/'corrected' transfers",
+}
+
+
+def detect_platform() -> str:
+    """Peaks-table key for the current runtime. CPU hosts map to
+    "cpu"; accelerators map to their platform class with "tpu-v5e" as
+    the conservative default for unrecognized TPU generations."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend not in ("tpu", "axon"):
+        return "cpu"
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return "tpu-v5e"
+    if "v4" in kind:
+        return "tpu-v4"
+    return "tpu-v5e"
+
+
+def _pyramid_px_factor(n_octaves: int, octave_scale: float) -> float:
+    """Sum of per-octave pixel-count ratios vs the base frame."""
+    return sum(
+        (1.0 / float(octave_scale)) ** (2 * i) for i in range(max(1, n_octaves))
+    )
+
+
+def stage_costs(
+    model: str,
+    shape: tuple[int, int],
+    batch: int,
+    *,
+    max_keypoints: int = 512,
+    n_octaves: int = 1,
+    octave_scale: float = 2.0,
+    oriented: bool | None = None,
+    n_hypotheses: int = 128,
+    refine_iters: int = 2,
+    patch_grid: tuple[int, int] = (8, 8),
+    patch_hypotheses: int = 32,
+    in_itemsize: int = 4,
+    out_itemsize: int = 4,
+    emit_frames: bool = True,
+) -> dict[str, dict[str, float]]:
+    """First-order bytes/FLOPs per pipeline stage for ONE batch.
+
+    Returns {stage: {"flops", "mem_bytes", "link_bytes"}} with the
+    stage keys matching `utils.profiling.stage_breakdown`'s rows
+    (detect / describe / match / consensus / full (+warp)) plus the
+    transfer pseudo-stages ``upload`` and ``download`` that never
+    appear in a device breakdown but dominate host-fed rooflines.
+
+    The constant factors are calibrated against XLA's cost analysis of
+    the compiled 512² programs (first-order: blocking, padding, and
+    fusion change them by tens of percent, not orders of magnitude).
+    """
+    from kcmc_tpu.ops.patterns import (
+        N_BITS,
+        PATCH_RADIUS,
+        ROT_RADIUS,
+    )
+
+    H, W = int(shape[0]), int(shape[1])
+    B = int(batch)
+    px = float(B * H * W)
+    K = int(max_keypoints)
+    if oriented is None:
+        oriented = model not in ("translation",)
+    r = ROT_RADIUS if oriented else PATCH_RADIUS
+    P = 2 * r + 2
+    pyr = _pyramid_px_factor(n_octaves, octave_scale)
+
+    costs: dict[str, dict[str, float]] = {}
+    costs["upload"] = {
+        "flops": 0.0,
+        "mem_bytes": px * in_itemsize,
+        "link_bytes": px * in_itemsize,
+    }
+    # Harris + blur + NMS + subpixel: ~12 conv/reduce passes of ~9-25
+    # taps over every pixel (per octave on the pyramid path).
+    costs["detect"] = {
+        "flops": px * pyr * 160.0,
+        "mem_bytes": px * pyr * 4 * 24.0,
+        "link_bytes": 0.0,
+    }
+    # Patch extraction (K patches of P² pixels, bf16 slabs) + N_BITS
+    # pair comparisons + orientation moments per keypoint.
+    costs["describe"] = {
+        "flops": B * K * (P * P * 24.0 + N_BITS * 4.0) * (1.0 if n_octaves <= 1 else 1.2),
+        "mem_bytes": px * pyr * 4 * 2.0 + B * K * P * P * 2 * 2.0,
+        "link_bytes": 0.0,
+    }
+    # Hamming matrix on the MXU: K x K_ref x N_BITS bit-MACs (int8/bf16
+    # packed), plus the 2-NN selection sweep.
+    costs["match"] = {
+        "flops": 2.0 * B * K * K * (N_BITS / 8.0) + B * K * K * 4.0,
+        "mem_bytes": B * K * K * 2.0,
+        "link_bytes": 0.0,
+    }
+    # Blocked hypothesis solves/scores over the match set; piecewise
+    # prices its global+patch field hypotheses through the same term.
+    hyp = float(n_hypotheses)
+    if model == "piecewise":
+        gh, gw = patch_grid
+        hyp = float(n_hypotheses + gh * gw * patch_hypotheses)
+    costs["consensus"] = {
+        "flops": B * hyp * K * 30.0 * (1.0 + refine_iters),
+        "mem_bytes": B * hyp * K * 8.0,
+        "link_bytes": 0.0,
+    }
+    # Bilinear warp + polish-free output pass.
+    costs["full (+warp)"] = {
+        "flops": px * 14.0,
+        "mem_bytes": px * 4 * 3.0,
+        "link_bytes": 0.0,
+    }
+    dl = px * out_itemsize if emit_frames else 0.0
+    costs["download"] = {
+        "flops": 0.0,
+        "mem_bytes": dl + B * 64.0,
+        "link_bytes": dl + B * 64.0,
+    }
+    return costs
+
+
+def total_costs(costs: dict[str, dict[str, float]]) -> dict[str, float]:
+    """Sum per-stage costs into one {"flops","mem_bytes","link_bytes"}."""
+    out = {"flops": 0.0, "mem_bytes": 0.0, "link_bytes": 0.0}
+    for c in costs.values():
+        for k in out:
+            out[k] += c.get(k, 0.0)
+    return out
+
+
+def judge(
+    costs: dict[str, dict[str, float]],
+    measured_s: float,
+    platform: str,
+    *,
+    n_devices: int = 1,
+    gathered_bytes: float = 0.0,
+) -> dict:
+    """Combine the cost model with a MEASURED wall time into a
+    binding-resource verdict.
+
+    For each resource the model computes the time the batch's work
+    would take at that resource's table peak; the resource with the
+    largest time-at-peak is the BINDING resource (the roofline's
+    ridge), and `fraction_of_peak` is that time divided by the
+    measured time — 1.0 means running at the model's speed of light,
+    small fractions mean overhead/latency the roofline cannot
+    attribute (dispatch, stalls, under-utilization).
+
+    `gathered_bytes` (multi-chip): per-batch bytes each chip receives
+    through the reference gathers, priced against the interconnect
+    peak. Compute/memory terms are divided by `n_devices` (perfectly
+    sharded work — optimistic, which is what a roofline is).
+    """
+    peaks = PEAKS[platform]
+    tot = total_costs(costs)
+    times: dict[str, float] = {}
+    # Matrix-class work (match+consensus) runs against the compute
+    # peak; elementwise pixel work against the vector peak where the
+    # table distinguishes them.
+    mxu_flops = sum(
+        costs.get(s, {}).get("flops", 0.0) for s in ("match", "consensus")
+    )
+    vec_flops = tot["flops"] - mxu_flops
+    n = max(1, int(n_devices))
+    if peaks.get("compute"):
+        times["compute"] = mxu_flops / peaks["compute"] / n
+    if peaks.get("vector"):
+        times["vector"] = vec_flops / peaks["vector"] / n
+    if peaks.get("memory"):
+        times["memory"] = tot["mem_bytes"] / peaks["memory"] / n
+    if peaks.get("link"):
+        times["link"] = tot["link_bytes"] / peaks["link"]
+    if peaks.get("interconnect") and gathered_bytes > 0:
+        times["interconnect"] = gathered_bytes / peaks["interconnect"]
+    binding = max(times, key=times.get)
+    bound_s = times[binding]
+    measured_s = max(float(measured_s), 1e-12)
+    return {
+        "platform": platform,
+        "platform_label": peaks["label"],
+        "binding": binding,
+        "binding_label": RESOURCE_NAMES[binding],
+        "fraction_of_peak": round(min(bound_s / measured_s, 1.0), 4),
+        "time_at_peak_s": {k: round(v, 6) for k, v in sorted(times.items())},
+        "measured_s": round(measured_s, 6),
+    }
+
+
+def achieved_rates(
+    costs: dict[str, dict[str, float]], stage_seconds: dict[str, float]
+) -> dict[str, dict[str, float]]:
+    """Achieved FLOP/s and bytes/s per measured stage (the --profile
+    columns): model work divided by measured incremental time. Stages
+    without a cost row or with non-positive time are skipped."""
+    out: dict[str, dict[str, float]] = {}
+    for name, secs in stage_seconds.items():
+        c = costs.get(name)
+        if c is None or not secs or secs <= 0:
+            continue
+        out[name] = {
+            "achieved_gflops": round(c["flops"] / secs / 1e9, 2),
+            "achieved_gbs": round(c["mem_bytes"] / secs / 1e9, 2),
+        }
+    return out

@@ -33,6 +33,7 @@ _RULE_HELP = {
     "dtype-flow": "no silent wide-dtype promotion or upload widening",
     "transfer": "no host transfers inside the dispatch window",
     "bucket-escape": "jit dispatch shapes stay on the plan_buckets ladder",
+    "roofline-vocab": "plan-routed programs priced by the roofline model",
     "donation": "dying same-shape jit inputs should donate their buffer",
     "baseline": "baseline entries stay justified and live",
     "parse": "sources must parse",

@@ -50,6 +50,15 @@ its trace-time branches legal. Four rule families:
   half: the static ladder predicts the compile-key set, the sentinel
   convicts any post-warm-up compile the prediction does not cover.
 
+* **roofline-vocab** (PR 18) — a program literal routed through the
+  plan machinery (`plan.maybe_timed("name", …)` / `plan.timed` /
+  `_instrument_program("name", …)`) that has no entry in
+  `analysis/roofline.PROGRAM_VOCAB`: the roofline cost model prices
+  programs by name, so an unvocabularied program silently escapes
+  `bench.py --roofline`'s attribution (and the --profile achieved-rate
+  columns) — under-counting, not mis-counting, which is exactly the
+  failure a checker must catch.
+
 Resolution failures stay silent (an unresolvable call contributes no
 edges and no findings) — the pass must be demonstrable on known-bad
 fixtures and quiet on code it cannot see into.
@@ -834,12 +843,54 @@ class _WindowScanner:
                     )
 
 
+# -- roofline program vocabulary (PR 18) -------------------------------------
+
+# Call tails that stamp a PROGRAM NAME into the plan machinery; their
+# first argument, when a string literal, must appear in
+# analysis/roofline.PROGRAM_VOCAB so the roofline cost model can price
+# the program.
+_PROGRAM_SITES = frozenset({"maybe_timed", "timed", "_instrument_program"})
+
+
+def _scan_roofline_vocab(mod: Module, emit) -> None:
+    """Emit `roofline-vocab` warnings for plan-routed program literals
+    missing from the roofline model's vocabulary."""
+    from kcmc_tpu.analysis.roofline import PROGRAM_VOCAB
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = attr_chain(node.func).rsplit(".", 1)[-1]
+        if tail not in _PROGRAM_SITES or not node.args:
+            continue
+        first = node.args[0]
+        if not (
+            isinstance(first, ast.Constant) and isinstance(first.value, str)
+        ):
+            continue  # name threaded through a variable: not this site
+        name = first.value
+        if name not in PROGRAM_VOCAB:
+            emit(
+                "roofline-vocab",
+                mod.path,
+                node.lineno,
+                "warning",
+                f"plan-routed program '{name}' has no "
+                "analysis/roofline.PROGRAM_VOCAB entry",
+                "the roofline cost model prices programs by name - an "
+                "unvocabularied program silently escapes `bench.py "
+                "--roofline` attribution; add a PROGRAM_VOCAB entry "
+                "describing which BYTES_HINTS rows / cost-model stages "
+                "account it",
+            )
+
+
 # -- the pass ----------------------------------------------------------------
 
 
 class TraceFlowPass:
     """Rule families `retrace` / `dtype-flow` / `transfer` /
-    `bucket-escape` (module docstring)."""
+    `bucket-escape` / `roofline-vocab` (module docstring)."""
 
     name = "traceflow"
 
@@ -871,6 +922,7 @@ class TraceFlowPass:
                 scanner.scan_root(root)
                 _static_argnum_candidates(root, emit)
             windows.scan_module(mod)
+            _scan_roofline_vocab(mod, emit)
         uniq: dict[tuple, Finding] = {}
         for f in out:
             uniq.setdefault((f.rule, f.path, f.line, f.message), f)

@@ -26,8 +26,8 @@ from kcmc_tpu.backends import register_backend
 from kcmc_tpu.config import CorrectorConfig
 from kcmc_tpu.models import get_model
 from kcmc_tpu.ops import piecewise as pw
-from kcmc_tpu.ops.describe import describe_keypoints, describe_keypoints_batch
-from kcmc_tpu.ops.detect import detect_keypoints, detect_keypoints_batch
+from kcmc_tpu.ops.describe import describe_keypoints
+from kcmc_tpu.ops.detect import detect_keypoints
 from kcmc_tpu.ops.match import knn_match
 from kcmc_tpu.ops.warp import warp_batch_with_ok, warp_frame_flow, warp_volume
 
@@ -153,6 +153,24 @@ def _coverage_field(fields: jnp.ndarray, shape) -> jnp.ndarray:
 
 
 _EXPORT_ADVISED = False  # one background-export notice per process
+
+
+class UploadedBatch:
+    """Ownership mark for a frame batch staged on device ahead of its
+    dispatch (`JaxBackend.stage_upload` — the double-buffered H2D
+    path). `process_batch_async` treats a wrapped buffer as its OWN
+    (donation-eligible, no defensive copy): the wrapper exists so a
+    pre-staged upload can never be confused with a caller-held device
+    array, which must be copied before donation."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+    @property
+    def shape(self):
+        return self.array.shape
 
 
 @functools.cache
@@ -327,6 +345,40 @@ class JaxBackend:
             self._batch_fns[key] = fn
         return fn
 
+    def _get_pyramid_prep_fn(self, shape):
+        """The MULTI-SCALE reference detect+describe as one jitted,
+        plan-instrumented program (the "reference_pyramid" program).
+
+        Before PR 18 the pyramid reference path ran eagerly: the
+        pyramid resize, each octave's separately jitted detect and
+        describe programs, and the merge dispatched one by one with
+        the selected keypoint sets materialized between them. Routing
+        the whole `fused_detect_describe` region through
+        `_instrument_program` makes it ONE traced program — compile
+        accounting, plan stamps, and the exported-program cold-start
+        bridge included, exactly like the single-scale "reference"
+        program — whose autotuned tilings replay from the plan stamps
+        on warm boots."""
+        key = ("prep_pyramid", shape, self.config)
+        fn = self._batch_fns.get(key)
+        if fn is None:
+            tiles = self._tile_params(shape)
+            on_acc = self._on_accelerator()
+
+            def prep(frame):
+                kps, desc = self._detect_describe_2d(
+                    frame[None], on_acc, tiles=tiles
+                )
+                return {
+                    "xy": kps.xy[0], "desc": desc[0], "valid": kps.valid[0],
+                }
+
+            fn = self._instrument_program(
+                "reference_pyramid", shape, jax.jit(prep)
+            )
+            self._batch_fns[key] = fn
+        return fn
+
     def _prepare_reference_impl(self, ref_frame, bucket) -> dict:
         cfg = self.config
         frame = jnp.asarray(ref_frame, jnp.float32)
@@ -337,18 +389,17 @@ class JaxBackend:
             frame = _sanitize_nonfinite(frame[None])[0]
         if frame.ndim == 2:
             if cfg.n_octaves > 1:
-                # Multi-scale reference through the SAME pyramid stage
-                # as the batch program, so frame and reference keypoint
-                # sets share octave layout and coordinate convention.
-                kps, desc = self._detect_describe_2d(
-                    frame[None], self._on_accelerator(),
-                    tiles=self._tile_params(
-                        tuple(int(s) for s in frame.shape)
-                    ),
+                # Multi-scale reference through the SAME fused pyramid
+                # region as the batch program (shared octave layout and
+                # coordinate convention), as ONE jitted and plan-
+                # accounted program — see _get_pyramid_prep_fn.
+                prep = self._get_pyramid_prep_fn(
+                    tuple(int(s) for s in frame.shape)
                 )
+                got = prep(frame)
                 return self._mesh_ref({
-                    "xy": kps.xy[0], "desc": desc[0],
-                    "valid": kps.valid[0], "frame": frame,
+                    "xy": got["xy"], "desc": got["desc"],
+                    "valid": got["valid"], "frame": frame,
                 })
             valid_hw = None
             plan_frame = frame
@@ -464,6 +515,34 @@ class JaxBackend:
         out = self.process_batch_async(frames, ref, frame_indices)
         return jax.tree.map(np.asarray, out)
 
+    def stage_upload(self, frames) -> "UploadedBatch":
+        """Upload one frame batch to the device AHEAD of dispatch — the
+        double-buffered H2D slot (`upload_overlap`).
+
+        Performs exactly the upload work `process_batch_async` would do
+        inline for a host batch: the native-dtype `jnp.asarray` onto
+        the device, plus the donation defensive copy when the caller
+        handed us a live device array (asarray was the identity) on the
+        donating single-device path. The result is wrapped in
+        `UploadedBatch` as an ownership mark: a staged buffer is OURS
+        to donate, so dispatch skips the defensive copy it would
+        otherwise need — staging must never ADD a copy to the path it
+        accelerates. Thread-safe by construction (pure uploads, no
+        backend state), so the corrector runs it on its upload worker
+        while the previous batch executes."""
+        shape = tuple(frames.shape[1:])
+        plan = self._plan
+        bucket = plan.route(shape) if plan.active else None
+        frames_j = jnp.asarray(frames)
+        if (
+            frames_j is frames
+            and self.mesh is None
+            and self.config.donate_buffers
+            and (bucket is None or bucket == shape)
+        ):
+            frames_j = jnp.array(frames_j, copy=True)
+        return UploadedBatch(frames_j)
+
     def process_batch_async(
         self, frames, ref: dict, frame_indices, to_host=True, cast_dtype=None,
         emit_frames=True, seed=None,
@@ -496,12 +575,21 @@ class JaxBackend:
         zero of every frame's consensus (temporal warm start; see
         ops/ransac.consensus_batch). None dispatches an identity seed
         with ok=False, so the compiled signature is seed-invariant."""
+        staged = isinstance(frames, UploadedBatch)
+        if staged:
+            # Pre-staged by `stage_upload` (the double-buffered H2D
+            # slot): the buffer is already on device and already OURS —
+            # the asarray/defensive-copy ownership logic below ran on
+            # the upload worker, so re-running it here would add the
+            # copy that staging exists to hide.
+            frames = frames.array
         shape = tuple(frames.shape[1:])
         plan = self._plan
         bucket = plan.route(shape) if plan.active else None
-        frames_j = jnp.asarray(frames)
+        frames_j = frames if staged else jnp.asarray(frames)
         if (
-            frames_j is frames
+            not staged
+            and frames_j is frames
             and self.mesh is None
             and self.config.donate_buffers
             and (bucket is None or bucket == shape)
@@ -785,15 +873,34 @@ class JaxBackend:
             else self._build_local_2d(shape, bucketed=bucketed)
         )
         if self.mesh is not None:
-            from kcmc_tpu.parallel.sharded import make_sharded_batch_fn
+            from kcmc_tpu.parallel.sharded import (
+                make_sharded_batch_fn,
+                mesh_size,
+            )
+            from kcmc_tpu.plans.runtime import _live_tracers
 
             # Trailing replicated args: the warm-start seed pair (a
             # shared (d+1, d+1) matrix + () bool) precedes the bucketed
             # valid_hw extent — all tiny, identical on every chip.
             warm = self.config.warm_start and self.config.model != "piecewise"
+            chunks = int(self.config.collective_chunks)
+            if chunks >= 2:
+                # Host-side breadcrumb (collectives trace inside the
+                # program, invisible to the host tracer): one instant
+                # per sharded-program build recording the ring layout.
+                for tr in _live_tracers():
+                    tr.instant(
+                        "collective.chunk",
+                        args={
+                            "chunks": chunks,
+                            "devices": mesh_size(self.mesh),
+                            "shape": list(shape),
+                        },
+                    )
             return make_sharded_batch_fn(
                 local, self.mesh,
                 extra_replicated=(2 if warm else 0) + (1 if bucketed else 0),
+                collective_chunks=chunks,
             )
         # Buffer donation (the kcmc-check donation-audit contract): the
         # corrected output matches the frame batch's shape/dtype only
@@ -819,60 +926,33 @@ class JaxBackend:
         (execution plans; single-scale only — bucket routing gates
         pyramid configs out)."""
         cfg = self.config
-        oriented = cfg.resolved_oriented()
-        precision = cfg.resolved_match_precision(self._on_accelerator())
+        from kcmc_tpu.ops.fused import fused_detect_describe
+
         # Autotuned tilings apply at the tuned (base) frame shape only;
         # other shapes in the same program (pyramid octaves) keep the
         # per-kernel defaults. `tiles` is resolved at BUILD time (the
         # tuning search times candidate kernels — it must never run
         # inside a trace), so it arrives as a plain dict of static
         # ints, keyed by the shape it was tuned for.
-        tiles = tiles or {}
-
-        def stage(fr, k_octave, border):
-            t = tiles if tiles.get("shape") == tuple(fr.shape[1:]) else {}
-            kps, smooth = detect_keypoints_batch(
-                fr,
-                max_keypoints=k_octave,
-                threshold=cfg.detect_threshold,
-                nms_size=cfg.nms_size,
-                border=border,
-                harris_k=cfg.harris_k,
-                use_pallas=use_pallas,
-                smooth_sigma=cfg.blur_sigma,
-                window_sigma=cfg.harris_window_sigma,
-                cand_tile=cfg.cand_tile,
-                valid_hw=valid_hw,
-                strip=t.get("detect_strip"),
-            )
-            desc = describe_keypoints_batch(
-                fr,
-                kps,
-                oriented=oriented,
-                blur_sigma=cfg.blur_sigma,
-                use_pallas=use_pallas,
-                smooth=smooth,
-                precision=precision,
-                bands=t.get("patch_bands"),
-            )
-            return kps, desc
-
-        if cfg.n_octaves <= 1 or not multi_scale:
-            return stage(frames, cfg.max_keypoints, cfg.border)
-
-        from kcmc_tpu.ops.pyramid import (
-            build_pyramid,
-            merge_octave_keypoints,
-            per_octave_k,
+        return fused_detect_describe(
+            frames,
+            max_keypoints=cfg.max_keypoints,
+            detect_threshold=cfg.detect_threshold,
+            nms_size=cfg.nms_size,
+            border=cfg.border,
+            harris_k=cfg.harris_k,
+            window_sigma=cfg.harris_window_sigma,
+            blur_sigma=cfg.blur_sigma,
+            cand_tile=cfg.cand_tile,
+            oriented=cfg.resolved_oriented(),
+            precision=cfg.resolved_match_precision(self._on_accelerator()),
+            use_pallas=use_pallas,
+            n_octaves=cfg.n_octaves,
+            octave_scale=cfg.octave_scale,
+            multi_scale=multi_scale,
+            valid_hw=valid_hw,
+            tiles=tiles,
         )
-
-        octs = build_pyramid(frames, cfg.n_octaves, cfg.octave_scale)
-        ks = per_octave_k(cfg.max_keypoints, cfg.n_octaves)
-        per = []
-        for oc, ko in zip(octs, ks):
-            b = min(cfg.border, min(oc.frames.shape[1:]) // 4)
-            per.append(stage(oc.frames, ko, b))
-        return merge_octave_keypoints(per, octs)
 
     def _build_local_2d(self, shape, bucketed: bool = False):
         cfg = self.config

@@ -443,6 +443,29 @@ class CorrectorConfig:
     # this is resume-signature NEUTRAL. Off = the measured per-kernel
     # defaults.
     autotune_tiles: bool = True
+    # Double-buffered host->device uploads: the dispatch loop stages
+    # the NEXT batch's native-dtype upload (the donated-buffer path) on
+    # a dedicated upload worker while the current batch executes on
+    # device, so host staging and device compute overlap instead of
+    # serializing. Consumer time spent waiting on a not-yet-staged
+    # upload lands in the `upload_wait` stall counter. Byte-identical
+    # to the serial path by construction — the staged slot holds the
+    # SAME arrays `process_batch_async` would have built inline, so
+    # overlap changes WHEN bytes move, never their values (asserted by
+    # the overlap parity suite). Resume-signature neutral.
+    upload_overlap: bool = True
+    # Pipelined multi-chip collectives: chunk the per-batch reference
+    # and rolling-template `all_gather`s into `lax.ppermute` rings of
+    # this many chunks per shard, so each hop's transfer overlaps the
+    # previous chunk's placement and per-shard compute instead of one
+    # monolithic synchronizing gather. 0/1 = the monolithic
+    # `all_gather` (default); >= 2 = the ring, clamped to the per-shard
+    # row count. Value-identical to the monolithic gather by
+    # construction (the ring reassembles shards in the same axis-index
+    # order `tiled=True` concatenates), so this is resume-signature
+    # neutral — it changes HOW bytes cross the interconnect, never what
+    # a run computes. Single-chip runs ignore it.
+    collective_chunks: int = 0
 
     # -- input hygiene -----------------------------------------------------
     # Replace non-finite input pixels (dead/hot sensor pixels, NaN
@@ -874,6 +897,11 @@ class CorrectorConfig:
                 "mesh_devices must be -1 (all devices), 0 (single-chip),"
                 f" or a positive device count, got {self.mesh_devices}"
             )
+        if self.collective_chunks < 0:
+            raise ValueError(
+                "collective_chunks must be >= 0 chunks (0/1 = one "
+                f"monolithic all_gather), got {self.collective_chunks}"
+            )
         if self.writer_depth < 0:
             raise ValueError(
                 f"writer_depth must be >= 0 batches (0 = synchronous "
@@ -1037,6 +1065,13 @@ SIG_NEUTRAL_FIELDS = frozenset(
         # identical — see the field comment), so two runs differing
         # only here produce the same frames.
         "autotune_tiles",
+        # Overlap/pipelining knobs (PR 18): both change WHEN/HOW bytes
+        # move — the staged upload slot holds the same arrays the
+        # inline path builds, and the ppermute ring reassembles the
+        # exact tiled-gather layout — never the values a run computes
+        # (asserted by the overlap and multichip parity suites).
+        "upload_overlap",
+        "collective_chunks",
     }
 )
 

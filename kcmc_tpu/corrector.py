@@ -169,6 +169,28 @@ class _StallWatchdog:
         self._stop.set()
 
 
+class _UploadWorker:
+    """Single-thread H2D staging executor (config.upload_overlap).
+
+    A dedicated owner class, same pattern as `_StallWatchdog`: the
+    worker only ever runs self-contained staging closures — it touches
+    no corrector state — so thread ownership lives here instead of
+    widening MotionCorrector's concurrent client surface."""
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="kcmc-upload"
+        )
+
+    def submit(self, work):
+        return self._ex.submit(work)
+
+    def shutdown(self, wait: bool = True):
+        self._ex.shutdown(wait=wait)
+
+
 def _cast_output(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
     """Cast resampled float32 frames to the requested output dtype.
 
@@ -1484,6 +1506,8 @@ class MotionCorrector:
             "drain_flushes": state["flushes"],
             "template_updates": n_updates,
             "device_templates": bool(dev_tmpl),
+            "upload_overlap": state["upload_overlap"],
+            "upload_waits": state["upload_waits"],
         }
         transforms = self._finalize_robustness(
             merged, transforms, start_frame, T - start_frame, timing,
@@ -1545,6 +1569,9 @@ class MotionCorrector:
             "native_ok": {},  # per-backend accepts_native_dtype flag
             "flushes": 0,  # full-pipeline drains (stall telemetry)
             "timer": None,  # StageTimer for drain-sync stall accounting
+            "uploader": None,  # lazy single-thread H2D staging worker
+            "upload_waits": 0,  # staged uploads the consumer waited on
+            "upload_overlap": False,  # did any batch ride a staged slot
         }
 
     def _dispatch_batches(
@@ -1660,7 +1687,55 @@ class MotionCorrector:
             while inflight:
                 self._drain_entry(inflight.pop(0), drain, to_host, state)
 
-        for n, batch, idx in batches:
+        # Double-buffered H2D (config.upload_overlap): a single-thread
+        # upload worker stages the NEXT batch's native-dtype device
+        # upload (backend.stage_upload — asarray + the donation
+        # ownership copy, exactly what dispatch would do inline) while
+        # the CURRENT batch's dispatch and device execution proceed, so
+        # host staging overlaps compute instead of serializing ahead of
+        # every dispatch. The consumer's wait on a not-yet-finished
+        # staged slot is the `upload_wait` stall. Byte-identical by
+        # construction: the slot holds the same arrays the inline path
+        # builds — only WHEN the bytes move changes.
+        overlap = bool(self.config.upload_overlap)
+
+        def stage_on(backend, nxt_batch):
+            """Submit the next batch's upload; (future, backend id) or
+            None where the seam doesn't apply (numpy backends, overlap
+            off)."""
+            stage = getattr(backend, "stage_upload", None)
+            if not overlap or stage is None:
+                return None
+            uploader = state["uploader"]
+            if uploader is None:
+                uploader = _UploadWorker()
+                state["uploader"] = uploader
+
+            def work():
+                t0 = time.perf_counter()
+                staged = stage(nxt_batch)
+                if tracer is not None:
+                    tracer.complete(
+                        "upload.stage", t0, time.perf_counter() - t0,
+                        cat="upload",
+                        args={"frames": int(nxt_batch.shape[0])},
+                    )
+                return staged
+
+            return uploader.submit(work), id(backend)
+
+        it = iter(batches)
+
+        def pull():
+            try:
+                return next(it)
+            except StopIteration:
+                return None
+
+        cur = pull()
+        slot = None  # staged upload for `cur`: (future, backend id)
+        while cur is not None:
+            n, batch, idx = cur
             backend = (
                 self._get_escalation_backend() if self._escalated else self.backend
             )
@@ -1671,6 +1746,25 @@ class MotionCorrector:
                 )
             if not native_ok[bkey] and batch.dtype != np.float32:
                 batch = batch.astype(np.float32)
+            # Resolve this batch's staged slot. `disp_batch` is what
+            # dispatch receives; `batch` stays the HOST array — the
+            # ladder re-dispatches from it and drain/rescue read it.
+            disp_batch = batch
+            if slot is not None:
+                fut, owner = slot
+                slot = None
+                t_wait = time.perf_counter()
+                staged = fut.result()
+                waited = time.perf_counter() - t_wait
+                if timer is not None:
+                    timer.add_stall("upload_wait", waited)
+                state["upload_waits"] += 1
+                if owner == bkey:
+                    disp_batch = staged
+                    state["upload_overlap"] = True
+                # else: escalation flipped the backend between staging
+                # and dispatch — drop the slot (its route/ownership
+                # decisions were the OLD backend's) and upload inline.
             dispatch = getattr(backend, "process_batch_async", None)
             kept = batch if keep_frames else None
             kw = {}
@@ -1711,6 +1805,13 @@ class MotionCorrector:
                         # ASYNC device array — no sync, no host round
                         # trip; the program scores it as hypothesis 0.
                         kw["seed"] = (seed, True)
+            # Advance the lookahead NOW: the next batch's upload runs
+            # on the worker while this batch dispatches and executes
+            # (the two-slot handoff). `cur` advances before dispatch so
+            # the ladder path's `continue` below keeps the loop moving.
+            cur = pull()
+            if cur is not None and dispatch is not None:
+                slot = stage_on(backend, cur[1])
             step = plan.op_index("device") if plan is not None else None
             t_disp = (
                 time.perf_counter()
@@ -1721,7 +1822,7 @@ class MotionCorrector:
                 if plan is not None:
                     plan.maybe_fail("device", step)
                 if dispatch is not None:
-                    out = dispatch(batch, ref, idx, **kw)
+                    out = dispatch(disp_batch, ref, idx, **kw)
                 else:
                     out = backend.process_batch(batch, ref, idx)
             except Exception as e:
@@ -1779,10 +1880,19 @@ class MotionCorrector:
                 # the frames here (no D2H saving, same results)
                 out = {k: v for k, v in out.items() if k != "corrected"}
             if dispatch is not None:
+                # The staged device buffer rides in the entry until its
+                # batch drains: dropping the last reference to an input
+                # buffer of an IN-FLIGHT program blocks the consumer
+                # thread on this image's CPU client until the program
+                # completes (measured ~a full batch per drop), which
+                # would serialize the very pipeline staging exists to
+                # overlap. By drain time the program has completed (the
+                # drain materializes its outputs), so the drop is free.
                 inflight.append(
                     (n, out, kept, batch if keep_for_ladder else None,
                      idx, step, backend, kw, emit_frames, cast_dtype, ref,
-                     t_disp_done)
+                     t_disp_done,
+                     disp_batch if disp_batch is not batch else None)
                 )
                 if len(inflight) >= depth:
                     self._drain_entry(inflight.pop(0), drain, to_host, state)
@@ -1804,6 +1914,13 @@ class MotionCorrector:
                     drain((n, out, kept, ref))
         if flush:
             flush_inflight()
+            uploader = state["uploader"]
+            if uploader is not None:
+                # End of the run (the final flush): the staging worker
+                # is idle by construction — every submitted slot was
+                # consumed or dropped before its batch dispatched.
+                state["uploader"] = None
+                uploader.shutdown(wait=True)
 
     def _drain_entry(self, entry, drain, to_host, state=None) -> None:
         """Drain one in-flight async batch. With the retry engine armed
@@ -1815,7 +1932,7 @@ class MotionCorrector:
         pre-boundary batch never re-register it against a template that
         advanced while it was in flight."""
         (n, out, kept, batch, idx, step, backend, kw, emit2, cast2, ref,
-         t_disp_done) = entry
+         t_disp_done, _staged_pin) = entry
         if self._robust_active() and to_host:
             timer = state.get("timer") if state is not None else None
             try:
@@ -2681,6 +2798,8 @@ class MotionCorrector:
             "drain_flushes": dp_state["flushes"],
             "template_updates": n_updates,
             "device_templates": bool(dev_tmpl),
+            "upload_overlap": dp_state["upload_overlap"],
+            "upload_waits": dp_state["upload_waits"],
         }
         obj_stats = {}
         if hasattr(ts, "stats_snapshot") and hasattr(ts, "arm"):

@@ -40,11 +40,19 @@ STALL_SPANS = frozenset(
         "writer_backpressure",
         "writer_flush",
         "template_update",
+        # Consumer wait on a not-yet-finished staged H2D upload slot
+        # (corrector._dispatch_batches double buffering, PR 18).
+        "upload_wait",
     }
 )
 
 # Per-batch dispatch + background-writer worker spans.
 DISPATCH_SPANS = frozenset({"dispatch_batch"})
+
+# Upload-worker spans (PR 18 double-buffered H2D): one `upload.stage`
+# per staged batch on the kcmc-upload worker's track — the host-side
+# asarray + ownership copy that now overlaps device execution.
+UPLOAD_SPANS = frozenset({"upload.stage"})
 WRITER_SPANS = frozenset(
     {
         "writer.append_batch",
@@ -70,6 +78,11 @@ INSTANT_NAMES = frozenset(
         "checkpoint_resume",
         "plan_cache_hit",
         "plan_cache_miss",
+        # Pipelined-collective breadcrumb (PR 18): one instant per
+        # sharded-program build recording the ppermute ring layout
+        # (chunks, devices, shape) — the collective itself traces
+        # inside the compiled program, invisible to the host tracer.
+        "collective.chunk",
     }
 )
 
@@ -119,6 +132,7 @@ SPAN_NAMES = (
     STAGE_SPANS
     | STALL_SPANS
     | DISPATCH_SPANS
+    | UPLOAD_SPANS
     | WRITER_SPANS
     | PLAN_SPANS
     | FEEDER_SPANS

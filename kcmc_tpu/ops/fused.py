@@ -23,6 +23,22 @@ Mixed precision rides here too: `precision` (the resolved
 `match_precision` config field) selects the exact int8 / bf16 / f32
 Hamming matmul variant (ops/match.hamming_matrix_mxu — identical
 distance matrices, different MXU paths).
+
+`fused_detect_describe` (PR 18) collapses the OTHER program seam the
+trace spans flag: detection and description used to reach XLA as two
+separately jitted programs with the selected `Keypoints` materialized
+between them — per octave on the pyramid path, so an `n_octaves=3`
+reference preparation dispatched six programs plus the pyramid resize
+and the merge, each boundary a host round-trip on the selected
+keypoint set. Here the pyramid build (MXU resize), every octave's
+detect→describe pair (Pallas response/extraction kernels where the
+frame size supports them and the autotuned tilings exist, the fused
+XLA fallbacks otherwise), and the base-coordinate merge trace as ONE
+region: the octave keypoint sets stay device-resident intermediates
+XLA can schedule freely, and the backend routes the whole region
+behind the plan machinery ("register" / "reference_pyramid"
+programs), so warm boots replay one stamped executable instead of
+re-dispatching the chain.
 """
 
 from __future__ import annotations
@@ -31,8 +47,96 @@ import jax
 import jax.numpy as jnp
 
 from kcmc_tpu.models.transforms import TransformModel
+from kcmc_tpu.ops.describe import describe_keypoints_batch
+from kcmc_tpu.ops.detect import detect_keypoints_batch
 from kcmc_tpu.ops.match import knn_match_impl
 from kcmc_tpu.ops.ransac import RansacResult, consensus_batch
+
+
+def fused_detect_describe(
+    frames: jnp.ndarray,
+    *,
+    max_keypoints: int,
+    detect_threshold: float,
+    nms_size: int,
+    border: int,
+    harris_k: float,
+    window_sigma: float,
+    blur_sigma: float,
+    cand_tile: int,
+    oriented: bool,
+    precision: str,
+    use_pallas: bool,
+    n_octaves: int = 1,
+    octave_scale: float = 2.0,
+    multi_scale: bool = True,
+    valid_hw: jnp.ndarray | None = None,
+    tiles: dict | None = None,
+):
+    """Detect + describe a (B, H, W) float32 batch as one traced region.
+
+    Single-scale (`n_octaves <= 1` or `multi_scale=False`): one
+    detect→describe pair, the blurred batch riding from the detection
+    kernel into description (no recomputed blur). Multi-scale: the ORB
+    pyramid — per-octave fixed-K detection/description on MXU-resized
+    images merged into one keypoint set in base coordinates
+    (ops/pyramid.py). Returns (Keypoints, desc), both with a leading
+    batch axis.
+
+    `tiles` carries the autotuned tile parameters stamped for the BASE
+    frame shape (backend `_tile_params`); octaves at other shapes keep
+    the per-kernel defaults — the dict is static by construction
+    (resolved at program-build time, never inside the trace).
+    `valid_hw` (traced (2,) ints) masks selection to the true extent
+    of bucket-padded frames; bucket routing gates pyramid configs out,
+    so it only applies single-scale.
+    """
+    tiles = tiles or {}
+
+    def stage(fr, k_octave, b):
+        t = tiles if tiles.get("shape") == tuple(fr.shape[1:]) else {}
+        kps, smooth = detect_keypoints_batch(
+            fr,
+            max_keypoints=k_octave,
+            threshold=detect_threshold,
+            nms_size=nms_size,
+            border=b,
+            harris_k=harris_k,
+            use_pallas=use_pallas,
+            smooth_sigma=blur_sigma,
+            window_sigma=window_sigma,
+            cand_tile=cand_tile,
+            valid_hw=valid_hw,
+            strip=t.get("detect_strip"),
+        )
+        desc = describe_keypoints_batch(
+            fr,
+            kps,
+            oriented=oriented,
+            blur_sigma=blur_sigma,
+            use_pallas=use_pallas,
+            smooth=smooth,
+            precision=precision,
+            bands=t.get("patch_bands"),
+        )
+        return kps, desc
+
+    if n_octaves <= 1 or not multi_scale:
+        return stage(frames, max_keypoints, border)
+
+    from kcmc_tpu.ops.pyramid import (
+        build_pyramid,
+        merge_octave_keypoints,
+        per_octave_k,
+    )
+
+    octs = build_pyramid(frames, n_octaves, octave_scale)
+    ks = per_octave_k(max_keypoints, n_octaves)
+    per = []
+    for oc, ko in zip(octs, ks):
+        b = min(border, min(oc.frames.shape[1:]) // 4)
+        per.append(stage(oc.frames, ko, b))
+    return merge_octave_keypoints(per, octs)
 
 
 def fused_match_consensus(

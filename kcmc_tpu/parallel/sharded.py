@@ -57,9 +57,50 @@ def shard_map(f, *, mesh, in_specs, out_specs, **kw):
 from kcmc_tpu.parallel.mesh import FRAME_AXIS
 
 
+def ring_all_gather(x, axis: str, axis_size: int, chunks: int):
+    """`lax.all_gather(x, axis, tiled=True)` as a chunked ppermute ring.
+
+    Value-identical to the monolithic tiled gather — the output is the
+    shards concatenated along axis 0 in axis-index order — but built
+    from `chunks` independent `lax.ppermute` pipelines per hop, so the
+    XLA scheduler can overlap each chunk's interconnect transfer with
+    the previous chunk's on-chip placement (and with whatever per-shard
+    compute is ready), instead of synchronizing the whole mesh on one
+    bulk gather. `chunks` is clamped to the local row count; every
+    shard has the same local K by shard_map construction, so the
+    static chunk layout lines up across the ring.
+    """
+    # Both are static Python ints at trace time: shard shapes are
+    # concrete under shard_map, and `chunks` is a config field.
+    K = x.shape[0]
+    chunks = max(1, min(chunks, K))
+    if axis_size <= 1:
+        return x
+    idx = lax.axis_index(axis)
+    fwd = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    bounds = [round(j * K / chunks) for j in range(chunks + 1)]
+    bufs = [
+        lax.slice_in_dim(x, bounds[j], bounds[j + 1], axis=0)
+        for j in range(chunks)
+    ]
+    out = jnp.zeros((axis_size * K,) + tuple(x.shape[1:]), x.dtype)
+    for s in range(axis_size):
+        # After s forward hops this device holds the shard that
+        # originated on axis index (idx - s) % n; its rows live at
+        # [src*K, (src+1)*K) of the tiled-gather layout.
+        src = (idx - s) % axis_size
+        for j, buf in enumerate(bufs):
+            out = lax.dynamic_update_slice_in_dim(
+                out, buf, src * K + bounds[j], axis=0
+            )
+        if s != axis_size - 1:
+            bufs = [lax.ppermute(b, axis, fwd) for b in bufs]
+    return out
+
+
 def make_sharded_batch_fn(
     local_batch_fn, mesh: Mesh, axis: str = FRAME_AXIS,
-    extra_replicated: int = 0,
+    extra_replicated: int = 0, collective_chunks: int = 0,
 ):
     """Wrap a local batch program into a sharded one.
 
@@ -77,14 +118,26 @@ def make_sharded_batch_fn(
     Returns a jitted fn whose frame-axis inputs/outputs are sharded over
     `mesh`; ref_* inputs are sharded over the *keypoint* axis (the
     reference frame over its row axis) and all-gathered on device.
+
+    `collective_chunks >= 2` (the config field) routes the reference
+    gathers through `ring_all_gather` — chunked ppermute rings the
+    scheduler can pipeline against per-shard compute — instead of the
+    monolithic synchronizing `all_gather`. Identical values either way.
     """
+    n = mesh_size(mesh)
+    use_ring = collective_chunks >= 2 and n > 1
+
+    def gather(x):
+        if use_ring:
+            return ring_all_gather(x, axis, n, collective_chunks)
+        return lax.all_gather(x, axis, tiled=True)
 
     def local_block(frames, ref_xy, ref_desc, ref_valid, ref_frame, indices,
                     *extra):
         # One all-gather per reference array: K/n -> K on every chip.
-        ref_xy = lax.all_gather(ref_xy, axis, tiled=True)
-        ref_desc = lax.all_gather(ref_desc, axis, tiled=True)
-        ref_valid = lax.all_gather(ref_valid, axis, tiled=True)
+        ref_xy = gather(ref_xy)
+        ref_desc = gather(ref_desc)
+        ref_valid = gather(ref_valid)
         return local_batch_fn(
             frames, ref_xy, ref_desc, ref_valid, ref_frame, indices, *extra
         )

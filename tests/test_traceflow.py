@@ -360,6 +360,43 @@ class B:
     assert [f for f in fs if f.rule == "bucket-escape"] == []
 
 
+# -- roofline-vocab ----------------------------------------------------------
+
+
+def test_roofline_vocab_fires_on_unknown_program_literal():
+    """A plan-routed program literal with no PROGRAM_VOCAB entry would
+    silently escape the roofline cost model — the rule warns at the
+    routing site."""
+    fs = tf({"kcmc_tpu/plans/bad.py": """
+def build(rt, fn):
+    return rt.maybe_timed("mystery_warp", fn)
+"""})
+    hits = [f for f in fs if f.rule == "roofline-vocab"]
+    assert len(hits) == 1, fs
+    assert "mystery_warp" in hits[0].message
+    assert hits[0].severity == "warning"
+
+
+def test_roofline_vocab_quiet_on_known_and_variable_names():
+    """Known vocabulary entries are quiet; a name threaded through a
+    variable is not a literal routing site (covered elsewhere)."""
+    fs = tf({"kcmc_tpu/plans/ok.py": """
+def build(rt, fn, name):
+    a = rt.maybe_timed("register", fn)
+    b = rt.timed("quality", a)
+    return rt.maybe_timed(name, b)
+"""})
+    assert [f for f in fs if f.rule == "roofline-vocab"] == []
+
+
+def test_roofline_vocab_ignores_modules_outside_scope():
+    fs = tf({"kcmc_tpu/io/elsewhere.py": """
+def build(rt, fn):
+    return rt.maybe_timed("mystery_warp", fn)
+"""})
+    assert [f for f in fs if f.rule == "roofline-vocab"] == []
+
+
 # -- donation ----------------------------------------------------------------
 
 
