@@ -289,6 +289,7 @@ def run_bench_streaming(
 
 def run_bench_serve(
     n_frames: int, size: int, batch: int, n_streams: int = 2,
+    trace: bool = False,
     **mc_overrides,
 ) -> dict:
     """The serving path: N concurrent client streams multiplexed
@@ -297,17 +298,33 @@ def run_bench_serve(
     socket serialization). Reports total + per-stream fps, batch
     occupancy, and admission counters from `stats()` so a scheduler
     regression (occupancy collapse, spurious degradation) is visible
-    round over round."""
+    round over round.
+
+    `trace=True` arms distributed tracing exactly as a traced client
+    would: a span-shard dir on the scheduler and a freshly minted trace
+    context on every submit, so the run pays span emission + exemplar
+    noting on the hot path — the ON arm of the `trace_overhead` A/B."""
+    import tempfile
     import threading
 
     from kcmc_tpu import MotionCorrector
     from kcmc_tpu.serve.scheduler import OverloadedError, StreamScheduler
+
+    if trace:
+        from kcmc_tpu.obs.tracing import new_context
 
     data = _build_stack(n_frames, size, "translation")
     base = len(data.stack)
     reps = (n_frames + base - 1) // base
     stack = np.tile(data.stack, (reps, 1, 1))[:n_frames].astype(np.float32)
 
+    trace_dir = (
+        tempfile.TemporaryDirectory(prefix="kcmc-bench-spans-")
+        if trace
+        else None
+    )
+    if trace:
+        mc_overrides.setdefault("trace_shard_dir", trace_dir.name)
     mc = MotionCorrector(
         model="translation", backend="jax", batch_size=batch, **mc_overrides
     )
@@ -326,7 +343,10 @@ def run_bench_serve(
                 part = stack[lo : lo + chunk]
                 while True:
                     try:
-                        sched.submit(sess.sid, part)
+                        sched.submit(
+                            sess.sid, part,
+                            trace=new_context() if trace else None,
+                        )
                         break
                     except OverloadedError:
                         # Backpressure, the well-behaved-client idiom:
@@ -348,6 +368,8 @@ def run_bench_serve(
         metrics = sched.metrics()
     finally:
         sched.stop()
+        if trace_dir is not None:
+            trace_dir.cleanup()
     total = n_frames * n_streams
     rmse = max(
         _rmse(data, "translation", r.transforms, None)
@@ -395,6 +417,7 @@ def run_bench_serve(
         "admission": stats["admission"],
         "latency_ms": latency_ms or None,
         "per_stream_latency_ms": per_stream_latency_ms or None,
+        "trace": trace,
     }
 
 
@@ -1545,6 +1568,14 @@ def main() -> None:
         "docs/OBSERVABILITY.md 'Request latency'",
     )
     ap.add_argument(
+        "--trace-off", action="store_true",
+        help="run --serve with distributed tracing unarmed and skip "
+        "the trace_overhead A/B — by default the serve row runs twice "
+        "(traced vs untraced, same protocol as --latency-off) and "
+        "records the judged trace_overhead column (< 2%% contract, "
+        "docs/OBSERVABILITY.md 'Distributed tracing')",
+    )
+    ap.add_argument(
         "--coldstart", action="store_true",
         help="cold-start mode: measure process start -> first corrected "
         "frame in fresh subprocesses, cold compile cache vs warm "
@@ -1847,10 +1878,11 @@ def main() -> None:
         rv = _run_with_retry(
             run_bench_serve, args.frames, args.size, args.batch,
             n_streams=args.streams,
+            trace=not args.trace_off,
             latency_telemetry=not args.latency_off,
         )
         configs = dict(configs or {})
-        configs[f"serve_{args.streams}streams"] = dict(
+        serve_row = dict(
             _config_row(rv),
             per_stream_fps=rv["per_stream_fps"],
             n_streams=rv["n_streams"],
@@ -1859,7 +1891,34 @@ def main() -> None:
             latency_telemetry=not args.latency_off,
             latency_ms=rv["latency_ms"],
             per_stream_latency_ms=rv["per_stream_latency_ms"],
+            trace=not args.trace_off,
         )
+        if not args.trace_off:
+            # The judged trace_overhead column: re-run the identical
+            # workload with tracing unarmed (the same A/B protocol as
+            # --latency-off) and record the relative mean-fps delta —
+            # the <2% overhead contract of docs/OBSERVABILITY.md
+            # "Distributed tracing".
+            rv_off = _run_with_retry(
+                run_bench_serve, args.frames, args.size, args.batch,
+                n_streams=args.streams,
+                trace=False,
+                latency_telemetry=not args.latency_off,
+            )
+            overhead = (rv_off["fps"] - rv["fps"]) / max(
+                rv_off["fps"], 1e-9
+            )
+            serve_row["fps_trace_off"] = round(rv_off["fps"], 2)
+            serve_row["trace_overhead"] = round(overhead, 4)
+            serve_row["trace_overhead_ok"] = bool(overhead < 0.02)
+            print(
+                f"[bench] serve trace overhead: {overhead * 100:.2f}% "
+                f"({rv['fps']:.1f} fps traced vs {rv_off['fps']:.1f} "
+                "untraced; contract < 2%"
+                + ("" if overhead < 0.02 else " — OVER") + ")",
+                file=sys.stderr,
+            )
+        configs[f"serve_{args.streams}streams"] = serve_row
         tot_lat = (rv["latency_ms"] or {}).get("request.total")
         print(
             f"[bench] serve x{args.streams} {args.size}x{args.size}: "

@@ -464,6 +464,10 @@ def _cmd_serve(args) -> int:
         overrides["serve_session_timeout_s"] = args.session_timeout
     if args.io_timeout is not None:
         overrides["serve_io_timeout_s"] = args.io_timeout
+    if getattr(args, "trace_shards", ""):
+        overrides["trace_shard_dir"] = args.trace_shards
+    if getattr(args, "slo", ""):
+        overrides["slo_objectives"] = args.slo
     args.reference = ref
     args.overrides = overrides
     from kcmc_tpu.serve.server import serve_main
@@ -589,6 +593,80 @@ def _cmd_metrics(args) -> int:
         print(render_prometheus(m), end="")
     else:
         print(json.dumps(m))
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    """Stitch distributed request traces (docs/OBSERVABILITY.md
+    "Distributed tracing") from span shards on disk and/or a live
+    server/router's `trace` verb, and render the slowest requests with
+    their critical path — which lifecycle segment dominated each."""
+    import os
+
+    from kcmc_tpu.obs.tracing import (
+        chrome_trace,
+        collect_spans,
+        critical_path,
+        slowest,
+        stitch,
+    )
+
+    spans: list = []
+    for src in args.sources:
+        if os.path.exists(src):
+            spans.extend(collect_spans([src]))
+        else:
+            from kcmc_tpu.obs.top import parse_addr
+            from kcmc_tpu.serve.client import ServeClient
+
+            host, port = parse_addr(src)
+            with ServeClient(host=host, port=port) as c:
+                spans.extend(c.trace_dump())
+    traces = stitch(spans)
+    if args.chrome:
+        with open(args.chrome, "w", encoding="utf-8") as f:
+            json.dump(chrome_trace(spans), f)
+    rows = slowest(traces, n=args.slowest)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "kind": "kcmc_trace",
+                    "n_spans": len(spans),
+                    "n_traces": len(traces),
+                    "slowest": rows,
+                }
+            )
+        )
+        return 0
+    print(f"{len(traces)} traces / {len(spans)} spans")
+    if rows:
+        print(
+            f"  {'trace':<32} {'total':>10} {'spans':>6}  dominant"
+        )
+        for r in rows:
+            tot = (
+                f"{r['total_s'] * 1e3:.1f}ms"
+                if r.get("total_s") is not None
+                else "—"
+            )
+            print(
+                f"  {r['trace_id']:<32} {tot:>10} "
+                f"{r['n_spans']:>6}  {r.get('dominant') or '—'}"
+            )
+        # segment breakdown of the slowest request — the "why"
+        cp = critical_path(traces[rows[0]["trace_id"]])
+        parts = ", ".join(
+            f"{seg.split('.', 1)[-1]}={dur * 1e3:.1f}ms"
+            for seg, dur in sorted(
+                (cp.get("segments") or {}).items(),
+                key=lambda kv: -kv[1],
+            )
+        )
+        if parts:
+            print(f"  slowest breakdown: {parts}")
+    if args.chrome:
+        print(f"chrome trace written to {args.chrome}")
     return 0
 
 
@@ -905,6 +983,21 @@ def main(argv=None) -> int:
         help="per-session frame-quality JSONLs (session-id derived "
         "filenames)",
     )
+    p.add_argument(
+        "--trace-shards", default="", metavar="DIR",
+        help="distributed-tracing span-shard directory "
+        "(trace_shard_dir): finished request/RPC spans append to a "
+        "bounded per-process JSONL under DIR; stitch with `kcmc_tpu "
+        "trace DIR` (docs/OBSERVABILITY.md 'Distributed tracing')",
+    )
+    p.add_argument(
+        "--slo", default="", metavar="SPEC",
+        help="declarative SLO objectives (slo_objectives): "
+        "';'-separated rung:threshold_s:fraction (latency) or "
+        "avail:fraction entries, e.g. 'full:0.5:0.99;avail:0.999'; "
+        "multi-window burn rates ride the metrics verb as kcmc_slo_* "
+        "gauges and the heartbeat",
+    )
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser(
@@ -993,6 +1086,19 @@ def main(argv=None) -> int:
         "the `fleet` surface — a raising clause blackholes a "
         "router->replica call, stall= stalls a health scrape past "
         "its budget; also via KCMC_FAULT_PLAN",
+    )
+    p.add_argument(
+        "--trace-shards", default="", metavar="DIR",
+        help="distributed-tracing span-shard directory for the router "
+        "AND spawned replicas (trace_shard_dir): the whole fleet "
+        "shards into DIR, so `kcmc_tpu trace DIR` stitches one fleet "
+        "trace per request",
+    )
+    p.add_argument(
+        "--slo", default="", metavar="SPEC",
+        help="fleet SLO objectives (slo_objectives; see `serve "
+        "--slo`): burn rates computed over the exact-merged fleet "
+        "histograms; alert transitions land in the router log",
     )
     p.set_defaults(fn=_cmd_router)
 
@@ -1145,6 +1251,34 @@ def main(argv=None) -> int:
         "gauges) instead of the JSON payload",
     )
     p.set_defaults(fn=_cmd_metrics)
+
+    p = sub.add_parser(
+        "trace",
+        help="stitch distributed request traces from span shards "
+        "and/or a live server/router's `trace` verb: slowest-N "
+        "requests with per-request critical paths (which lifecycle "
+        "segment dominated), optional Chrome/Perfetto export "
+        "(docs/OBSERVABILITY.md 'Distributed tracing')",
+    )
+    p.add_argument(
+        "sources", nargs="+", metavar="SRC",
+        help="span-shard .jsonl files, shard directories "
+        "(--trace-shards DIR), or host:port of a live server/router",
+    )
+    p.add_argument(
+        "--slowest", type=int, default=10, metavar="N",
+        help="slowest-N requests to list (default 10)",
+    )
+    p.add_argument(
+        "--chrome", default="", metavar="PATH",
+        help="also write the stitched multi-process trace as Chrome "
+        "trace-event JSON (load in Perfetto / chrome://tracing)",
+    )
+    p.add_argument(
+        "--json", action="store_true",
+        help="machine-readable JSON summary instead of the text table",
+    )
+    p.set_defaults(fn=_cmd_trace)
 
     p = sub.add_parser(
         "top",

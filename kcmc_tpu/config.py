@@ -295,6 +295,29 @@ class CorrectorConfig:
     # < 2% on `bench.py --serve` — the acceptance gate). On by
     # default; False drops every record site to one attribute check.
     latency_telemetry: bool = True
+    # Distributed-trace span-shard directory ("" = tracing off): each
+    # serve process appends finished spans (request segments, RPC
+    # spans, migration links) to its own bounded JSONL shard under this
+    # directory, torn-tail tolerant like frame records. `kcmc_tpu
+    # trace DIR` stitches the shards into per-request causal traces
+    # (docs/OBSERVABILITY.md "Distributed tracing"). CLI (serve/
+    # router): --trace-shards DIR.
+    trace_shard_dir: str = ""
+    # Per-process span-shard bound, in spans: the in-memory ring the
+    # `trace` verb serves holds this many, and the shard FILE stops
+    # growing past it (further spans counted as dropped) — a long-
+    # lived replica must not grow an unbounded trace file.
+    trace_shard_cap: int = 4096
+    # Declarative SLO objectives ("" = engine off): ';'-separated
+    # entries, each `rung:threshold_s:fraction` (latency — that
+    # fraction of `request.total` observations on that QoS rung must
+    # land under the threshold) or `avail:fraction` (availability —
+    # admitted-frame fraction). The serve plane computes multi-window
+    # burn rates (5m/1h fast, 6h/3d slow) from the mergeable
+    # histograms and exposes them as `kcmc_slo_*` gauges, a heartbeat
+    # line, and router alert-log entries. Example:
+    # "full:0.5:0.99;degraded:2.0:0.95;avail:0.999". CLI: --slo SPEC.
+    slo_objectives: str = ""
 
     # -- serving (kcmc_tpu/serve; docs/SERVING.md) -------------------------
     # Per-session admission bound, in frames: a `submit_frames` that
@@ -887,6 +910,17 @@ class CorrectorConfig:
                 f"heartbeat_s must be >= 0 seconds (0 = off), got "
                 f"{self.heartbeat_s}"
             )
+        if self.trace_shard_cap <= 0:
+            raise ValueError(
+                "trace_shard_cap must be a positive span count, got "
+                f"{self.trace_shard_cap}"
+            )
+        if self.slo_objectives:
+            # parse eagerly so a malformed spec fails at config time,
+            # naming the bad entry, not mid-serve
+            from kcmc_tpu.obs.slo import parse_objectives
+
+            parse_objectives(self.slo_objectives)
         if not 0.0 < self.rescue_warn_fraction <= 1.0:
             raise ValueError(
                 "rescue_warn_fraction must be in (0, 1], got "
@@ -1036,6 +1070,11 @@ SIG_NEUTRAL_FIELDS = frozenset(
         # Pure observability: histograms record WHEN things happened,
         # never change what a run computes.
         "latency_telemetry",
+        # Distributed tracing + SLO engine (PR 19): span shards and
+        # burn-rate gauges observe the request path, never steer it.
+        "trace_shard_dir",
+        "trace_shard_cap",
+        "slo_objectives",
         "serve_queue_depth",
         "serve_inflight",
         "serve_degrade_watermark",

@@ -129,6 +129,9 @@ def aggregate_sampler(snapshot):
     * ``latency`` — optional end-to-end request-latency digest
       (``{"p50_ms", "p99_ms"}`` of the plane's ``request.total``
       histogram) — the liveness line's tail-latency pulse;
+    * ``slo`` — optional pre-formatted SLO burn-rate line from
+      ``SLOEngine.heartbeat()`` (obs/slo.py) — rendered verbatim
+      between the latency pulse and the staleness list;
     * ``stale`` — optional ``{session name: idle seconds}`` of clients
       approaching the staleness reap;
     * ``loop_beat_age_s`` — optional scheduler-loop liveness age; ages
@@ -185,6 +188,9 @@ def aggregate_sampler(snapshot):
                 f"latency p50={float(lat.get('p50_ms', 0.0)):.0f}ms "
                 f"p99={float(lat['p99_ms']):.0f}ms"
             )
+        slo = snap.get("slo")
+        if slo:
+            parts.append(str(slo))
         stale = snap.get("stale")
         if stale:
             parts.append(

@@ -216,14 +216,21 @@ class RequestClock:
     frame's submit-entry `perf_counter()` stamp (the anchor of
     `request.total`); the remaining fields are batch-level."""
 
-    __slots__ = ("t_submit", "t_formed", "t_dispatched", "t_host", "rung")
+    __slots__ = (
+        "t_submit", "t_formed", "t_dispatched", "t_host", "rung", "trace",
+    )
 
-    def __init__(self, t_submit, t_formed: float):
+    def __init__(self, t_submit, t_formed: float, trace: dict | None = None):
         self.t_submit = t_submit
         self.t_formed = t_formed
         self.t_dispatched: float | None = None
         self.t_host: float | None = None
         self.rung: str = DEFAULT_RUNG
+        # Distributed-trace context of the request(s) in this batch
+        # ({"trace_id", "span_id", ...}, obs/tracing.py) — threads the
+        # id from submit through dispatch to drain so device spans and
+        # bucket exemplars name the originating trace.
+        self.trace = trace
 
 
 class SegmentLatencies:
@@ -334,10 +341,15 @@ def _fmt_le(ns: int) -> str:
 def render_prometheus(metrics: dict) -> str:
     """Prometheus text exposition (version 0.0.4) of a `metrics` verb
     payload: request-latency histograms (cumulative buckets + sum +
-    count per segment/rung), serve counters, and serve gauges. Works
-    on a live reply or a dumped snapshot — pure dict in, text out."""
+    count per segment/rung), serve counters, serve gauges, SLO burn
+    gauges, and — when the payload carries an `exemplars` section
+    (obs/tracing.py) — OpenMetrics ``# {trace_id=...}`` exemplar
+    suffixes on the matching bucket lines. Works on a live reply or a
+    dumped snapshot — pure dict in, text out. Every `# TYPE` line has
+    a matching `# HELP` line (format-test enforced)."""
     lines: list[str] = []
 
+    exemplars = metrics.get("exemplars") or {}
     hists = (metrics.get("plane") or {}).get("histograms") or {}
     if hists:
         lines.append(
@@ -357,16 +369,25 @@ def render_prometheus(metrics: dict) -> str:
                     counts[int(k)] = int(c)
                 total = int(d.get("count", 0))
                 acc = 0
+                seg_ex = (exemplars.get(seg) or {}).get(rung) or {}
                 for i, edge in enumerate(_EDGES_NS):
                     acc += counts[i]
                     # render populated prefixes only (a subset of le's
                     # plus +Inf is valid exposition); stop once the
                     # cumulative count is complete
                     if counts[i]:
-                        lines.append(
+                        line = (
                             "kcmc_request_latency_seconds_bucket"
                             f'{{{labels},le="{_fmt_le(edge)}"}} {acc}'
                         )
+                        ex = seg_ex.get(str(i))
+                        if isinstance(ex, dict) and ex.get("trace_id"):
+                            line += (
+                                " # {trace_id=\""
+                                f"{_prom_escape(ex['trace_id'])}\"}} "
+                                f"{float(ex.get('value_s', 0.0)):.9g}"
+                            )
+                        lines.append(line)
                     if acc >= total - counts[-1]:
                         break
                 lines.append(
@@ -384,6 +405,7 @@ def render_prometheus(metrics: dict) -> str:
 
     for name, value in sorted((metrics.get("counters") or {}).items()):
         metric = f"kcmc_serve_{name}_total"
+        lines.append(f"# HELP {metric} Serve counter `{name}`.")
         lines.append(f"# TYPE {metric} counter")
         lines.append(f"{metric} {int(value)}")
 
@@ -391,15 +413,26 @@ def render_prometheus(metrics: dict) -> str:
     queues = gauges.pop("queues", None)
     for name, value in sorted(gauges.items()):
         metric = f"kcmc_serve_{name}"
+        lines.append(f"# HELP {metric} Serve gauge `{name}`.")
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {float(value):.9g}")
     if queues:
+        lines.append(
+            "# HELP kcmc_serve_queue_frames Undispatched frames"
+            " queued per open session."
+        )
         lines.append("# TYPE kcmc_serve_queue_frames gauge")
         for sid in sorted(queues):
             lines.append(
                 "kcmc_serve_queue_frames"
                 f'{{session="{_prom_escape(sid)}"}} {int(queues[sid])}'
             )
+
+    slo = metrics.get("slo")
+    if slo:
+        from .slo import render_slo_prometheus  # lazy: avoids cycle
+
+        lines.extend(render_slo_prometheus(slo))
     return "\n".join(lines) + "\n"
 
 

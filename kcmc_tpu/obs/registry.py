@@ -125,8 +125,19 @@ OBJECT_SPANS = frozenset({"object.get", "object.put"})
 # router records into its own SegmentLatencies — `fleet.migrate` is
 # one whole session migration (pick survivor -> resume_session ->
 # tail replay -> rebind), surfaced through the router's `metrics`
-# rollup so migration cost is visible fleet-wide.
+# rollup so migration cost is visible fleet-wide. With distributed
+# tracing (PR 19) the router also emits the migration as a link span
+# into its span shard, carrying the migrated session's trace id so
+# the stitched trace crosses replicas.
 FLEET_SPANS = frozenset({"fleet.migrate"})
+
+# Distributed-tracing RPC hop spans (obs/tracing.py SpanShard): one
+# span per protocol hop of a traced request — the client side of a
+# call (serve/client.py), the router forward (serve/router.py), and
+# the replica handling it (serve/server.py). Together with the
+# REQUEST_SEGMENTS spans the scheduler/session emit per batch, they
+# form the causal tree `kcmc_tpu trace` stitches.
+TRACE_SPANS = frozenset({"rpc.client", "rpc.router", "rpc.server"})
 
 SPAN_NAMES = (
     STAGE_SPANS
@@ -141,6 +152,7 @@ SPAN_NAMES = (
     | REQUEST_SEGMENTS
     | JOURNAL_SPANS
     | FLEET_SPANS
+    | TRACE_SPANS
     | OBJECT_SPANS
 )
 

@@ -45,11 +45,42 @@ _PCTS = (5, 25, 50, 75, 95)
 
 def load_run(path: str) -> dict:
     """Normalize either artifact into
-    {manifest, records: [dict], timing, robustness, source}."""
+    {manifest, records: [dict], timing, robustness, source}.
+    Distributed-tracing span shards (a shard .jsonl or a directory of
+    them) normalize to {source, spans} and render as the critical-path
+    report."""
     p = str(path)
     if p.endswith(".npz"):
         return _load_npz(p)
+    spans = _load_maybe_spans(p)
+    if spans is not None:
+        return spans
     return _load_jsonl(p)
+
+
+def _load_maybe_spans(path: str) -> dict | None:
+    """The artifact as {source, spans} if it is a span shard (header
+    kind kcmc_span_shard) or a directory containing shards; None
+    otherwise — frame-records JSONLs have a different header kind and
+    fall through to the frame-quality loader."""
+    import os
+
+    from kcmc_tpu.obs.tracing import SHARD_KIND, collect_spans
+
+    if os.path.isdir(path):
+        try:
+            spans = collect_spans([path])
+        except (OSError, ValueError):
+            return None
+        return {"source": path, "spans": spans} if spans else None
+    try:
+        with open(path, encoding="utf-8") as f:
+            first = json.loads(f.readline() or "null")
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    if not (isinstance(first, dict) and first.get("kind") == SHARD_KIND):
+        return None
+    return {"source": path, "spans": collect_spans([path])}
 
 
 def _load_jsonl(path: str) -> dict:
@@ -279,9 +310,79 @@ def _latency_table(timing: dict) -> list[str]:
     return lines
 
 
+def _critical_path_summary(spans) -> dict | None:
+    """Per-request dominant-segment histogram from distributed-tracing
+    span shards: {n_traces, dominant: {segment: count}, slowest:
+    [{trace_id, total_s, dominant}]}. None when the artifact predates
+    tracing (no spans) — the renderers show "—" instead of a table."""
+    if not spans:
+        return None
+    from kcmc_tpu.obs.tracing import critical_path, slowest, stitch
+
+    traces = stitch(spans)
+    counts: dict[str, int] = {}
+    total_by: dict[str, float] = {}
+    for trace_spans in traces.values():
+        cp = critical_path(trace_spans)
+        dom = cp.get("dominant")
+        if dom is None:
+            continue
+        counts[dom] = counts.get(dom, 0) + 1
+        total_by[dom] = total_by.get(dom, 0.0) + float(
+            cp.get("total_s") or 0.0
+        )
+    if not counts:
+        return None
+    return {
+        "n_traces": len(traces),
+        "dominant": counts,
+        "mean_total_s": {
+            seg: total_by[seg] / counts[seg] for seg in counts
+        },
+        "slowest": slowest(traces, n=5),
+    }
+
+
+def _critical_path_table(spans) -> list[str]:
+    """The "Critical path" report section. Always present: artifacts
+    without span shards (every pre-tracing run) render "—" rather than
+    omitting the section, so a reader knows tracing simply wasn't on —
+    and never crash, whatever shape the artifact has."""
+    cp = _critical_path_summary(spans)
+    if cp is None:
+        return ["Critical path: — (no span shards in this artifact)"]
+    n = sum(cp["dominant"].values())
+    lines = [
+        f"Critical path ({cp['n_traces']} traced requests, "
+        "dominant segment per request):",
+        f"  {'dominant segment':<22} {'requests':>9} {'share':>7}"
+        f" {'mean e2e':>10}",
+    ]
+    for seg, c in sorted(cp["dominant"].items(), key=lambda kv: -kv[1]):
+        lines.append(
+            f"  {seg:<22} {c:>9} {100.0 * c / n:>6.1f}%"
+            f" {_fmt_ms(cp['mean_total_s'][seg]):>8}ms"
+        )
+    rows = cp.get("slowest") or []
+    if rows:
+        lines.append("  slowest:")
+        for r in rows:
+            lines.append(
+                f"    {r['trace_id']}  {_fmt_ms(r['total_s']):>8}ms"
+                f"  dominant={r.get('dominant') or '—'}"
+            )
+    return lines
+
+
 def render_report(run: dict, top: int = 10) -> str:
     """The human-readable report text."""
     lines = [f"# kcmc run report — {run.get('source', '?')}"]
+    if run.get("spans") is not None:
+        # A span-shard artifact IS the critical-path report — no
+        # frame-quality sections to render.
+        lines.append("")
+        lines.extend(_critical_path_table(run["spans"]))
+        return "\n".join(lines) + "\n"
     man = run.get("manifest")
     if man:
         v = man.get("versions", {})
@@ -405,6 +506,8 @@ def render_report(run: dict, top: int = 10) -> str:
             lines.append(
                 f"  quarantined checkpoint parts: {rb['quarantined_parts']}"
             )
+    lines.append("")
+    lines.extend(_critical_path_table(run.get("spans")))
     return "\n".join(lines) + "\n"
 
 
@@ -483,8 +586,8 @@ def main(path: str, top: int = 10, as_json: bool = False) -> int:
     ) as e:
         print(
             f"kcmc report: {path!r} is not a readable run artifact "
-            f"(expected a --frame-records JSONL or a `correct "
-            f"--transforms` .npz): {e}",
+            f"(expected a --frame-records JSONL, a `correct "
+            f"--transforms` .npz, or a trace span shard): {e}",
             file=sys.stderr,
         )
         return 2
@@ -520,5 +623,8 @@ def _json_summary(run: dict, top: int) -> dict:
         "worst_frames": [
             r.get("frame") for r in _worst_frames(records, top)
         ],
+        # dominant-segment histogram from span shards; None on every
+        # pre-tracing artifact (the text report renders "—")
+        "critical_path": _critical_path_summary(run.get("spans")),
         "incomplete": bool(run.get("incomplete")),
     }

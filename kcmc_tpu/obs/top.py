@@ -113,19 +113,31 @@ def render(metrics: dict, stats: dict, addr: str) -> str:
             )
 
     totals = (metrics.get("plane") or {}).get("totals") or {}
+    exemplars = metrics.get("exemplars") or {}
     lines.append("")
     if totals:
-        lines.append(
+        header = (
             f"  {'segment':<22} {'count':>8} {'p50':>10} {'p99':>10}"
             f" {'max':>10}"
         )
+        if exemplars:
+            header += "  exemplar"
+        lines.append(header)
         for seg in sorted(totals, key=_seg_rank):
             s = totals[seg]
-            lines.append(
+            row = (
                 f"  {seg:<22} {s.get('count', 0):>8}"
                 f" {_ms(s.get('p50_s')):>10} {_ms(s.get('p99_s')):>10}"
                 f" {_ms(s.get('max_s')):>10}"
             )
+            if exemplars:
+                # a trace id FROM the segment's slowest populated
+                # bucket — copy it into `kcmc_tpu trace` to see why
+                from kcmc_tpu.obs.tracing import top_exemplar
+
+                ex = top_exemplar(exemplars, seg)
+                row += f"  {ex['trace_id']}" if ex else "  —"
+            lines.append(row)
     else:
         lines.append(
             "  (no request latency yet"

@@ -60,9 +60,16 @@ class Tracer:
         dur_s: float,
         cat: str = "stage",
         args: dict | None = None,
+        trace_id: str | None = None,
+        span_id: str | None = None,
+        parent_id: str | None = None,
     ) -> None:
         """Record a finished span: `t0` is its start as a
-        `time.perf_counter()` value, `dur_s` its duration in seconds."""
+        `time.perf_counter()` value, `dur_s` its duration in seconds.
+        Distributed-trace identity (`trace_id`/`span_id`/`parent_id`,
+        obs/tracing.py) rides in `args` so Perfetto shows which fleet
+        request a process-local span served — the golden event schema
+        (name/ph/ts/dur/pid/tid) is untouched."""
         ev = {
             "name": name,
             "cat": cat,
@@ -70,6 +77,15 @@ class Tracer:
             "ts": (t0 - self._t0) * 1e6,
             "dur": dur_s * 1e6,
         }
+        if trace_id or span_id or parent_id:
+            args = dict(args or {})
+            for k, v in (
+                ("trace_id", trace_id),
+                ("span_id", span_id),
+                ("parent_id", parent_id),
+            ):
+                if v:
+                    args[k] = v
         if args:
             ev["args"] = args
         self._append(ev)

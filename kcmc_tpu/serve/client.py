@@ -67,6 +67,8 @@ class ServeClient:
         io_timeout: float | None = None,
         reconnect_attempts: int = 4,
         reconnect_backoff_s: float = 0.25,
+        trace: bool = True,
+        trace_shard=None,
     ):
         """`timeout` bounds long blocking ops (close_session's default
         wait) and CAPS the transport deadlines below — the historical
@@ -78,7 +80,16 @@ class ServeClient:
         advertises its configured value for operator tooling);
         `connect_timeout` bounds each (re)connect;
         `reconnect_attempts`/`reconnect_backoff_s` shape the
-        exponential-backoff reconnect loop."""
+        exponential-backoff reconnect loop.
+
+        `trace` (default on): every call mints a 128-bit trace id +
+        root span id (obs/tracing.py) and sends them as the message's
+        ``trace`` field, so any request can be followed client →
+        router → replica → device. Minting is two `os.urandom` reads
+        per call — the A/B bench gate pins the end-to-end overhead
+        < 2%. `trace_shard` (a path or an `obs.tracing.SpanShard`)
+        additionally records one client-side `rpc.client` span per
+        call, giving the stitched fleet trace its root."""
         if io_timeout is None:
             from kcmc_tpu.config import CorrectorConfig
 
@@ -128,6 +139,19 @@ class ServeClient:
         # AFTER the server released the span would otherwise silently
         # gap the stream — the mismatch raises instead (code 410).
         self._results_next: dict[str, int] = {}
+        self._trace = bool(trace)
+        # The context of the most recent traced call — tests and the
+        # bench A/B read the trace id of the request they just made.
+        self.last_trace: dict | None = None
+        self._trace_shard = None
+        if trace_shard is not None:
+            from kcmc_tpu.obs.tracing import SpanShard
+
+            self._trace_shard = (
+                trace_shard
+                if isinstance(trace_shard, SpanShard)
+                else SpanShard(str(trace_shard))
+            )
         self._connect_locked()
 
     # -- plumbing ----------------------------------------------------------
@@ -189,6 +213,17 @@ class ServeClient:
             None if _budget is None else time.monotonic() + float(_budget)
         )
         msg = {"op": op, **fields}
+        ctx = None
+        if self._trace and "trace" not in msg:
+            # Mint ONCE per call, before the retry loop: a reconnect
+            # replay re-sends the same trace/span ids, so the server's
+            # idempotent dedup and the trace tree agree on identity.
+            from kcmc_tpu.obs.tracing import new_context
+
+            ctx = new_context()
+            msg["trace"] = ctx
+        t_wall = time.time()
+        t_perf = time.perf_counter()
         last: Exception | None = None
         resp: dict | None = None
         with self._lock:
@@ -196,6 +231,10 @@ class ServeClient:
                 raise RuntimeError(
                     "ServeClient is closed; create a new client"
                 )
+            if ctx is not None:
+                # under the call lock: embedders share clients across
+                # threads, and last_trace must pair with THIS call
+                self.last_trace = ctx
             self._last_call_reconnected = False
             tried = 0
             for attempt in range(self._reconnect_attempts):
@@ -257,6 +296,15 @@ class ServeClient:
                     f"({type(last).__name__}: {last})",
                     code=503,
                 )
+        if ctx is not None and self._trace_shard is not None:
+            self._trace_shard.complete(
+                "rpc.client",
+                t_wall,
+                time.perf_counter() - t_perf,
+                trace_id=ctx["trace_id"],
+                span_id=ctx["span_id"],
+                args={"op": op},
+            )
         if not resp.get("ok"):
             raise ServeError(
                 resp.get("error", "unknown server error"),
@@ -284,6 +332,8 @@ class ServeClient:
         with self._lock:
             self._closed = True
             self._teardown_locked()
+        if self._trace_shard is not None:
+            self._trace_shard.close()
 
     def __enter__(self) -> "ServeClient":
         return self
@@ -551,6 +601,13 @@ class ServeClient:
         latency". Idempotent read, replayed across reconnects.
         `timeout` hard-caps the whole round-trip like `stats`."""
         return self._call("metrics", _budget=timeout)["metrics"]
+
+    def trace_dump(self, timeout: float | None = None) -> list[dict]:
+        """Recent finished spans from the server's bounded in-memory
+        span ring (`trace` verb) — a router answers with every healthy
+        replica's spans plus its own. The live source for
+        `kcmc_tpu trace <addr>`; empty when tracing is unarmed."""
+        return list(self._call("trace", _budget=timeout).get("spans") or [])
 
     def call(
         self,

@@ -230,9 +230,12 @@ def merge_fleet_metrics(
     gauges: dict[str, float] = {}
     sessions: dict[str, dict] = {}
     per_replica: dict[str, dict] = {}
+    exemplar_payloads: list[dict] = []
     for rid in sorted(payloads):
         m = payloads[rid] or {}
         _fold((m.get("plane") or {}).get("histograms") or {})
+        if m.get("exemplars"):
+            exemplar_payloads.append(m["exemplars"])
         for k, v in (m.get("counters") or {}).items():
             if isinstance(v, (int, float)):
                 counters[k] = counters.get(k, 0) + v
@@ -262,6 +265,13 @@ def merge_fleet_metrics(
         hist_out.setdefault(seg, {})[rung] = h.to_dict()
         t = totals.get(seg)
         totals[seg] = h.clone() if t is None else t.merge(h)
+    fleet_exemplars: dict = {}
+    if exemplar_payloads:
+        # exemplars fold last-wins (they are pointers, not counts — the
+        # histogram bit-exact merge contract does not apply to them)
+        from kcmc_tpu.obs.tracing import ExemplarStore
+
+        fleet_exemplars = ExemplarStore.merge_exports(exemplar_payloads)
     return {
         "schema": "kcmc_metrics/1",
         "plane": {
@@ -272,6 +282,7 @@ def merge_fleet_metrics(
         "sessions": sessions,
         "counters": counters,
         "gauges": gauges,
+        **({"exemplars": fleet_exemplars} if fleet_exemplars else {}),
         "fleet": {
             "replicas": per_replica,
             "n_replicas": len(per_replica),

@@ -35,6 +35,19 @@ Ops (docs/SERVING.md has the full field tables):
   Prometheus scrapers poll (`kcmc_tpu metrics --text` renders it as
   text exposition, `kcmc_tpu top` as a live dashboard)
 * ``ping`` / ``shutdown``
+* ``trace`` — recent finished spans from the replica's bounded
+  in-memory span ring (or, via the router, from every healthy replica
+  plus the router's own) — the live source for `kcmc_tpu trace
+  <addr>` (docs/OBSERVABILITY.md "Distributed tracing")
+
+Distributed-trace context (docs/OBSERVABILITY.md "Distributed
+tracing"): any request may carry a ``trace`` field —
+``{"trace_id": <32-hex>, "span_id": <16-hex>}`` — where `span_id` is
+the SENDER's span, i.e. the parent of every span the receiver records
+for this request. Responses echo ``{"trace_id"}`` back. The field is
+optional and opaque to the transport: the router forwards it verbatim
+like every other non-``op`` field, and untraced clients simply omit
+it.
 """
 
 from __future__ import annotations

@@ -258,6 +258,37 @@ class FleetRouter:
         self._migrations: list[dict] = []  # recent migration records
         self._lock = threading.Lock()
         self._lat = SegmentLatencies()  # fleet.migrate spans
+        # Distributed tracing (obs/tracing.py; docs/OBSERVABILITY.md
+        # "Distributed tracing"): the router's own span shard holds
+        # its `rpc.router` forward spans and `fleet.migrate` link
+        # spans; `_session_trace` remembers the last traced context
+        # seen per session so a migrated session's replayed frames —
+        # and the migration link span itself — continue the SAME
+        # trace on the survivor replica.
+        self._trace_shard = None
+        if getattr(config, "trace_shard_dir", ""):
+            import os
+
+            from kcmc_tpu.obs.tracing import SpanShard
+
+            self._trace_shard = SpanShard(
+                os.path.join(
+                    config.trace_shard_dir,
+                    f"spans-router-{os.getpid()}-"
+                    f"{uuid.uuid4().hex[:8]}.jsonl",
+                ),
+                cap=int(getattr(config, "trace_shard_cap", 4096)),
+            )
+        self._session_trace: dict[str, dict] = {}
+        # Fleet-level SLO burn-rate engine (obs/slo.py) over the
+        # exact-merged fleet histograms; gauges ride fleet_metrics(),
+        # alert TRANSITIONS land once in the router's advise log.
+        self._slo = None
+        self._slo_alerted: set[str] = set()
+        if getattr(config, "slo_objectives", ""):
+            from kcmc_tpu.obs.slo import SLOEngine
+
+            self._slo = SLOEngine(config.slo_objectives)
         self._tcp = _RouterTCP((host, port), _RouterHandler)
         self._tcp.kcmc_router = self  # type: ignore[attr-defined]
         self._tcp_thread: threading.Thread | None = None
@@ -559,6 +590,23 @@ class FleetRouter:
                 self._stash_journal_spans(sid, cursor, replica)
                 dur = time.perf_counter() - t0
                 self._lat.observe("fleet.migrate", dur)
+                if self._trace_shard is not None:
+                    with self._lock:
+                        link = self._session_trace.get(sid)
+                    if link:
+                        # migration LINK span: this move — and the
+                        # survivor's continued segments — stitch into
+                        # the session's ORIGINAL trace id
+                        self._trace_shard.complete(
+                            "fleet.migrate", time.time() - dur, dur,
+                            trace_id=link.get("trace_id"),
+                            parent_id=link.get("span_id"),
+                            args={
+                                "from": from_rid,
+                                "to": rid,
+                                "cursor": int(cursor),
+                            },
+                        )
                 with self._lock:
                     self._bind[sid] = rid
                     self._counters["migrations_total"] += 1
@@ -667,6 +715,10 @@ class FleetRouter:
         idempotent-replay contract absorbs any overlap)."""
         with self._lock:
             entries = sorted(self._buffers.get(sid) or [])
+            trace_ctx = self._session_trace.get(sid)
+        # replayed frames carry the session's remembered trace context
+        # — the survivor's segment spans stitch into the SAME trace
+        trace_kw = {"trace": trace_ctx} if trace_ctx else {}
         next_needed = int(cursor)
         for first, n, enc in entries:
             if first + n <= next_needed:
@@ -686,6 +738,7 @@ class FleetRouter:
                 frames=payload,
                 first=next_needed,
                 idempotent=True,
+                **trace_kw,
             )
             next_needed = first + n
 
@@ -699,6 +752,8 @@ class FleetRouter:
             return {"ok": True, "stats": self.stats()}
         if op == "metrics":
             return {"ok": True, "metrics": self.fleet_metrics()}
+        if op == "trace":
+            return {"ok": True, "spans": self.trace_dump(pool)}
         if op == "shutdown":
             return {"ok": True, "stats": self.stats()}
         if op == "open_session":
@@ -725,6 +780,18 @@ class FleetRouter:
         (or a replica that lost the session), migrate and retry once.
         The end client sees at most added latency."""
         fields = {k: v for k, v in msg.items() if k != "op"}
+        ctx = None
+        if self._trace_shard is not None:
+            from kcmc_tpu.obs.tracing import child_context, valid_context
+
+            parent = valid_context(fields.get("trace"))
+            if parent is not None:
+                # re-parent: the replica's rpc.server span hangs under
+                # the router's rpc.router span, which hangs under the
+                # client's — one causal tree per request
+                ctx = child_context(parent)
+                fields["trace"] = ctx
+        t_wall, t0 = time.time(), time.perf_counter()
         last: Exception | None = None
         for attempt in (0, 1):
             with self._lock:
@@ -740,12 +807,24 @@ class FleetRouter:
             if not migrate:
                 try:
                     self._inject()
-                    return pool.get(replica).call(
+                    resp = pool.get(replica).call(
                         msg["op"],
                         deadline=deadline,
                         idempotent=idempotent,
                         **fields,
                     )
+                    if ctx is not None:
+                        # the span covers any migrate-and-retry too —
+                        # router-added latency is what it measures
+                        self._trace_shard.complete(
+                            "rpc.router", t_wall,
+                            time.perf_counter() - t0,
+                            trace_id=ctx["trace_id"],
+                            span_id=ctx["span_id"],
+                            parent_id=ctx.get("parent_id"),
+                            args={"op": str(msg["op"])},
+                        )
+                    return resp
                 except (FaultError, OSError) as e:
                     pool.drop(rid)
                     migrate, last = True, e
@@ -836,6 +915,12 @@ class FleetRouter:
 
     def _op_submit(self, msg: dict, pool: _UpstreamPool) -> dict:
         sid = str(msg["session"])
+        tr = msg.get("trace")
+        if isinstance(tr, dict) and tr.get("trace_id"):
+            # remembered for migration: replayed frames and the
+            # fleet.migrate link span continue this trace
+            with self._lock:
+                self._session_trace[sid] = tr
         first = msg.get("first")
         if first is not None:
             self._buffer_frames(sid, int(first), msg["frames"])
@@ -942,6 +1027,7 @@ class FleetRouter:
             self._delivered.pop(sid, None)
             self._pending_spans.pop(sid, None)
             self._migrate_locks.pop(sid, None)
+            self._session_trace.pop(sid, None)
         return resp
 
     def _op_resume(self, msg: dict, pool: _UpstreamPool) -> dict:
@@ -993,7 +1079,42 @@ class FleetRouter:
             payloads, extra_hists=self._lat.hist_dicts(), states=states
         )
         merged["latency_telemetry"] = True
+        if self._slo is not None:
+            # burn rates over the fleet-merged histograms/counters —
+            # the engine's own lock serializes concurrent scrapers
+            self._slo.tick(
+                (merged.get("plane") or {}).get("histograms") or {},
+                merged.get("counters") or {},
+            )
+            slo = self._slo.gauges()
+            merged["slo"] = slo
+            alerts = set(slo.get("alerts") or [])
+            with self._lock:
+                new = sorted(alerts - self._slo_alerted)
+                self._slo_alerted = alerts
+            for line in new:
+                # alert TRANSITION, logged once per firing
+                advise(f"kcmc router: SLO {line}", stacklevel=2)
         return merged
+
+    def trace_dump(self, pool: _UpstreamPool) -> list[dict]:
+        """The router's `trace` verb: recent spans from every live
+        replica's in-memory ring plus the router's own forward and
+        migration spans — the live stitched-fleet source for
+        `kcmc_tpu trace <addr>`."""
+        spans: list[dict] = []
+        for replica in self._snapshot():
+            if replica.state == DEAD:
+                continue
+            try:
+                self._inject()
+                resp = pool.get(replica).call("trace", idempotent=True)
+                spans.extend(resp.get("spans") or [])
+            except (ServeError, FaultError, OSError):
+                continue  # an unreachable ring loses only ITS spans
+        if self._trace_shard is not None:
+            spans.extend(self._trace_shard.tail())
+        return spans
 
     def stats(self) -> dict:
         with self._lock:
@@ -1104,6 +1225,8 @@ class FleetRouter:
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=10.0)
             self._probe_thread = None
+        if self._trace_shard is not None:
+            self._trace_shard.close()
         if stop_owned:
             for replica in self._snapshot():
                 stop_replica(replica)
@@ -1139,6 +1262,8 @@ def router_main(args) -> int:
         ("fleet_wedge_threshold_s", "wedge_threshold"),
         ("fleet_queue_watermark", "watermark"),
         ("fleet_scale_cooldown_s", "scale_cooldown"),
+        ("trace_shard_dir", "trace_shards"),
+        ("slo_objectives", "slo"),
     ):
         v = getattr(args, arg, None)
         if v is not None:
@@ -1154,6 +1279,15 @@ def router_main(args) -> int:
     serve_args = list(shlex.split(args.serve_args or ""))
     if journal_dir and "--journal-dir" not in serve_args:
         serve_args += ["--journal-dir", journal_dir]
+    # tracing/SLO flags propagate to spawned replicas: every process
+    # of the fleet shards spans into the same directory, so `kcmc_tpu
+    # trace DIR` stitches one fleet trace
+    ts = getattr(args, "trace_shards", None)
+    if ts and "--trace-shards" not in serve_args:
+        serve_args += ["--trace-shards", ts]
+    slo_spec = getattr(args, "slo", None)
+    if slo_spec and "--slo" not in serve_args:
+        serve_args += ["--slo", slo_spec]
     if "--port" not in serve_args:
         serve_args = ["--port", "0", *serve_args]
 

@@ -36,8 +36,10 @@ close) under the scheduler lock and wait on per-session conditions.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
+import uuid
 from collections import deque
 
 import numpy as np
@@ -154,6 +156,41 @@ class StreamScheduler:
         # — an EXACT merge, bit-identical to recording every sample
         # into one histogram (the fleet-aggregation contract).
         self._lat_closed = SegmentLatencies()
+        # Distributed tracing (obs/tracing.py, docs/OBSERVABILITY.md
+        # "Distributed tracing"): one bounded span shard per serving
+        # process when `trace_shard_dir` is set — traced requests emit
+        # their lifecycle-segment and rpc.server spans here, the
+        # `trace` verb serves its in-memory ring, and the collector
+        # stitches the shard with the router's and the client's.
+        self.trace_shard = None
+        if cfg.trace_shard_dir:
+            from kcmc_tpu.obs.tracing import SpanShard
+
+            self.trace_shard = SpanShard(
+                os.path.join(
+                    cfg.trace_shard_dir,
+                    f"spans-{os.getpid()}-{uuid.uuid4().hex[:8]}.jsonl",
+                ),
+                cap=cfg.trace_shard_cap,
+            )
+        # Exemplars: bounded last-wins (segment, rung, bucket) ->
+        # trace id, exported as the `exemplars` metrics section so the
+        # p99 bucket names real traces. Parallel to the histograms —
+        # their bit-identity merge contract stays untouched.
+        self._exemplars = None
+        if cfg.latency_telemetry:
+            from kcmc_tpu.obs.tracing import ExemplarStore
+
+            self._exemplars = ExemplarStore()
+        # SLO burn-rate engine (obs/slo.py): armed by the declarative
+        # `slo_objectives` config spec; ticked by the scheduler loop
+        # and surfaced via metrics()/snapshot().
+        self._slo = None
+        self._slo_tick_last = 0.0
+        if cfg.slo_objectives:
+            from kcmc_tpu.obs.slo import SLOEngine
+
+            self._slo = SLOEngine(cfg.slo_objectives)
         self._stats = {
             "accepted_frames": 0,
             "rejected_submits": 0,
@@ -216,6 +253,8 @@ class StreamScheduler:
             warm, self._warm_threads = self._warm_threads, []
         for t in warm:
             t.join(timeout=timeout)
+        if self.trace_shard is not None:
+            self.trace_shard.close()
 
     def _spawn_warmup(self, target, name: str, args: tuple = ()) -> None:
         """Degraded-budget warm-up threads reach jax compile (backend
@@ -300,6 +339,7 @@ class StreamScheduler:
                 emit_frames=emit_frames, output=output,
                 expected_frames=expected_frames, output_dtype=output_dtype,
                 compression=compression, telemetry=telemetry,
+                trace_shard=self.trace_shard, exemplars=self._exemplars,
             )
             if self.journal_dir:
                 from kcmc_tpu.serve.journal import SessionJournal
@@ -494,7 +534,10 @@ class StreamScheduler:
         )
         return sess, int(meta["done"]), True
 
-    def submit(self, session_id: str, frames, first: int | None = None):
+    def submit(
+        self, session_id: str, frames, first: int | None = None,
+        trace: dict | None = None,
+    ):
         """Admission-controlled submit. Returns a decision dict
         ``{"accepted", "queued", "degraded", "next"}``; raises
         OverloadedError when the queue bound is exceeded (the last
@@ -507,7 +550,12 @@ class StreamScheduler:
         double-process a frame; a `first` PAST the session cursor is a
         gap (lost frames) and is rejected so a stream can never
         silently skip. Without `first` (legacy callers) frames append
-        unconditionally."""
+        unconditionally.
+
+        `trace` is the request's distributed-trace context (the
+        server's span for this call, obs/tracing.py): the admitted
+        frames inherit it, so their queue/dispatch/device/drain spans
+        and bucket exemplars name the originating trace id."""
         t_call = time.perf_counter()  # request.total's anchor
         frames = np.asarray(frames)
         if frames.ndim == 2:
@@ -567,10 +615,16 @@ class StreamScheduler:
                 # (t_call, t_admitted) stamps seed queue_wait/total.
                 t_adm = time.perf_counter()
                 sess._t_submit.extend([(t_call, t_adm)] * n)
+                rung = "degraded" if sess.degraded else "full"
                 sess.lat.observe(
-                    "request.admission", t_adm - t_call, n=n,
-                    rung="degraded" if sess.degraded else "full",
+                    "request.admission", t_adm - t_call, n=n, rung=rung,
                 )
+                if trace is not None:
+                    sess.note_trace(trace, n)
+                    sess.trace_obs(
+                        "request.admission", t_adm - t_call, n, rung,
+                        trace,
+                    )
             # Dedup counts only once the trimmed remainder is ADMITTED:
             # a rejected/raising submit will be retried verbatim, and
             # counting its overlap on every attempt would inflate the
@@ -826,7 +880,7 @@ class StreamScheduler:
                 per_session[s.sid] = entry
         plane_rep = plane.report()
         batches = max(st["batches"], 1)
-        return {
+        payload = {
             "schema": "kcmc_metrics/1",
             "latency_telemetry": bool(self.mc.config.latency_telemetry),
             "plane": {
@@ -862,6 +916,41 @@ class StreamScheduler:
                 "queues": queues,
             },
         }
+        if self._exemplars is not None:
+            ex = self._exemplars.export()
+            if ex:
+                payload["exemplars"] = ex
+        if self._slo is not None:
+            self._slo.tick(
+                payload["plane"]["histograms"], payload["counters"]
+            )
+            payload["slo"] = self._slo.gauges()
+        return payload
+
+    def trace_dump(self) -> list:
+        """Recent finished spans from the process span ring (the
+        `trace` serve verb); [] when tracing is unarmed."""
+        if self.trace_shard is None:
+            return []
+        return self.trace_shard.tail()
+
+    def _slo_tick(self) -> None:
+        """Advance the burn-rate windows from the scheduler loop (at
+        most 1/s) so the SLO state moves even when nobody scrapes."""
+        if self._slo is None:
+            return
+        now = time.monotonic()
+        if now - self._slo_tick_last < 1.0:
+            return
+        self._slo_tick_last = now
+        plane = SegmentLatencies()
+        with self._lock:
+            plane.merge_from(self._lat_closed)
+            for s in self._sessions.values():
+                if s.lat is not None:
+                    plane.merge_from(s.lat)
+            counters = dict(self._stats)
+        self._slo.tick(plane.hist_dicts(), counters)
 
     def _latency_beat(self) -> dict | None:
         """End-to-end p50/p99 for the heartbeat line: the plane's
@@ -923,6 +1012,10 @@ class StreamScheduler:
         lat = self._latency_beat()
         if lat is not None:
             out["latency"] = lat
+        if self._slo is not None:
+            slo_line = self._slo.heartbeat()
+            if slo_line:
+                out["slo"] = slo_line
         if any(rb_total.values()):
             out["robustness"] = rb_total
         if self.session_timeout_s > 0:
@@ -1113,6 +1206,7 @@ class StreamScheduler:
                 time.sleep(stall)
             self.fault_plan.maybe_fail("scheduler", step)
         self._reap_stale()
+        self._slo_tick()
         self._prepare_references()
         with self._wake:
             picked = self._pick_locked() if self._running else None
@@ -1362,6 +1456,12 @@ class StreamScheduler:
                 "request.dispatch", clock.t_dispatched - clock.t_formed,
                 n=n, rung=clock.rung,
             )
+            if clock.trace is not None:
+                sess.trace_obs(
+                    "request.dispatch",
+                    clock.t_dispatched - clock.t_formed,
+                    n, clock.rung, clock.trace,
+                )
         if warm and "transform" in out:
             sess.warm_seed = out["transform"][n - 1]
         return (sess, n, out, kept, batch, idx, ref, backend, clock)

@@ -116,9 +116,38 @@ class ServeServer:
     # -- op dispatch (handler threads) ------------------------------------
 
     def handle_op(self, msg: dict) -> dict:
+        """Dispatch one op. When the message carries a `trace` field
+        and the scheduler's span shard is armed, the whole handling
+        becomes an `rpc.server` span (child of the sender's span) and
+        the response echoes the trace id back."""
+        from kcmc_tpu.obs.tracing import child_context, valid_context
+
         op = msg.get("op")
+        ctx = valid_context(msg.get("trace"))
+        shard = self.scheduler.trace_shard
+        if ctx is None or shard is None:
+            return self._dispatch_op(op, msg, child_context(ctx))
+        server_ctx = child_context(ctx)
+        t_wall, t0 = time.time(), time.perf_counter()
+        resp = self._dispatch_op(op, msg, server_ctx)
+        shard.complete(
+            "rpc.server",
+            t_wall,
+            time.perf_counter() - t0,
+            trace_id=server_ctx["trace_id"],
+            span_id=server_ctx["span_id"],
+            parent_id=server_ctx.get("parent_id"),
+            args={"op": str(op)},
+        )
+        if isinstance(resp, dict) and resp.get("ok"):
+            resp.setdefault("trace", {"trace_id": ctx["trace_id"]})
+        return resp
+
+    def _dispatch_op(self, op, msg: dict, ctx: dict | None) -> dict:
         if op == "ping":
             return {"ok": True}
+        if op == "trace":
+            return {"ok": True, "spans": self.scheduler.trace_dump()}
         if op == "stats":
             return {"ok": True, "stats": self.scheduler.stats()}
         if op == "metrics":
@@ -151,6 +180,7 @@ class ServeServer:
             decision = self.scheduler.submit(
                 msg["session"], frames,
                 first=int(first) if first is not None else None,
+                trace=ctx,
             )
             return {"ok": True, **decision}
         if op == "resume_session":

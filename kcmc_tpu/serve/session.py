@@ -62,6 +62,8 @@ class Session:
         output_dtype="float32",
         compression: str = "none",
         telemetry: bool = True,
+        trace_shard=None,
+        exemplars=None,
     ):
         if output is not None and expected_frames is None:
             raise ValueError(
@@ -196,6 +198,19 @@ class Session:
         self._t_submit: deque = deque()
         self._t_done: deque = deque()
 
+        # Distributed-trace plumbing (obs/tracing.py; docs/
+        # OBSERVABILITY.md "Distributed tracing"): `trace_shard` is the
+        # scheduler's bounded per-process span sink, `exemplars` its
+        # latency-exemplar store. `_trace_ctx` is the most recent
+        # traced submit's context — batches formed from this session
+        # attribute their segment spans to it (latest-wins: a stream
+        # interleaving traced submits shares attribution, which keeps
+        # the hot path to one reference write instead of a per-frame
+        # context queue).
+        self._trace_shard = trace_shard
+        self._exemplars = exemplars
+        self._trace_ctx: dict | None = None
+
         # Per-session telemetry (trace + frame records) through the
         # run-id machinery: concurrent sessions configured with the same
         # artifact paths get per-session derived filenames. The serve
@@ -238,6 +253,30 @@ class Session:
     def backlog(self) -> int:
         """Frames admitted but not yet dispatched (the admission gauge)."""
         return len(self.pending)
+
+    def note_trace(self, ctx: dict, n: int) -> None:
+        """Remember the most recent traced submit's context (plane lock
+        held; scheduler calls this at admission). Subsequent batch/
+        delivery spans of this stream parent under it."""
+        self._trace_ctx = ctx
+
+    def trace_obs(self, seg, dur, n, rung, ctx) -> None:
+        """Emit one span-shard record (+ latency exemplar) mirroring a
+        segment observation. The span's weight — ``dur × n`` — equals
+        the same site's histogram-sum contribution, so per-trace span
+        sums telescope against the `metrics` segment sums. No-op
+        without a context; shard/exemplar sinks are each optional."""
+        if ctx is None:
+            return
+        tid = ctx.get("trace_id")
+        if self._trace_shard is not None:
+            self._trace_shard.complete(
+                seg, time.time() - dur, dur,
+                trace_id=tid, parent_id=ctx.get("span_id"),
+                args={"n": int(n), "rung": rung},
+            )
+        if self._exemplars is not None and tid:
+            self._exemplars.note(seg, dur, tid, rung=rung)
 
     def add_frames(self, frames) -> int:
         """Append admitted frames to the pending queue (admission checks
@@ -589,8 +628,21 @@ class Session:
             self.lat.observe(
                 "request.batch_form", t_formed - t_take, n=n, rung=rung
             )
-            clock = RequestClock([t0 for t0, _ in stamps], t_formed)
+            clock = RequestClock(
+                [t0 for t0, _ in stamps], t_formed, trace=self._trace_ctx
+            )
             clock.rung = rung
+            if clock.trace is not None:
+                # one span per batch, dur = per-frame mean so the span
+                # weight (dur × n) equals the per-frame histogram sum
+                q_sum = sum(t_take - t_adm for _, t_adm in stamps)
+                self.trace_obs(
+                    "request.queue_wait", q_sum / n, n, rung, clock.trace
+                )
+                self.trace_obs(
+                    "request.batch_form", t_formed - t_take, n, rung,
+                    clock.trace,
+                )
             return padded + (self.ref, clock)
         return self.mc._pad_batch(frames, idx, B) + (self.ref, clock)
 
@@ -692,6 +744,15 @@ class Session:
                 self.lat.observe(
                     "request.drain", t_acct - t_host, n=n, rung=clock.rung
                 )
+                if clock.trace is not None:
+                    self.trace_obs(
+                        "request.device", t_host - t_disp, n,
+                        clock.rung, clock.trace,
+                    )
+                    self.trace_obs(
+                        "request.drain", t_acct - t_host, n,
+                        clock.rung, clock.trace,
+                    )
                 for t0f in clock.t_submit[:n]:
                     self._t_done.append((t0f, t_acct))
             self.done += n
@@ -782,6 +843,8 @@ class Session:
                 # series.
                 t_now = time.perf_counter()
                 rung = "degraded" if self.degraded else "full"
+                d_sum = e_sum = 0.0
+                k = 0
                 while self._t_done:
                     t0f, t_acct = self._t_done.popleft()
                     self.lat.observe(
@@ -789,6 +852,18 @@ class Session:
                     )
                     self.lat.observe(
                         "request.total", t_now - t0f, rung=rung
+                    )
+                    d_sum += t_now - t_acct
+                    e_sum += t_now - t0f
+                    k += 1
+                if k and self._trace_ctx is not None:
+                    self.trace_obs(
+                        "request.delivery", d_sum / k, k, rung,
+                        self._trace_ctx,
+                    )
+                    self.trace_obs(
+                        "request.total", e_sum / k, k, rung,
+                        self._trace_ctx,
                     )
             # Shallow-copy each batch dict: the merge below runs
             # OUTSIDE the lock, and a concurrent fetch() pops delivered
@@ -913,6 +988,8 @@ class Session:
                 # frame this fetch hands over
                 t_now = time.perf_counter()
                 rung = "degraded" if self.degraded else "full"
+                d_sum = e_sum = 0.0
+                k = 0
                 for _ in range(min(n, len(self._t_done))):
                     t0f, t_acct = self._t_done.popleft()
                     self.lat.observe(
@@ -920,6 +997,18 @@ class Session:
                     )
                     self.lat.observe(
                         "request.total", t_now - t0f, rung=rung
+                    )
+                    d_sum += t_now - t_acct
+                    e_sum += t_now - t0f
+                    k += 1
+                if k and self._trace_ctx is not None:
+                    self.trace_obs(
+                        "request.delivery", d_sum / k, k, rung,
+                        self._trace_ctx,
+                    )
+                    self.trace_obs(
+                        "request.total", e_sum / k, k, rung,
+                        self._trace_ctx,
                     )
             merged = merge_outputs(new)
             # Release delivered pixels — frames dominate memory; the

@@ -1,0 +1,436 @@
+"""Integration suite for fleet-wide distributed tracing over the
+serve path (docs/OBSERVABILITY.md "Distributed tracing").
+
+* one routed request yields ONE stitched trace spanning client →
+  server → scheduler segments, over a real socket, with the causal
+  parent/child chain intact;
+* span weights (dur × n) telescope against the latency plane's
+  per-segment histogram sums — the two views of one request agree;
+* the `trace` verb serves the live span ring; `kcmc_tpu trace` renders
+  critical paths from shards and addresses;
+* `metrics` carries bucket exemplars naming real trace ids, rendered
+  as OpenMetrics ``# {trace_id=...}`` suffixes;
+* every `# TYPE` in the exposition has a matching `# HELP` (the
+  format test of the `kcmc_serve_queue_frames` satellite);
+* `slo_objectives` surfaces multi-window `kcmc_slo_*` gauges, with a
+  nonzero burn rate under an injected slowdown (an impossible
+  threshold: every request is "slow");
+* `trace=False` on the client and an unset `trace_shard_dir` disable
+  every emission site (the overhead A/B's off arm).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.obs.latency import render_prometheus
+from kcmc_tpu.obs.tracing import collect_spans, critical_path, stitch
+from kcmc_tpu.utils.synthetic import make_drift_stack
+
+MC_KW = dict(
+    model="translation", backend="numpy", batch_size=8,
+    max_keypoints=64, n_hypotheses=32,
+)
+
+LIFECYCLE_SEGMENTS = {
+    "request.admission", "request.queue_wait", "request.batch_form",
+    "request.dispatch", "request.device", "request.drain",
+    "request.delivery", "request.total",
+}
+
+
+def _stack(n=16, seed=0, shape=(48, 48)):
+    d = make_drift_stack(
+        n_frames=n, shape=shape, model="translation", max_drift=3.0,
+        seed=seed,
+    )
+    return d.stack.astype(np.float32)
+
+
+def _drive(c, n=16, seed=0):
+    sid = c.open_session(tenant="trace-t")
+    c.submit(sid, _stack(n, seed=seed))
+    seen = 0
+    while seen < n:
+        got = c.results(sid, timeout=60.0)
+        assert got is not None
+        seen += got["n"]
+    c.close_session(sid)
+    return sid
+
+
+# -- one stitched trace over the real socket ---------------------------------
+
+
+def test_one_request_yields_one_stitched_trace(tmp_path):
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    shard_dir = str(tmp_path / "spans")
+    mc = MotionCorrector(trace_shard_dir=shard_dir, **MC_KW)
+    with ServeServer(mc, port=0) as srv:
+        with ServeClient(
+            port=srv.port,
+            trace_shard=str(tmp_path / "client-spans.jsonl"),
+        ) as c:
+            _drive(c)
+            submit_ctx = None
+            # last_trace tracks the most recent call; remember the
+            # submit's by driving once more explicitly
+            sid = c.open_session(tenant="t2")
+            c.submit(sid, _stack(8, seed=1))
+            submit_ctx = dict(c.last_trace)
+            seen = 0
+            while seen < 8:
+                got = c.results(sid, timeout=60.0)
+                seen += got["n"]
+            c.close_session(sid)
+            live = c.trace_dump()
+            m = c.metrics()
+    assert submit_ctx and len(submit_ctx["trace_id"]) == 32
+
+    spans = collect_spans(
+        [shard_dir, str(tmp_path / "client-spans.jsonl")]
+    )
+    traces = stitch(spans)
+    tid = submit_ctx["trace_id"]
+    assert tid in traces, sorted(traces)
+    tr = traces[tid]
+    names = {s["name"] for s in tr}
+    # client → server → every scheduler segment, one causal trace
+    assert "rpc.client" in names and "rpc.server" in names
+    assert LIFECYCLE_SEGMENTS <= names, sorted(names)
+    # causal chain: the client's rpc.client span is the root; the
+    # server re-parents onto the wire span id
+    roots = [s for s in tr if s["name"] == "rpc.client"]
+    assert any(s["span_id"] == submit_ctx["span_id"] for s in roots)
+    segs = [s for s in tr if s["name"] in LIFECYCLE_SEGMENTS]
+    assert all(s.get("parent_id") for s in segs)
+    cp = critical_path(tr)
+    assert cp["dominant"] in LIFECYCLE_SEGMENTS - {"request.total"}
+    assert cp["total_s"] > 0
+
+    # the live ring (trace verb) carries the same trace
+    assert any(s.get("trace_id") == tid for s in live)
+    # ...and the exemplars name real traces from this run
+    all_tids = {s["trace_id"] for s in spans if s.get("trace_id")}
+    ex_tids = {
+        ex["trace_id"]
+        for rungs in (m.get("exemplars") or {}).values()
+        for buckets in rungs.values()
+        for ex in buckets.values()
+    }
+    assert ex_tids and ex_tids <= all_tids
+
+
+def test_span_weights_telescope_against_segment_sums(tmp_path):
+    """The spans and the latency histograms are two views of the same
+    requests: per segment, sum(dur × n) over spans must equal the
+    histogram's sum_s (within float rounding of the span records)."""
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    shard_dir = str(tmp_path / "spans")
+    mc = MotionCorrector(trace_shard_dir=shard_dir, **MC_KW)
+    with ServeServer(mc, port=0) as srv:
+        with ServeClient(port=srv.port) as c:
+            _drive(c, n=24)
+            m = c.metrics()
+
+    spans = collect_spans([shard_dir])
+    weights: dict[str, float] = {}
+    for s in spans:
+        if s["name"] in LIFECYCLE_SEGMENTS:
+            n = int((s.get("args") or {}).get("n", 1))
+            weights[s["name"]] = weights.get(s["name"], 0.0) + (
+                s["dur_s"] * max(1, n)
+            )
+    totals = m["plane"]["totals"]
+    for seg in LIFECYCLE_SEGMENTS:
+        hist_sum = totals[seg]["sum_s"]
+        assert weights.get(seg, 0.0) == pytest.approx(
+            hist_sum, rel=0.02, abs=2e-3
+        ), (seg, weights.get(seg), hist_sum)
+
+
+def test_tracing_unarmed_emits_nothing(tmp_path):
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    mc = MotionCorrector(**MC_KW)  # no trace_shard_dir
+    with ServeServer(mc, port=0) as srv:
+        with ServeClient(port=srv.port, trace=False) as c:
+            _drive(c)
+            assert c.last_trace is None
+            assert c.trace_dump() == []
+            m = c.metrics()
+    assert not m.get("exemplars")
+
+
+def test_concurrent_traced_streams_stay_distinct(tmp_path):
+    """Two threads submitting traced requests concurrently: every
+    emitted span belongs to a trace one of the clients minted — no
+    cross-talk, no unparented segments."""
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    shard_dir = str(tmp_path / "spans")
+    mc = MotionCorrector(trace_shard_dir=shard_dir, **MC_KW)
+    minted: set[str] = set()
+    lock = threading.Lock()
+    with ServeServer(mc, port=0) as srv:
+        def drive(i):
+            with ServeClient(port=srv.port) as c:
+                sid = c.open_session(tenant=f"t{i}")
+                c.submit(sid, _stack(12, seed=i))
+                with lock:
+                    minted.add(c.last_trace["trace_id"])
+                seen = 0
+                while seen < 12:
+                    seen += c.results(sid, timeout=60.0)["n"]
+                c.close_session(sid)
+
+        ts = [threading.Thread(target=drive, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+    assert len(minted) == 2
+    spans = collect_spans([shard_dir])
+    seg_tids = {
+        s["trace_id"]
+        for s in spans
+        if s["name"] in LIFECYCLE_SEGMENTS and s.get("trace_id")
+    }
+    assert seg_tids <= minted and seg_tids
+
+
+# -- exposition: exemplars + HELP/TYPE format --------------------------------
+
+
+def test_prometheus_exposition_exemplars_and_help_format(tmp_path):
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    mc = MotionCorrector(
+        trace_shard_dir=str(tmp_path / "spans"), **MC_KW
+    )
+    with ServeServer(mc, port=0) as srv:
+        with ServeClient(port=srv.port) as c:
+            sid = c.open_session(tenant="fmt")
+            c.submit(sid, _stack(8))
+            seen = 0
+            while seen < 8:
+                seen += c.results(sid, timeout=60.0)["n"]
+            m = c.metrics()  # session open: queues gauge populated
+            c.close_session(sid)
+    text = render_prometheus(m)
+    # at least one bucket line carries an OpenMetrics exemplar
+    ex_lines = [
+        ln for ln in text.splitlines()
+        if "_bucket{" in ln and '# {trace_id="' in ln
+    ]
+    assert ex_lines, text
+    trace_id = ex_lines[0].split('trace_id="')[1].split('"')[0]
+    assert len(trace_id) == 32
+    # the queue gauge rides with its HELP line
+    assert "# TYPE kcmc_serve_queue_frames gauge" in text
+    assert "# HELP kcmc_serve_queue_frames" in text
+    # format contract: EVERY # TYPE has a matching # HELP
+    types = {
+        ln.split()[2]
+        for ln in text.splitlines()
+        if ln.startswith("# TYPE")
+    }
+    helps = {
+        ln.split()[2]
+        for ln in text.splitlines()
+        if ln.startswith("# HELP")
+    }
+    assert types and types == helps, types ^ helps
+    # empty payloads still render (the pre-plane contract)
+    assert render_prometheus({}) == "\n"
+
+
+# -- SLO objectives over the serve path --------------------------------------
+
+
+def test_slo_gauges_burn_under_injected_slowdown(tmp_path):
+    """An impossible latency objective (1 µs threshold) makes every
+    real request a budget burn: the `metrics` slo section and the
+    exposition must show a nonzero multi-window burn rate."""
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    mc = MotionCorrector(
+        slo_objectives="full:0.000001:0.99;avail:0.999", **MC_KW
+    )
+    with ServeServer(mc, port=0) as srv:
+        with ServeClient(port=srv.port) as c:
+            _drive(c)
+            m = c.metrics()
+    slo = m.get("slo")
+    assert slo, sorted(m)
+    names = {o["name"] for o in slo["objectives"]}
+    assert names == {"latency_full_lt_1e-06s", "availability"}
+    burns = slo["burn_rates"]["latency_full_lt_1e-06s"]
+    assert burns["5m"] > 1.0, burns  # every request burned budget
+    text = render_prometheus(m)
+    burn_lines = [
+        ln for ln in text.splitlines()
+        if ln.startswith("kcmc_slo_burn_rate{")
+    ]
+    assert len(burn_lines) >= 4  # one per window per objective
+    assert any(
+        'window="5m"' in ln and not ln.rstrip().endswith(" 0")
+        for ln in burn_lines
+    ), burn_lines
+    assert any(
+        ln.startswith("kcmc_slo_target") for ln in text.splitlines()
+    )
+
+
+def test_slo_spec_validated_at_config_time():
+    with pytest.raises(ValueError, match="slo_objectives"):
+        MotionCorrector(slo_objectives="full:nope", **MC_KW)
+
+
+def test_trace_shard_cap_validated_at_config_time():
+    with pytest.raises(ValueError):
+        MotionCorrector(trace_shard_cap=0, **MC_KW)
+
+
+# -- the trace CLI -----------------------------------------------------------
+
+
+def test_trace_cli_renders_shards_and_live_address(tmp_path, capsys):
+    import json as _json
+
+    from kcmc_tpu.__main__ import main as cli_main
+    from kcmc_tpu.serve.client import ServeClient
+    from kcmc_tpu.serve.server import ServeServer
+
+    shard_dir = str(tmp_path / "spans")
+    mc = MotionCorrector(trace_shard_dir=shard_dir, **MC_KW)
+    with ServeServer(mc, port=0) as srv:
+        with ServeClient(port=srv.port) as c:
+            _drive(c)
+        # live address source (the trace verb) while the server is up
+        rc = cli_main(
+            ["trace", f"127.0.0.1:{srv.port}", "--json"]
+        )
+    assert rc == 0
+    live = _json.loads(capsys.readouterr().out)
+    assert live["kind"] == "kcmc_trace" and live["n_traces"] >= 1
+
+    chrome = str(tmp_path / "trace.json")
+    rc = cli_main(["trace", shard_dir, "--chrome", chrome])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "critical" in out or "dominant" in out, out
+    events = _json.load(open(chrome))["traceEvents"]
+    assert any(e.get("ph") == "X" for e in events)
+
+
+# -- fleet: the trace survives a kill-and-migrate ----------------------------
+
+
+@pytest.mark.slow
+def test_trace_survives_kill_and_migrate(tmp_path):
+    """THE fleet tracing acceptance: SIGKILL the bound replica while a
+    traced session is mid-stream. The router migrates the session to
+    the survivor, the replayed frames carry the SAME trace context,
+    and the stitched trace ends up spanning BOTH replica processes
+    plus a `fleet.migrate` link span on the router."""
+    import os
+    import signal
+    import time
+
+    from kcmc_tpu.config import CorrectorConfig
+    from kcmc_tpu.serve.client import ServeClient, ServeError
+    from kcmc_tpu.serve.fleet import spawn_replica
+    from kcmc_tpu.serve.journal import journal_path, load_session_journal
+    from kcmc_tpu.serve.router import FleetRouter
+
+    jdir = str(tmp_path / "journals")
+    shard_dir = str(tmp_path / "spans")
+    os.makedirs(jdir, exist_ok=True)
+    replicas = [
+        spawn_replica(
+            [
+                "--port", "0", "--backend", "numpy",
+                "--batch-size", "8", "--max-keypoints", "64",
+                "--hypotheses", "32",
+                "--journal-dir", jdir, "--journal-every", "4",
+                "--trace-shards", shard_dir,
+            ],
+            env={"JAX_PLATFORMS": "cpu"},
+        )
+        for _ in range(2)
+    ]
+    cfg = CorrectorConfig(trace_shard_dir=shard_dir)
+    router = FleetRouter(
+        replicas, port=0, config=cfg, journal_dir=jdir
+    ).start()
+    # One traced submit for the WHOLE stream: the kill lands while the
+    # victim is mid-batch, so the un-journaled tail is replayed to the
+    # survivor by the router with the ORIGINAL trace context — that is
+    # the continuation under test. (A second client submit would mint
+    # a fresh trace id by design.)
+    stack = _stack(64, seed=7)
+    n = len(stack)
+    try:
+        with ServeClient(port=router.port) as c:
+            sid = c.open_session(tenant="trace", session_id="T1")
+            c.submit(sid, stack)
+            tid = c.last_trace["trace_id"]
+            jp = journal_path(jdir, sid)
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60.0:
+                if os.path.exists(jp):
+                    got = load_session_journal(jp)
+                    if got and 4 <= int(got[0]["done"]) < n:
+                        break
+                time.sleep(0.02)
+            else:
+                raise AssertionError("journal never became durable")
+            victim_rid = router.stats()["sessions"][sid]
+            victim = next(r for r in replicas if r.rid == victim_rid)
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            victim.proc.wait(timeout=30)
+            delivered = 0
+            while delivered < n:
+                try:
+                    span = c.results(sid, timeout=60.0)
+                except ServeError as e:
+                    # a span delivered to the dropped connection is
+                    # reported, not lost — the error carries it
+                    span = (getattr(e, "info", None) or {}).get("span")
+                    if span is None:
+                        raise
+                assert span is not None
+                delivered = int(span["first_frame"]) + int(span["n"])
+            out = c.close_session(sid)
+        assert out["frames"] == n
+        assert router.stats()["migrations_total"] >= 1
+    finally:
+        router.stop(stop_owned=True)
+
+    spans = [
+        s for s in collect_spans([shard_dir]) if s.get("trace_id") == tid
+    ]
+    assert spans, "the trace vanished in the migration"
+    seg_pids = {
+        s["pid"] for s in spans if s["name"] in LIFECYCLE_SEGMENTS
+    }
+    assert len(seg_pids) >= 2, (
+        f"one stitched trace must span both replicas, saw pids "
+        f"{seg_pids}"
+    )
+    links = [s for s in spans if s["name"] == "fleet.migrate"]
+    assert links, "no fleet.migrate link span on the migrated trace"
+    assert links[0].get("args", {}).get("from") == victim_rid

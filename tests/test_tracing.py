@@ -1,0 +1,480 @@
+"""Unit suite for the distributed-tracing substrate (obs/tracing.py)
+and the SLO burn-rate engine (obs/slo.py).
+
+Covered here (socket-free; the serve integration lives in
+test_serve_trace.py):
+
+* id minting: W3C-width hex ids, span-id uniqueness under concurrent
+  sessions (run under the concurrency sanitizer — must be clean);
+* context propagation: child_context advances the causal tree,
+  valid_context rejects wire garbage;
+* span shards: header + append + bounded cap + dropped counter,
+  torn-tail recovery after a real SIGKILL mid-write and after a
+  deterministic truncation;
+* collection: collect_spans over files/dirs/lists, stitch,
+  critical_path, slowest, chrome_trace;
+* exemplars: bucketing parity with LatencyHistogram, last-wins,
+  bounded, fleet merge, top_exemplar;
+* SLO: objective-spec parsing errors, multi-window burn under an
+  injected slowdown (fake clock), page/ticket AND-gating, heartbeat
+  line, Prometheus rendering;
+* `kcmc_tpu report` critical-path rendering from shards, and the
+  "—" row on pre-tracing artifacts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kcmc_tpu.obs.tracing import (
+    ExemplarStore,
+    SpanShard,
+    child_context,
+    chrome_trace,
+    collect_spans,
+    critical_path,
+    mint_span_id,
+    mint_trace_id,
+    new_context,
+    read_span_shard,
+    slowest,
+    stitch,
+    top_exemplar,
+    valid_context,
+)
+
+
+# -- ids + context -----------------------------------------------------------
+
+
+def test_mint_ids_are_hex_and_right_width():
+    t, s = mint_trace_id(), mint_span_id()
+    assert len(t) == 32 and int(t, 16) >= 0
+    assert len(s) == 16 and int(s, 16) >= 0
+    ctx = new_context()
+    assert set(ctx) == {"trace_id", "span_id"}
+
+
+def test_span_ids_unique_across_concurrent_sessions():
+    """Concurrent sessions minting ids and emitting to one shared
+    shard must never collide (os.urandom: no shared counter to race
+    on) — and the shard's lock discipline must be sanitizer-clean."""
+    from kcmc_tpu.analysis import sanitize
+
+    owned = not sanitize.active()
+    if owned:
+        sanitize.enable(watchdog_s=5.0, static=False)
+    try:
+        shard = SpanShard()
+        minted: list[list[str]] = [[] for _ in range(8)]
+
+        def mint(slot: int) -> None:
+            for _ in range(200):
+                ctx = new_context()
+                minted[slot].append(ctx["span_id"])
+                shard.complete(
+                    "request.total", time.time(), 1e-4,
+                    trace_id=ctx["trace_id"], span_id=ctx["span_id"],
+                )
+
+        ts = [
+            threading.Thread(target=mint, args=(i,)) for i in range(8)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        ids = [s for slot in minted for s in slot]
+        assert len(ids) == 8 * 200
+        assert len(set(ids)) == len(ids), "span-id collision"
+        violations = sanitize.take_violations()
+        assert not violations, violations
+    finally:
+        if owned:
+            sanitize.disable()
+
+
+def test_child_context_advances_the_tree():
+    root = new_context()
+    ch = child_context(root)
+    assert ch["trace_id"] == root["trace_id"]
+    assert ch["parent_id"] == root["span_id"]
+    assert ch["span_id"] != root["span_id"]
+    assert child_context(None) is None
+    assert child_context({}) is None
+
+
+@pytest.mark.parametrize(
+    "garbage",
+    [None, 7, "abc", [], {"trace_id": ""}, {"trace_id": 12},
+     {"span_id": "deadbeef"}],
+)
+def test_valid_context_rejects_wire_garbage(garbage):
+    assert valid_context(garbage) is None
+
+
+def test_valid_context_strips_non_string_optionals():
+    got = valid_context(
+        {"trace_id": "t" * 32, "span_id": 5, "parent_id": "p" * 16,
+         "junk": 1}
+    )
+    assert got == {"trace_id": "t" * 32, "parent_id": "p" * 16}
+
+
+# -- span shards -------------------------------------------------------------
+
+
+def test_shard_header_roundtrip_and_ring(tmp_path):
+    p = str(tmp_path / "spans.jsonl")
+    sh = SpanShard(p, cap=16)
+    ctx = new_context()
+    sh.complete(
+        "request.device", time.time(), 0.01,
+        trace_id=ctx["trace_id"], args={"n": 4},
+    )
+    sh.close()
+    with open(p) as f:
+        header = json.loads(f.readline())
+    assert header["kind"] == "kcmc_span_shard"
+    spans = read_span_shard(p)
+    assert len(spans) == 1
+    s = spans[0]
+    assert s["name"] == "request.device"
+    assert s["trace_id"] == ctx["trace_id"]
+    assert s["args"] == {"n": 4}
+    assert s["pid"] == os.getpid()
+    # the in-memory ring serves the live `trace` verb
+    assert sh.tail()[0]["name"] == "request.device"
+
+
+def test_shard_bounded_cap_counts_drops(tmp_path):
+    p = str(tmp_path / "spans.jsonl")
+    sh = SpanShard(p, cap=5)
+    for _ in range(9):
+        sh.complete("request.total", time.time(), 1e-3,
+                    trace_id=mint_trace_id())
+    sh.close()
+    assert len(read_span_shard(p)) == 5  # file capped
+    assert sh.dropped == 4  # overflow counted, never torn
+    assert len(sh.tail()) == 5  # ring ages out oldest
+
+
+def test_shard_torn_tail_truncation_recovery(tmp_path):
+    p = str(tmp_path / "spans.jsonl")
+    sh = SpanShard(p)
+    for i in range(4):
+        sh.complete("request.total", time.time(), 1e-3,
+                    trace_id=mint_trace_id(), args={"i": i})
+    sh.close()
+    # tear the final line mid-object, the kill -9 disk state
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-9])
+    spans = read_span_shard(p)
+    assert [s["args"]["i"] for s in spans] == [0, 1, 2]
+    # an unparseable HEADER is a hard error (not a span shard at all)
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"kind": "kcmc_span_shard"')
+    with pytest.raises(ValueError):
+        read_span_shard(str(bad))
+
+
+def test_shard_survives_real_sigkill_mid_write(tmp_path):
+    """A child process SIGKILLed while appending spans leaves a shard
+    the reader recovers without error — every complete line parses."""
+    p = str(tmp_path / "spans.jsonl")
+    child = subprocess.Popen(
+        [
+            sys.executable, "-c",
+            "import sys, time\n"
+            "from kcmc_tpu.obs.tracing import SpanShard, mint_trace_id\n"
+            f"sh = SpanShard({p!r}, cap=1_000_000)\n"
+            "print('armed', flush=True)\n"
+            "while True:\n"
+            "    sh.complete('request.total', time.time(), 1e-4,\n"
+            "                trace_id=mint_trace_id())\n",
+        ],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert child.stdout.readline().strip() == "armed"
+        deadline = time.monotonic() + 30
+        while os.path.getsize(p) < 4096 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+            child.wait(timeout=30)
+    spans = read_span_shard(p)  # must not raise
+    assert len(spans) >= 1
+    assert all(len(s["trace_id"]) == 32 for s in spans)
+
+
+def test_shard_write_failure_never_raises(tmp_path):
+    p = str(tmp_path / "spans.jsonl")
+    sh = SpanShard(p)
+    sh._fh.close()  # simulate the disk yanked mid-run
+    sh.complete("request.total", time.time(), 1e-3,
+                trace_id=mint_trace_id())  # must swallow, not raise
+    assert sh.tail()  # the ring still works
+    sh.close()
+
+
+# -- collection / stitching --------------------------------------------------
+
+
+def _one_trace(shard, dur_device=0.03, n=4):
+    client = new_context()
+    shard.complete("rpc.client", time.time(), dur_device + 0.01,
+                   trace_id=client["trace_id"],
+                   span_id=client["span_id"])
+    ch = child_context(client)
+    shard.complete("request.device", time.time(), dur_device,
+                   trace_id=ch["trace_id"], parent_id=ch["parent_id"],
+                   args={"n": n})
+    shard.complete("request.queue_wait", time.time(), 0.001,
+                   trace_id=ch["trace_id"], parent_id=ch["parent_id"],
+                   args={"n": n})
+    shard.complete("request.total", time.time(),
+                   dur_device + 0.002, trace_id=ch["trace_id"],
+                   parent_id=ch["parent_id"], args={"n": n})
+    return client["trace_id"]
+
+
+def test_collect_stitch_critical_path_slowest(tmp_path):
+    a = SpanShard(str(tmp_path / "a.jsonl"))
+    tid_fast = _one_trace(a, dur_device=0.01)
+    tid_slow = _one_trace(a, dur_device=0.5)
+    a.close()
+    # files, dirs, and already-loaded lists all collect
+    spans = collect_spans([str(tmp_path)])
+    assert spans == collect_spans([str(tmp_path / "a.jsonl")])
+    assert spans == collect_spans([spans])
+    traces = stitch(spans)
+    assert set(traces) == {tid_fast, tid_slow}
+    cp = critical_path(traces[tid_slow])
+    assert cp["dominant"] == "request.device"
+    # span weight = dur * n telescopes against per-frame histograms
+    assert cp["segments"]["request.device"] == pytest.approx(
+        0.5 * 4, rel=1e-6
+    )
+    rows = slowest(traces, n=1)
+    assert rows[0]["trace_id"] == tid_slow
+    # untraced spans stitch to no trace
+    assert stitch([{"name": "x", "dur_s": 1.0}]) == {}
+
+
+def test_chrome_trace_export(tmp_path):
+    sh = SpanShard()
+    _one_trace(sh)
+    out = chrome_trace(sh.tail())
+    events = out["traceEvents"]
+    names = {e["name"] for e in events}
+    assert "request.device" in names and "process_name" in names
+    x = [e for e in events if e["ph"] == "X"]
+    assert all(e["ts"] > 0 and "trace_id" in e["args"] for e in x)
+    json.dumps(out)  # must be serializable as written
+
+
+# -- exemplars ---------------------------------------------------------------
+
+
+def test_exemplar_store_buckets_like_the_histogram():
+    from kcmc_tpu.obs.latency import LatencyHistogram
+
+    store = ExemplarStore()
+    h = LatencyHistogram()
+    for v, tid in [(0.001, "a" * 32), (0.25, "b" * 32)]:
+        store.note("request.total", v, tid)
+        h.record(v)
+    exp = store.export()
+    buckets = exp["request.total"]["full"]
+    # the exemplar bucket indices are exactly the histogram's
+    assert set(buckets) == set(h.to_dict()["counts"])
+    top = top_exemplar(exp, "request.total")
+    assert top["trace_id"] == "b" * 32
+    assert top_exemplar(exp, "request.device") is None
+    assert top_exemplar({}, "request.total") is None
+
+
+def test_exemplar_store_last_wins_and_bounded():
+    store = ExemplarStore(cap=3)
+    store.note("request.total", 0.01, "old" + "0" * 29)
+    store.note("request.total", 0.0101, "new" + "0" * 29)  # same bucket
+    exp = store.export()
+    (only,) = exp["request.total"]["full"].values()
+    assert only["trace_id"].startswith("new")
+    for i in range(5):  # distinct buckets overflow the cap
+        store.note("request.device", 10.0 ** (-i), f"{i}" * 32)
+    total = sum(
+        len(b)
+        for rungs in store.export().values()
+        for b in rungs.values()
+    )
+    assert total <= 3
+    store.note("request.total", 0.01, None)  # untraced: no-op
+
+
+def test_exemplar_merge_exports_last_wins():
+    a = {"request.total": {"full": {"9": {"trace_id": "a" * 32,
+                                          "value_s": 0.1}}}}
+    b = {"request.total": {"full": {"9": {"trace_id": "b" * 32,
+                                          "value_s": 0.2}}}}
+    merged = ExemplarStore.merge_exports([a, b, None, "junk"])
+    assert merged["request.total"]["full"]["9"]["trace_id"] == "b" * 32
+
+
+# -- SLO engine --------------------------------------------------------------
+
+
+def _hists(good: int, bad: int) -> dict:
+    """A plane.histograms dict with `good` fast and `bad` slow
+    request.total observations on the full rung."""
+    from kcmc_tpu.obs.latency import LatencyHistogram
+
+    h = LatencyHistogram()
+    if good:
+        h.record(0.01, n=good)
+    if bad:
+        h.record(5.0, n=bad)
+    return {"request.total": {"full": h.to_dict()}}
+
+
+def test_parse_objectives_spec_grammar():
+    from kcmc_tpu.obs.slo import parse_objectives
+
+    objs = parse_objectives("full:0.25:0.99;avail:0.999; ")
+    assert [o.kind for o in objs] == ["latency", "availability"]
+    assert objs[0].rung == "full" and objs[0].threshold_s == 0.25
+    assert objs[1].target == 0.999
+    assert parse_objectives("") == []
+    for bad in ["full:0.25", "avail:2", "full:-1:0.9", "full:0.2:1.5",
+                "avail:0.9:0.9", "full:x:0.9"]:
+        with pytest.raises(ValueError):
+            parse_objectives(bad)
+
+
+def test_slo_burn_nonzero_under_injected_slowdown():
+    from kcmc_tpu.obs.slo import PAGE_BURN, SLOEngine, WINDOWS
+
+    clock = [0.0]
+    eng = SLOEngine("full:0.25:0.99;avail:0.999",
+                    now=lambda: clock[0])
+    # healthy hour: all requests fast, zero burn
+    eng.tick(_hists(good=1000, bad=0), {"frames_done": 1000})
+    clock[0] = 3600.0
+    eng.tick(_hists(good=2000, bad=0), {"frames_done": 2000})
+    burns = eng.burn_rates()
+    assert burns["latency_full_lt_0.25s"]["5m"] == 0.0
+    assert eng.alerts() == []
+    # injected slowdown: from here every new request is slow — the
+    # cumulative bad count grows while good stalls, so the bad
+    # fraction of every window's delta is 1.0 against a 1% budget
+    clock[0] += 300.0
+    eng.tick(_hists(good=2000, bad=700), {"frames_done": 2700})
+    clock[0] += 3600.0
+    eng.tick(_hists(good=2000, bad=1400), {"frames_done": 3400})
+    burns = eng.burn_rates()
+    for w in WINDOWS:
+        assert burns["latency_full_lt_0.25s"][w] > 1.0, (w, burns)
+    assert burns["latency_full_lt_0.25s"]["5m"] >= PAGE_BURN
+    alerts = eng.alerts()
+    assert any(a.startswith("PAGE slo=latency_full") for a in alerts)
+    hb = eng.heartbeat()
+    assert hb.startswith("slo burn 5m=") and "ALERTS=" in hb
+
+
+def test_slo_page_requires_both_fast_windows():
+    """The multi-window AND: a 5-minute blip must not page when the
+    1-hour window is still healthy."""
+    from kcmc_tpu.obs.slo import SLOEngine
+
+    clock = [0.0]
+    eng = SLOEngine("full:0.25:0.99", now=lambda: clock[0])
+    # a long healthy hour dilutes the 1h window
+    eng.tick(_hists(good=100_000, bad=0), {})
+    clock[0] = 3600.0
+    eng.tick(_hists(good=200_000, bad=0), {})
+    # short sharp blip: 100% bad for one 5m sample
+    clock[0] += 300.0
+    eng.tick(_hists(good=200_000, bad=300), {})
+    burns = eng.burn_rates()["latency_full_lt_0.25s"]
+    assert burns["5m"] > burns["1h"]
+    assert eng.alerts() == [], burns
+
+
+def test_slo_availability_objective_counts_rejections():
+    from kcmc_tpu.obs.slo import SLOEngine
+
+    clock = [0.0]
+    eng = SLOEngine("avail:0.999", now=lambda: clock[0])
+    eng.tick({}, {"frames_done": 0, "rejected_frames": 0})
+    for i in range(1, 5):  # cumulative: 10% of frames rejected
+        clock[0] += 300.0
+        eng.tick({}, {"frames_done": 900 * i,
+                      "rejected_frames": 100 * i})
+    burns = eng.burn_rates()["availability"]
+    assert burns["5m"] == pytest.approx(100.0, rel=0.01)  # 10%/0.1%
+
+
+def test_render_slo_prometheus_lines_and_absence():
+    from kcmc_tpu.obs.slo import SLOEngine, render_slo_prometheus
+
+    assert render_slo_prometheus(None) == []
+    assert render_slo_prometheus({}) == []
+    eng = SLOEngine("full:0.25:0.99")
+    eng.tick(_hists(good=10, bad=0), {})
+    lines = render_slo_prometheus(eng.gauges())
+    text = "\n".join(lines)
+    assert 'kcmc_slo_burn_rate{objective="latency_full_lt_0.25s"' in text
+    assert 'window="5m"' in text and 'window="3d"' in text
+    assert 'kcmc_slo_target{objective="latency_full_lt_0.25s"} 0.99' \
+        in text
+    assert "kcmc_slo_alerts 0" in text
+    # every TYPE has a HELP (the exposition format contract)
+    types = {l.split()[2] for l in lines if l.startswith("# TYPE")}
+    helps = {l.split()[2] for l in lines if l.startswith("# HELP")}
+    assert types == helps
+
+
+# -- report rendering --------------------------------------------------------
+
+
+def test_report_renders_critical_path_from_shards(tmp_path):
+    from kcmc_tpu.obs.report import load_run, render_report, _json_summary
+
+    sh = SpanShard(str(tmp_path / "spans.jsonl"))
+    for _ in range(3):
+        _one_trace(sh, dur_device=0.1)
+    sh.close()
+    for src in (str(tmp_path / "spans.jsonl"), str(tmp_path)):
+        run = load_run(src)
+        text = render_report(run)
+        assert "Critical path (3 traced requests" in text
+        assert "request.device" in text and "slowest:" in text
+        cp = _json_summary(run, top=5)["critical_path"]
+        assert cp["dominant"] == {"request.device": 3}
+        assert len(cp["slowest"]) == 3
+
+
+def test_report_critical_path_dash_on_pre_tracing_artifacts(tmp_path):
+    from kcmc_tpu.obs.report import load_run, render_report, _json_summary
+
+    p = tmp_path / "frames.jsonl"
+    p.write_text(
+        json.dumps({"kind": "kcmc_frame_records", "version": 1}) + "\n"
+        + json.dumps({"frame": 0, "n_inliers": 10}) + "\n"
+    )
+    run = load_run(str(p))
+    text = render_report(run)
+    assert "Critical path: —" in text  # present, dashed, no crash
+    assert _json_summary(run, top=5)["critical_path"] is None
