@@ -468,6 +468,16 @@ def _cmd_serve(args) -> int:
         overrides["trace_shard_dir"] = args.trace_shards
     if getattr(args, "slo", ""):
         overrides["slo_objectives"] = args.slo
+    # Latency QoS (docs/SERVING.md "Latency QoS"): scheduling-timing
+    # knobs only — SIG_NEUTRAL, never change per-frame results.
+    if getattr(args, "latency_fill_floor", None) is not None:
+        overrides["serve_latency_fill_floor"] = args.latency_fill_floor
+    if getattr(args, "no_latency_admission", False):
+        overrides["serve_latency_admission"] = False
+    if getattr(args, "starvation_limit", None) is not None:
+        overrides["serve_latency_starvation_limit"] = (
+            args.starvation_limit
+        )
     args.reference = ref
     args.overrides = overrides
     from kcmc_tpu.serve.server import serve_main
@@ -997,6 +1007,29 @@ def main(argv=None) -> int:
         "avail:fraction entries, e.g. 'full:0.5:0.99;avail:0.999'; "
         "multi-window burn rates ride the metrics verb as kcmc_slo_* "
         "gauges and the heartbeat",
+    )
+    p.add_argument(
+        "--latency-fill-floor", type=float, default=None,
+        metavar="FRAC",
+        help="deadline-QoS fill floor (serve_latency_fill_floor; "
+        "default 0): a deadline-forced partial window below this "
+        "fraction of batch_size defers while slack remains, so "
+        "trickle traffic cannot collapse throughput "
+        "(docs/SERVING.md 'Latency QoS')",
+    )
+    p.add_argument(
+        "--no-latency-admission", action="store_true",
+        help="disable predictive admission "
+        "(serve_latency_admission=False): submits whose predicted "
+        "wait exceeds their deadline are admitted anyway instead of "
+        "being rejected 429 with a predicted_wait_s hint",
+    )
+    p.add_argument(
+        "--starvation-limit", type=int, default=None, metavar="N",
+        help="batch-class starvation bound "
+        "(serve_latency_starvation_limit; default 4): after N "
+        "consecutive latency-class preemptions a waiting batch "
+        "session takes the dispatch slot unconditionally",
     )
     p.set_defaults(fn=_cmd_serve)
 

@@ -369,6 +369,36 @@ class CorrectorConfig:
     # the rebuild warm-boots through the persistent compile cache when
     # configured). 0 = never quarantine.
     serve_backend_strikes: int = 2
+    # -- latency QoS (docs/SERVING.md "Latency QoS"). All scheduling
+    # WHEN, never WHAT: deadlines steer dispatch timing and window
+    # sizing, per-frame results stay bit-identical (the PR-7 bucket
+    # parity contract extends to batch rungs).
+    # Minimum window fill a deadline-forced PARTIAL dispatch needs,
+    # as a fraction of batch_size (0.0 = deadlines always win): below
+    # the floor a blown deadline defers instead of dispatching, so
+    # pathological trickle traffic (one frame per tight deadline)
+    # cannot collapse throughput to one-frame windows. The deferred
+    # window dispatches as soon as the floor is reached (counted as a
+    # `fill_floor` dispatch) or the full-window path fires.
+    serve_latency_fill_floor: float = 0.0
+    # Predictive admission gate: when True, a `submit_frames` carrying
+    # a deadline the horizon model already predicts will be missed is
+    # rejected 429-style with the `predicted_wait_s` hint (consistent
+    # with the fleet watermark hint) instead of admitted to miss.
+    # False = deadlines only steer dispatch, never admission.
+    serve_latency_admission: bool = True
+    # Horizon-model refresh cadence, seconds: how often the scheduler
+    # recomputes its cached dispatch horizon (predicted batch_form +
+    # dispatch + device p50 from the live segment histograms). The
+    # same rate-limiting idea as the SLO tick — the model must cost
+    # nothing on the dispatch path.
+    serve_latency_horizon_refresh_s: float = 1.0
+    # Batch-class starvation bound: after this many consecutive
+    # latency-class preemptions while a batch-class session had ready
+    # frames, that session gets a guaranteed dispatch slot (its aging
+    # credit resets; the grant is counted in `stats`). Lower = fairer
+    # to batch, higher = tighter latency-class tails.
+    serve_latency_starvation_limit: int = 4
     # -- fleet router (serve/fleet.py + serve/router.py; CLI
     # `kcmc_tpu router` — docs/SERVING.md "Running a fleet"). All
     # resume-signature neutral: they schedule WHERE sessions run and
@@ -874,6 +904,23 @@ class CorrectorConfig:
                 "serve_backend_strikes must be >= 0 failures (0 = "
                 f"never quarantine), got {self.serve_backend_strikes}"
             )
+        if not 0.0 <= self.serve_latency_fill_floor <= 1.0:
+            raise ValueError(
+                "serve_latency_fill_floor must be in [0, 1] (0 = "
+                "deadlines always win), got "
+                f"{self.serve_latency_fill_floor}"
+            )
+        if self.serve_latency_horizon_refresh_s <= 0:
+            raise ValueError(
+                "serve_latency_horizon_refresh_s must be positive "
+                f"seconds, got {self.serve_latency_horizon_refresh_s}"
+            )
+        if self.serve_latency_starvation_limit < 1:
+            raise ValueError(
+                "serve_latency_starvation_limit must be >= 1 "
+                "preemption (a batch session must eventually run), got "
+                f"{self.serve_latency_starvation_limit}"
+            )
         if self.fleet_probe_interval_s <= 0:
             raise ValueError(
                 "fleet_probe_interval_s must be positive seconds, got "
@@ -1087,6 +1134,14 @@ SIG_NEUTRAL_FIELDS = frozenset(
         "serve_session_timeout_s",
         "serve_io_timeout_s",
         "serve_backend_strikes",
+        # Latency QoS (PR 20): deadlines and fill floors schedule WHEN
+        # a window dispatches and at WHICH batch rung it pads — the
+        # bucket parity contract pins every rung to the full-window
+        # values, so these steer timing only, never results.
+        "serve_latency_fill_floor",
+        "serve_latency_admission",
+        "serve_latency_horizon_refresh_s",
+        "serve_latency_starvation_limit",
         # Fleet router (PR 16): placement/health/autoscale knobs move
         # sessions BETWEEN replicas — the migration contract already
         # guarantees a moved stream computes the same frames, so none

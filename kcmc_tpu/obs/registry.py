@@ -89,6 +89,31 @@ INSTANT_NAMES = frozenset(
 # Counter series.
 COUNTER_NAMES = frozenset({"frames_done"})
 
+# Dispatch-decision explainability counters (serve/scheduler.py,
+# docs/SERVING.md "Latency QoS"): every dispatch records exactly one
+# `why` — the full vocabulary of reasons a window left the queue.
+# Emitted as SpanShard.counter(...) instants when tracing is armed and
+# mirrored in the scheduler's `stats` payload; the `why` also rides
+# the per-batch request.dispatch span as an arg.
+DISPATCH_WHY_COUNTERS = frozenset(
+    {
+        # the window filled to batch_size — the throughput-optimal case
+        "dispatch.why.full_window",
+        # head-of-line deadline minus the dispatch horizon went
+        # negative: a partial window dispatched NOW on the smallest
+        # covering batch-ladder rung
+        "dispatch.why.deadline_forced",
+        # a latency-class session jumped the weighted round-robin
+        "dispatch.why.preempted",
+        # a deadline-forced partial deferred by serve_latency_fill_floor
+        # fired once the window reached the floor
+        "dispatch.why.fill_floor",
+        # a partial window with no deadline pressure (tail/trickle
+        # drain — the pre-QoS scheduler's only partial case)
+        "dispatch.why.flush",
+    }
+)
+
 # Request-lifecycle latency segments (obs/latency.py): the shared
 # vocabulary of the per-request telemetry plane — every
 # `SegmentLatencies.observe(...)` site in serve/scheduler.py,
@@ -149,6 +174,7 @@ SPAN_NAMES = (
     | FEEDER_SPANS
     | INSTANT_NAMES
     | COUNTER_NAMES
+    | DISPATCH_WHY_COUNTERS
     | REQUEST_SEGMENTS
     | JOURNAL_SPANS
     | FLEET_SPANS
@@ -189,5 +215,10 @@ TIMING_KEYS = frozenset(
         # serve session finalize and RunTelemetry.finish, rendered by
         # obs/report.py and the `metrics` verb consumers
         "latency",
+        # deadline-QoS section (serve/session.py finalize): qos_class,
+        # deadline hit/miss counts, preemption exposure — rendered as
+        # the "Deadline QoS" table by obs/report.py; absent on every
+        # pre-QoS artifact (the table renders "—", never crashes)
+        "deadline_qos",
     }
 )

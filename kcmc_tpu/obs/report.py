@@ -310,6 +310,37 @@ def _latency_table(timing: dict) -> list[str]:
     return lines
 
 
+def _deadline_qos_table(timing) -> list[str]:
+    """The "Deadline QoS" report section (docs/SERVING.md "Latency
+    QoS"): the session's scheduling class and its deadline scorecard
+    from `timing["deadline_qos"]`. Always present, like the critical-
+    path table: artifacts that predate latency QoS (or batch-class runs
+    that never touched a deadline) render the em dash rather than
+    omitting the section — and never crash, whatever shape the artifact
+    has."""
+    dq = (timing or {}).get("deadline_qos") if isinstance(
+        timing, dict
+    ) else None
+    if not isinstance(dq, dict) or not dq:
+        return [
+            "Deadline QoS: — (no latency-class activity in this "
+            "artifact)"
+        ]
+    hits = int(dq.get("deadline_hits") or 0)
+    misses = int(dq.get("deadline_misses") or 0)
+    rate = (
+        f"{100.0 * hits / (hits + misses):.1f}%"
+        if (hits + misses) else "—"
+    )
+    return [
+        "Deadline QoS:",
+        f"  class={dq.get('qos_class') or '—'}"
+        f" deadline_hits={hits} deadline_misses={misses}"
+        f" hit_rate={rate}"
+        f" preempted_dispatches={int(dq.get('preempted_dispatches') or 0)}",
+    ]
+
+
 def _critical_path_summary(spans) -> dict | None:
     """Per-request dominant-segment histogram from distributed-tracing
     span shards: {n_traces, dominant: {segment: count}, slowest:
@@ -507,6 +538,8 @@ def render_report(run: dict, top: int = 10) -> str:
                 f"  quarantined checkpoint parts: {rb['quarantined_parts']}"
             )
     lines.append("")
+    lines.extend(_deadline_qos_table(run.get("timing")))
+    lines.append("")
     lines.extend(_critical_path_table(run.get("spans")))
     return "\n".join(lines) + "\n"
 
@@ -619,6 +652,9 @@ def _json_summary(run: dict, top: int) -> dict:
         # SAME schema as the serve `metrics` verb (one schema,
         # asserted in tests); None on pre-latency-plane artifacts
         "latency": (timing or {}).get("latency"),
+        # the Deadline QoS scorecard (class, hits/misses, preempted
+        # dispatches); None on pre-QoS artifacts and batch-class runs
+        "deadline_qos": (timing or {}).get("deadline_qos"),
         "metrics": metrics,
         "worst_frames": [
             r.get("frame") for r in _worst_frames(records, top)

@@ -64,17 +64,26 @@ _LATENCY_SEGMENT = "request.total"
 
 class Objective:
     """One declarative target. kind is "latency" (rung + threshold_s
-    + target) or "availability" (target only)."""
+    + target) or "availability" (target only). Latency objectives
+    carry a `qos_class`: the "latency" rung IS the latency scheduling
+    class (serve/session.py records latency-class streams under it),
+    and every other rung — "full", "degraded", or the "batch"
+    pseudo-rung that folds both — measures batch-class traffic, so
+    per-class SLOs need no grammar beyond the existing rung slot."""
 
-    __slots__ = ("kind", "rung", "threshold_s", "target", "name")
+    __slots__ = (
+        "kind", "rung", "threshold_s", "target", "name", "qos_class"
+    )
 
     def __init__(self, kind, target, rung=None, threshold_s=None):
         self.kind = kind
         self.target = float(target)
         self.rung = rung
         self.threshold_s = threshold_s
+        self.qos_class = None
         if kind == "latency":
             self.name = f"latency_{rung}_lt_{threshold_s:g}s"
+            self.qos_class = "latency" if rung == "latency" else "batch"
         else:
             self.name = "availability"
 
@@ -86,6 +95,7 @@ class Objective:
         if self.kind == "latency":
             d["rung"] = self.rung
             d["threshold_s"] = self.threshold_s
+            d["qos_class"] = self.qos_class
         return d
 
 
@@ -129,22 +139,35 @@ def parse_objectives(spec: str) -> list[Objective]:
     return objectives
 
 
+# The "batch" pseudo-rung: batch-class traffic spans the healthy and
+# degraded rungs (a degraded stream is still batch-class work), so a
+# batch-class objective folds both — exact, bucket counts are
+# integers. The "latency" rung needs no fold: latency-class streams
+# record under it natively.
+_BATCH_FOLD = ("full", "degraded")
+
+
 def _good_total_latency(hists: dict, rung: str, threshold_s: float):
     """(good, total) cumulative counts for one latency objective from
     a `plane.histograms`-shaped dict — exact, because bucket counts
     are integers and the threshold is resolved to a bucket edge. A
-    request is "good" when its bucket's upper edge ≤ threshold."""
+    request is "good" when its bucket's upper edge ≤ threshold. The
+    "batch" pseudo-rung folds the full + degraded rungs (the batch
+    QoS class); any concrete rung reads its own series."""
     rungs = hists.get(_LATENCY_SEGMENT) or {}
-    d = rungs.get(rung)
-    if not isinstance(d, dict):
-        return 0, 0
+    sources = _BATCH_FOLD if rung == "batch" else (rung,)
     thr_ns = int(threshold_s * 1e9)
     k = bisect_right(_EDGES_NS, thr_ns)  # buckets [0, k) are good
-    good = 0
-    for idx, c in (d.get("counts") or {}).items():
-        if int(idx) < k:
-            good += int(c)
-    return good, int(d.get("count", 0))
+    good = total = 0
+    for r in sources:
+        d = rungs.get(r)
+        if not isinstance(d, dict):
+            continue
+        for idx, c in (d.get("counts") or {}).items():
+            if int(idx) < k:
+                good += int(c)
+        total += int(d.get("count", 0))
+    return good, total
 
 
 def _good_total_availability(counters: dict):
@@ -292,6 +315,20 @@ def render_slo_prometheus(slo: dict) -> list[str]:
     Returns [] for payloads without the section (pre-PR snapshots)."""
     if not isinstance(slo, dict) or not slo.get("objectives"):
         return []
+    # objective -> qos_class, for the per-class labels below (absent
+    # on pre-QoS payloads and availability objectives — those lines
+    # simply omit the label, so old scrapes keep parsing)
+    classes = {
+        obj.get("name"): obj.get("qos_class")
+        for obj in slo.get("objectives") or []
+        if isinstance(obj, dict)
+    }
+
+    def _labels(name: str, extra: str = "") -> str:
+        qc = classes.get(name)
+        cls = f',qos_class="{qc}"' if qc else ""
+        return f'objective="{name}"{cls}{extra}'
+
     lines = [
         "# HELP kcmc_slo_burn_rate Error-budget burn rate per"
         " objective and window (1.0 = sustainable).",
@@ -303,8 +340,9 @@ def render_slo_prometheus(slo: dict) -> list[str]:
             v = (burns[name] or {}).get(w)
             if v is None:
                 continue
+            window = f',window="{w}"'
             lines.append(
-                f'kcmc_slo_burn_rate{{objective="{name}",window="{w}"}}'
+                f"kcmc_slo_burn_rate{{{_labels(name, window)}}}"
                 f" {float(v):.9g}"
             )
     lines.append(
@@ -314,7 +352,7 @@ def render_slo_prometheus(slo: dict) -> list[str]:
     for obj in slo.get("objectives") or []:
         if isinstance(obj, dict) and obj.get("name"):
             lines.append(
-                f'kcmc_slo_target{{objective="{obj["name"]}"}}'
+                f'kcmc_slo_target{{{_labels(obj["name"])}}}'
                 f" {float(obj.get('target', 0.0)):.9g}"
             )
     lines.append(

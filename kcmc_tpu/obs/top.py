@@ -94,6 +94,35 @@ def render(metrics: dict, stats: dict, addr: str) -> str:
         sup_bits.append(f"degraded_batches={c['degraded_batches']}")
     lines.append("supervisor: " + " ".join(sup_bits))
 
+    # Deadline QoS header line — only when the plane has QoS activity
+    # (pre-QoS replicas and idle batch-only planes stay one line
+    # shorter). Stats block preferred; flat counters are the fleet
+    # fallback (merge_fleet_metrics sums them across replicas).
+    dq = stats.get("deadline_qos") or {}
+    pre = int(dq.get("preemptions", c.get("preemptions", 0)))
+    starve = int(
+        dq.get("starvation_grants", c.get("starvation_grants", 0))
+    )
+    rej = int(
+        dq.get(
+            "rejected_deadline_submits",
+            c.get("rejected_deadline_submits", 0),
+        )
+    )
+    hits = int(dq.get("deadline_hits", c.get("deadline_hits", 0)))
+    misses = int(dq.get("deadline_misses", c.get("deadline_misses", 0)))
+    if pre or starve or rej or hits or misses:
+        rate = (
+            f"{100.0 * hits / (hits + misses):.1f}%"
+            if (hits + misses) else "—"
+        )
+        lines.append(
+            "deadline qos: "
+            f"hit_rate={rate} ({hits}/{hits + misses}) "
+            f"preemptions={pre} starvation_grants={starve} "
+            f"admission_rejects={rej}"
+        )
+
     # Fleet block: present when the payload came from a router (or
     # was merged from several replicas by the multi-target poll).
     fleet = metrics.get("fleet")
@@ -152,17 +181,25 @@ def render(metrics: dict, stats: dict, addr: str) -> str:
     sessions = metrics.get("sessions") or {}
     lines.append("")
     lines.append(
-        f"  {'session':<12} {'tenant':<12} {'frames':>8} {'fps':>8}"
-        f" {'queued':>7} {'deg':>4} {'p50':>10} {'p99':>10}"
+        f"  {'session':<12} {'tenant':<12} {'class':<8} {'frames':>8}"
+        f" {'fps':>8} {'queued':>7} {'deg':>4} {'dl-hit':>7}"
+        f" {'p50':>10} {'p99':>10}"
     )
     for sid in sorted(sessions):
         s = sessions[sid]
         tot = (s.get("totals") or {}).get("request.total") or {}
+        # pre-QoS payloads carry neither field: render "—", never crash
+        klass = str(s.get("qos_class") or "—")
+        dh = int(s.get("deadline_hits", 0))
+        dm = int(s.get("deadline_misses", 0))
+        dl_hit = f"{100.0 * dh / (dh + dm):.0f}%" if (dh + dm) else "—"
         lines.append(
             f"  {sid:<12} {str(s.get('tenant', '?')):<12}"
+            f" {klass:<8}"
             f" {s.get('frames', 0):>8} {s.get('fps', 0.0):>8.1f}"
             f" {s.get('queued', 0):>7}"
             f" {'yes' if s.get('degraded') else 'no':>4}"
+            f" {dl_hit:>7}"
             f" {_ms(tot.get('p50_s')):>10} {_ms(tot.get('p99_s')):>10}"
         )
     if not sessions:
@@ -180,6 +217,13 @@ def _merge_stats(stats_by: dict) -> dict:
         "backend_rebuilding": False,
         "loop_beat_age_s": 0.0,
     }
+    dq = {
+        "preemptions": 0,
+        "starvation_grants": 0,
+        "rejected_deadline_submits": 0,
+        "deadline_hits": 0,
+        "deadline_misses": 0,
+    }
     for st in stats_by.values():
         s = (st or {}).get("supervisor") or {}
         sup["backend_strikes"] += int(s.get("backend_strikes", 0))
@@ -188,7 +232,10 @@ def _merge_stats(stats_by: dict) -> dict:
         sup["loop_beat_age_s"] = max(
             sup["loop_beat_age_s"], float(s.get("loop_beat_age_s", 0.0))
         )
-    return {"supervisor": sup}
+        d = (st or {}).get("deadline_qos") or {}
+        for k in dq:
+            dq[k] += int(d.get(k, 0))
+    return {"supervisor": sup, "deadline_qos": dq}
 
 
 def main(args) -> int:

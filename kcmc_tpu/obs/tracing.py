@@ -203,6 +203,28 @@ class SpanShard:
                 else:
                     self.dropped += 1
 
+    # Registry-checked counter emitter (same contract as `complete`):
+    # a zero-duration span carrying an increment, so counter series —
+    # the dispatch-`why` vocabulary — ride the shard and the span-
+    # registry pass verifies every literal name used here.
+    def counter(
+        self,
+        name: str,
+        t0: float,
+        n: int = 1,
+        *,
+        trace_id: str | None = None,
+        args: dict | None = None,
+    ) -> None:
+        """Record one counter increment as a zero-duration span."""
+        self.complete(
+            name,
+            t0,
+            0.0,
+            trace_id=trace_id,
+            args={**(args or {}), "n": int(n)},
+        )
+
     def tail(self, n: int | None = None) -> list[dict]:
         """Most recent spans from the in-memory ring (newest last)."""
         with self._lock:

@@ -74,3 +74,37 @@ def route_shape(
         if h <= bh and w <= bw:
             return (bh, bw)
     return None
+
+
+def batch_ladder(batch_size: int) -> tuple[int, ...]:
+    """The halving batch-bucket ladder under `batch_size`: every power
+    of two below it, plus the full window itself — e.g. 8 -> (1, 2, 4,
+    8), 12 -> (1, 2, 4, 8, 12). The serve scheduler's deadline-forced
+    partial dispatch pads a short window to the smallest covering rung
+    (same quantized-shape-space argument as the (H, W) buckets: each
+    rung is one compiled program, and a 3-frame window on the 4-rung
+    beats paying the full-window batch latency)."""
+    b = int(batch_size)
+    if b < 1:
+        raise ValueError(f"batch_size must be >= 1, got {batch_size!r}")
+    out: list[int] = []
+    rung = 1
+    while rung < b:
+        out.append(rung)
+        rung *= 2
+    out.append(b)
+    return tuple(out)
+
+
+def route_batch(n: int, ladder: tuple[int, ...]) -> int | None:
+    """The smallest ladder rung covering `n` frames, or None when no
+    rung covers it (n exceeds the full window — the caller splits the
+    window instead). Ladder is ascending by construction
+    (`batch_ladder`), so the first cover is the smallest."""
+    n = int(n)
+    if n < 1:
+        return None
+    for rung in ladder:  # ascending: first cover is the smallest
+        if n <= rung:
+            return int(rung)
+    return None

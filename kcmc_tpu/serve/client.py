@@ -358,18 +358,30 @@ class ServeClient:
         output_dtype: str = "float32",
         compression: str = "none",
         session_id: str | None = None,
+        qos_class: str = "batch",
+        deadline_ms: float | None = None,
     ) -> str:
         """Open a stream. Pass `session_id` (a client-chosen id) to
         make the open idempotent across reconnect retries — a retry
         whose first attempt actually succeeded server-side re-attaches
-        instead of double-opening."""
+        instead of double-opening.
+
+        `qos_class` ("latency" | "batch", default "batch") declares
+        the session's scheduling class (docs/SERVING.md "Latency
+        QoS"): latency-class sessions may preempt the dispatch window
+        and dispatch partial windows against their deadlines.
+        `deadline_ms` sets a session-default per-frame deadline
+        (milliseconds from submit); per-submit values override it."""
         fields: dict = {
             "tenant": tenant,
             "weight": weight,
             "emit": emit,
             "output_dtype": output_dtype,
             "compression": compression,
+            "qos_class": str(qos_class),
         }
+        if deadline_ms is not None:
+            fields["deadline_ms"] = float(deadline_ms)
         if reference is not None:
             fields["reference"] = proto.encode_array(
                 np.asarray(reference, np.float32)
@@ -458,19 +470,29 @@ class ServeClient:
             # client never tracked one).
         return {k: v for k, v in resp.items() if k != "ok"}
 
-    def submit(self, session: str, frames: np.ndarray) -> dict:
+    def submit(
+        self,
+        session: str,
+        frames: np.ndarray,
+        deadline_ms: float | None = None,
+    ) -> dict:
         """Submit frames; returns the admission decision
         ``{"accepted", "queued", "degraded", "deduped", "next"}``.
         Raises ServeError with ``code == 429`` when the session queue
-        is full. Idempotent: every call carries the session-global
-        index of its first frame, so a reconnect-retried submit never
-        double-processes a frame. The cursor read-send-update is
-        atomic under the client lock, so threads sharing one client
-        interleave whole submits, never halves."""
+        is full — or when predictive admission rejects a `deadline_ms`
+        the horizon model already predicts will be missed (the error's
+        ``.info["predicted_wait_s"]`` carries the hint). Idempotent:
+        every call carries the session-global index of its first
+        frame, so a reconnect-retried submit never double-processes a
+        frame. The cursor read-send-update is atomic under the client
+        lock, so threads sharing one client interleave whole submits,
+        never halves."""
         fields: dict = {
             "session": session,
             "frames": proto.encode_array(np.asarray(frames)),
         }
+        if deadline_ms is not None:
+            fields["deadline_ms"] = float(deadline_ms)
         with self._lock:
             first = self._next.get(session)
             if first is not None:

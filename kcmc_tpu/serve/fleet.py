@@ -295,16 +295,42 @@ def merge_fleet_metrics(
     }
 
 
-def predicted_wait_s(merged_metrics: dict, queued: int, capacity: int):
+def predicted_wait_s(
+    merged_metrics: dict, queued: int, capacity: int,
+    qos_class: str | None = None,
+):
     """Admission-rejection hint: a rough expected wait for new work
     given the fleet's merged end-to-end latency and current backlog.
     p50(request.total) scaled by the backlog fraction — deliberately a
     HINT (the schema says so), not a promise; None when the fleet has
-    no latency history yet."""
-    tot = (
-        ((merged_metrics or {}).get("plane") or {}).get("totals") or {}
-    ).get("request.total") or {}
-    p50 = tot.get("p50_s")
+    no latency history yet.
+
+    `qos_class` scopes the p50 to one scheduling class (docs/
+    SERVING.md "Latency QoS"): "latency" reads the latency rung's
+    histogram, "batch" folds full + degraded — both exact merges of
+    the per-rung series `merge_fleet_metrics` already carries. A class
+    with no history (or a pre-QoS payload) falls back to the
+    class-blind total, so routers probing old replicas keep working."""
+    plane = ((merged_metrics or {}).get("plane") or {})
+    p50 = None
+    if qos_class is not None:
+        rungs = (plane.get("histograms") or {}).get("request.total") or {}
+        fold = (
+            ("latency",) if qos_class == "latency"
+            else ("full", "degraded")
+        )
+        h = None
+        for r in fold:
+            d = rungs.get(r)
+            if not isinstance(d, dict):
+                continue
+            hr = LatencyHistogram.from_dict(d)
+            h = hr if h is None else h.merge(hr)
+        if h is not None and h.count:
+            p50 = h.quantile(50)
+    if p50 is None:
+        tot = (plane.get("totals") or {}).get("request.total") or {}
+        p50 = tot.get("p50_s")
     if p50 is None or capacity <= 0:
         return None
     return round(float(p50) * (1.0 + queued / capacity), 4)

@@ -11,14 +11,23 @@ Ops (docs/SERVING.md has the full field tables):
 
 * ``open_session`` — tenant/weight/reference/template_update/emit/
   output(+expected_frames)/output_dtype [+ session (a client-chosen
-  id — the idempotency key for reconnect-retried opens)] ->
-  ``{"session": id}``
+  id — the idempotency key for reconnect-retried opens)]
+  [+ qos_class ("latency" | "batch", default "batch" — the session's
+  scheduling class; docs/SERVING.md "Latency QoS")]
+  [+ deadline_ms (session-default per-frame deadline, milliseconds
+  from submit)] -> ``{"session": id}``
 * ``submit_frames`` — session + frames [+ first (the session-global
   index of this call's first frame — the idempotency key: a retried
   submit's overlap with already-admitted frames is deduplicated, and
-  a `first` past the cursor is a gap error)] -> admission decision
+  a `first` past the cursor is a gap error)] [+ deadline_ms
+  (per-frame deadline for THIS call's frames, milliseconds from now;
+  overrides the session default)] [+ replay (router-internal: marks a
+  migration re-delivery, which predictive admission never re-judges)]
+  -> admission decision
   ``{"accepted", "queued", "degraded", "deduped", "next"}`` (or a
-  429-coded error when rejected)
+  429-coded error when rejected — with ``predicted_wait_s`` when the
+  predictive-admission horizon model rejected a deadline it already
+  predicts will be missed)
 * ``results`` — session [+ timeout] -> next undelivered span of
   per-frame outputs (blocks until available)
 * ``close_session`` — session [+ timeout] -> final merged outputs

@@ -714,13 +714,15 @@ class FleetRouter:
         replica, in order, with their original `first` indices (the
         idempotent-replay contract absorbs any overlap)."""
         with self._lock:
-            entries = sorted(self._buffers.get(sid) or [])
+            entries = sorted(
+                self._buffers.get(sid) or [], key=lambda e: e[0]
+            )
             trace_ctx = self._session_trace.get(sid)
         # replayed frames carry the session's remembered trace context
         # — the survivor's segment spans stitch into the SAME trace
         trace_kw = {"trace": trace_ctx} if trace_ctx else {}
         next_needed = int(cursor)
-        for first, n, enc in entries:
+        for first, n, enc, abs_dl in entries:
             if first + n <= next_needed:
                 continue
             if first > next_needed:
@@ -732,13 +734,26 @@ class FleetRouter:
                 )
             lo = next_needed - first
             payload = _enc_slice(enc, lo) if lo else enc
+            dl_kw = {}
+            if abs_dl is not None:
+                # back to relative-remaining: whatever budget survived
+                # the migration is what the new replica schedules to
+                # (0 floors an already-blown deadline rather than
+                # rejecting the replay)
+                dl_kw["deadline_ms"] = max(
+                    0.0, (abs_dl - time.time()) * 1000.0
+                )
             pool.get(replica).call(
                 "submit_frames",
                 session=sid,
                 frames=payload,
                 first=next_needed,
                 idempotent=True,
+                # re-delivery, not new work: predictive admission must
+                # not 429 a stream mid-migration
+                replay=True,
                 **trace_kw,
+                **dl_kw,
             )
             next_needed = first + n
 
@@ -849,7 +864,9 @@ class FleetRouter:
         raise AssertionError("unreachable")  # pragma: no cover
 
     def _op_open(self, msg: dict, pool: _UpstreamPool) -> dict:
-        reject = self._admission_reject()
+        reject = self._admission_reject(
+            qos_class=msg.get("qos_class") or None
+        )
         if reject is not None:
             return reject
         sid = str(msg.get("session") or f"fr-{uuid.uuid4().hex[:12]}")
@@ -868,6 +885,14 @@ class FleetRouter:
             rid = bound
         else:
             rid = place(sid, placeable)
+            if msg.get("qos_class") == "latency":
+                # latency-class streams chase the replica with the
+                # lowest per-class predicted wait; rendezvous placement
+                # stands when no replica has an estimate yet (cold
+                # fleet), so pre-QoS behavior is unchanged
+                best = self._lowest_wait_rid(placeable, "latency")
+                if best is not None:
+                    rid = best
         with self._lock:
             replica = self._replicas[rid]
         fields = {k: v for k, v in msg.items() if k != "op"}
@@ -886,7 +911,37 @@ class FleetRouter:
             self._counters["sessions_routed"] += 1
         return resp
 
-    def _admission_reject(self) -> dict | None:
+    def _lowest_wait_rid(
+        self, placeable: list[str], qos_class: str
+    ) -> str | None:
+        """Rank placeable replicas by their OWN per-class predicted
+        wait (scrape-snapshot histograms x local backlog). Returns None
+        when no replica has a usable estimate — the caller keeps its
+        rendezvous pick, so a cold fleet places exactly as before."""
+        want = set(placeable)
+        with self._lock:
+            snaps = [
+                (r.rid, r.last_metrics, r.queue_depth())
+                for r in self._replicas.values()
+                if r.rid in want and r.last_metrics is not None
+            ]
+        best_rid, best_wait = None, None
+        for rid, metrics, depth in snaps:
+            queued = int(
+                (metrics.get("gauges") or {}).get("queued_frames", 0)
+            )
+            wait = predicted_wait_s(
+                metrics, queued, max(int(depth), 1), qos_class=qos_class
+            )
+            if wait is None:
+                continue
+            if best_wait is None or wait < best_wait:
+                best_rid, best_wait = rid, wait
+        return best_rid
+
+    def _admission_reject(
+        self, qos_class: str | None = None
+    ) -> dict | None:
         watermark = float(self.config.fleet_queue_watermark)
         if watermark >= 1.0:
             return None
@@ -895,7 +950,9 @@ class FleetRouter:
         limit = int(watermark * capacity)
         if capacity <= 0 or queued <= limit:
             return None
-        hint = predicted_wait_s(self.fleet_metrics(), queued, capacity)
+        hint = predicted_wait_s(
+            self.fleet_metrics(), queued, capacity, qos_class=qos_class
+        )
         with self._lock:
             self._counters["sessions_rejected"] += 1
         resp = {
@@ -923,19 +980,33 @@ class FleetRouter:
                 self._session_trace[sid] = tr
         first = msg.get("first")
         if first is not None:
-            self._buffer_frames(sid, int(first), msg["frames"])
+            # the buffer stamps deadlines ABSOLUTE: a migration replay
+            # happens later, and the client's budget keeps draining
+            # while the router recovers the stream
+            dl = msg.get("deadline_ms")
+            abs_dl = (
+                time.time() + float(dl) / 1000.0 if dl is not None
+                else None
+            )
+            self._buffer_frames(sid, int(first), msg["frames"], abs_dl)
         return self._forward(
             sid, msg, pool, idempotent=first is not None
         )
 
-    def _buffer_frames(self, sid: str, first: int, enc: dict) -> None:
+    def _buffer_frames(
+        self,
+        sid: str,
+        first: int,
+        enc: dict,
+        abs_deadline: float | None = None,
+    ) -> None:
         n = _enc_nframes(enc)
         with self._lock:
             buf = self._buffers.setdefault(sid, [])
             # replace a replayed duplicate instead of stacking it
             buf[:] = [e for e in buf if e[0] != first]
-            buf.append((first, n, enc))
-            buf.sort()
+            buf.append((first, n, enc, abs_deadline))
+            buf.sort(key=lambda e: e[0])
             total = sum(e[1] for e in buf)
             while buf and total > BUFFER_CAP_FRAMES:
                 total -= buf[0][1]

@@ -46,18 +46,28 @@ import numpy as np
 
 from kcmc_tpu.obs.latency import SegmentLatencies
 from kcmc_tpu.obs.log import advise
+from kcmc_tpu.plans.buckets import batch_ladder, route_batch
 
 
 class OverloadedError(RuntimeError):
     """429-style admission rejection: the session's queue is full even
-    after QoS degradation engaged. Carries `.code` for transports."""
+    after QoS degradation engaged — or, with predictive admission, a
+    deadline the horizon model already predicts will be missed
+    (`predicted_wait_s` carries the prediction so clients can back off
+    an informed amount). Carries `.code` for transports."""
 
     code = 429
 
-    def __init__(self, message: str, queued: int, limit: int):
+    def __init__(
+        self, message: str, queued: int, limit: int,
+        predicted_wait_s: float | None = None,
+    ):
         super().__init__(message)
         self.queued = int(queued)
         self.limit = int(limit)
+        self.predicted_wait_s = (
+            float(predicted_wait_s) if predicted_wait_s is not None else None
+        )
 
 
 class StreamScheduler:
@@ -78,6 +88,33 @@ class StreamScheduler:
         self.journal_dir = cfg.serve_journal_dir
         self.journal_every = cfg.serve_journal_every
         self.session_timeout_s = cfg.serve_session_timeout_s
+        # Latency QoS (docs/SERVING.md "Latency QoS"): the deadline-
+        # aware dispatch knobs, plus the halving batch-bucket ladder a
+        # deadline-forced partial window pads to (smallest covering
+        # rung — a smaller compiled program is a faster one).
+        self.fill_floor = cfg.serve_latency_fill_floor
+        self.admission_predict = cfg.serve_latency_admission
+        self.horizon_refresh_s = cfg.serve_latency_horizon_refresh_s
+        self.starvation_limit = cfg.serve_latency_starvation_limit
+        self._rungs = batch_ladder(self.B)
+        # Dispatch-horizon model cache (predicted seconds from "dispatch
+        # now" to results, per rung): recomputed from the live latency
+        # histograms at most every horizon_refresh_s — scheduling
+        # decisions read a dict, not quantile math.
+        self._horizon_cache: dict | None = None
+        self._horizon_last = -float("inf")
+        # Bounded-starvation ledger: batch-class sessions a latency
+        # preemption skipped while they had ready frames accumulate
+        # credit; at serve_latency_starvation_limit one gets the next
+        # slot unconditionally (credit reset, grant counted).
+        self._starve_credit: dict[str, int] = {}
+        # Latency sessions whose deadline-forced partial is being held
+        # below serve_latency_fill_floor (the dispatch that finally
+        # fires records why="fill_floor").
+        self._floor_deferred: set = set()
+        # (shape, rung) partial-window programs already background-
+        # compiled for latency streams (see _maybe_warm_partial_rungs).
+        self._rung_warm_started: set = set()
         # The serve plane's OWN fault-plan instance, for the surfaces
         # the plane (not a session) owns: `scheduler` here, `transport`
         # in serve/server.py's handler. Sessions arm their own plans
@@ -205,6 +242,23 @@ class StreamScheduler:
             "backend_rebuilds": 0,  # quarantine->rebuild cycles started
             "sessions_resumed": 0,  # journal resumes served
             "sessions_reaped": 0,  # stale sessions journaled + closed
+            # latency QoS (PR 20, docs/SERVING.md "Latency QoS")
+            "preemptions": 0,  # latency dispatches that jumped the WRR
+            "starvation_grants": 0,  # starved batch sessions given a slot
+            "rejected_deadline_submits": 0,  # predictive-admission 429s
+            "deadline_hits": 0,  # folded from sessions at close
+            "deadline_misses": 0,
+            # Every dispatch records exactly one `why` (the literal
+            # keys ARE the registry-checked counter vocabulary —
+            # obs/registry.py DISPATCH_WHY_COUNTERS; mirrored as
+            # SpanShard counters when tracing is armed).
+            "dispatch_why": {
+                "dispatch.why.full_window": 0,
+                "dispatch.why.deadline_forced": 0,
+                "dispatch.why.preempted": 0,
+                "dispatch.why.fill_floor": 0,
+                "dispatch.why.flush": 0,
+            },
         }
 
     # -- lifecycle ---------------------------------------------------------
@@ -299,10 +353,14 @@ class StreamScheduler:
         compression: str = "none",
         session_id: str | None = None,
         telemetry: bool = True,
+        qos_class: str = "batch",
+        deadline_ms: float | None = None,
     ):
         """Open a stream: builds a per-session corrector view sharing
         the warm backend, registers it with the fairness schedule, and
-        returns the `Session`."""
+        returns the `Session`. `qos_class` ("latency" | "batch") picks
+        the scheduling class; `deadline_ms` is the session-default
+        per-frame deadline (docs/SERVING.md "Latency QoS")."""
         from kcmc_tpu.serve.session import Session
 
         view = self.mc.stream_view(
@@ -340,6 +398,7 @@ class StreamScheduler:
                 expected_frames=expected_frames, output_dtype=output_dtype,
                 compression=compression, telemetry=telemetry,
                 trace_shard=self.trace_shard, exemplars=self._exemplars,
+                qos_class=qos_class, deadline_ms=deadline_ms,
             )
             if self.journal_dir:
                 from kcmc_tpu.serve.journal import SessionJournal
@@ -536,7 +595,8 @@ class StreamScheduler:
 
     def submit(
         self, session_id: str, frames, first: int | None = None,
-        trace: dict | None = None,
+        trace: dict | None = None, deadline_ms: float | None = None,
+        replay: bool = False,
     ):
         """Admission-controlled submit. Returns a decision dict
         ``{"accepted", "queued", "degraded", "next"}``; raises
@@ -555,7 +615,15 @@ class StreamScheduler:
         `trace` is the request's distributed-trace context (the
         server's span for this call, obs/tracing.py): the admitted
         frames inherit it, so their queue/dispatch/device/drain spans
-        and bucket exemplars name the originating trace id."""
+        and bucket exemplars name the originating trace id.
+
+        `deadline_ms` stamps this call's frames with a per-frame
+        deadline (milliseconds from now; overrides the session
+        default). With `serve_latency_admission` on, a submit whose
+        PREDICTED wait — the dispatch-horizon model plus the plane's
+        backlog in device-p50 units — already exceeds its deadline is
+        rejected 429-style up front with `predicted_wait_s`, instead
+        of being admitted into a miss (docs/SERVING.md "Latency QoS")."""
         t_call = time.perf_counter()  # request.total's anchor
         frames = np.asarray(frames)
         if frames.ndim == 2:
@@ -598,6 +666,38 @@ class StreamScheduler:
                     "(submit less per call, or wait for results)",
                     queued=queued, limit=self.queue_depth,
                 )
+            eff_dl = deadline_ms if deadline_ms is not None else (
+                sess.deadline_ms
+            )
+            # `replay` marks a migration re-delivery: admission already
+            # ran once when the client first submitted these frames, and
+            # rejecting them now would strand the stream mid-migrate —
+            # prediction never re-judges spent budget.
+            if (
+                self.admission_predict and eff_dl is not None and n
+                and not replay
+            ):
+                predicted = self._predicted_wait_locked(sess, queued + n)
+                if (
+                    predicted is not None
+                    and predicted > float(eff_dl) / 1000.0
+                ):
+                    # Reject-with-hint: admitting would only manufacture
+                    # a deadline miss — tell the client how long the
+                    # plane predicts it would actually take.
+                    self._stats["rejected_submits"] += 1
+                    self._stats["rejected_frames"] += n
+                    self._stats["rejected_deadline_submits"] += 1
+                    raise OverloadedError(
+                        f"session {session_id}: predicted wait "
+                        f"{predicted:.3f}s exceeds the "
+                        f"{float(eff_dl) / 1000.0:.3f}s deadline "
+                        "(predictive admission — retry later, relax "
+                        "deadline_ms, or disable "
+                        "serve_latency_admission)",
+                        queued=queued, limit=self.queue_depth,
+                        predicted_wait_s=round(predicted, 4),
+                    )
             engage = (
                 not sess.degraded
                 and self.watermark < 1.0
@@ -606,7 +706,7 @@ class StreamScheduler:
             # Validate/admit BEFORE flipping QoS state: a mis-shaped
             # submit raises here and must not leave the session
             # permanently degraded by load it never added.
-            sess.add_frames(frames)
+            sess.add_frames(frames, deadline_ms=deadline_ms)
             self._stats["accepted_frames"] += n
             if sess.lat is not None and n:
                 # Per-request lifecycle tracing (obs/latency.py): each
@@ -615,7 +715,7 @@ class StreamScheduler:
                 # (t_call, t_admitted) stamps seed queue_wait/total.
                 t_adm = time.perf_counter()
                 sess._t_submit.extend([(t_call, t_adm)] * n)
-                rung = "degraded" if sess.degraded else "full"
+                rung = sess._rung()
                 sess.lat.observe(
                     "request.admission", t_adm - t_call, n=n, rung=rung,
                 )
@@ -716,6 +816,13 @@ class StreamScheduler:
             self._closed_ids.discard(self._closed_order[0])
         self._closed_order.append(sess.sid)
         self._closed_ids.add(sess.sid)
+        # Fold the stream's deadline scorecard into the plane counters
+        # (same exactly-once close seam as the latency fold below) and
+        # drop its QoS ledger entries.
+        self._stats["deadline_hits"] += int(sess.deadline_hits)
+        self._stats["deadline_misses"] += int(sess.deadline_misses)
+        self._starve_credit.pop(sess.sid, None)
+        self._floor_deferred.discard(sess.sid)
         # Fold the stream's latency histograms into the plane rollup
         # exactly once — finalize has already closed its delivery
         # segments, so nothing records into `sess.lat` after this and
@@ -747,6 +854,18 @@ class StreamScheduler:
         with self._lock:
             sessions = list(self._sessions.values())
             st = dict(self._stats)
+            # deep-copy the nested why dict (the shallow dict() above
+            # shares it with the scheduler thread's increments) and
+            # fold LIVE sessions' deadline scorecards over the closed
+            # accumulator, all under the plane lock
+            why = dict(self._stats["dispatch_why"])
+            d_hits = st["deadline_hits"] + sum(
+                s.deadline_hits for s in sessions
+            )
+            d_misses = st["deadline_misses"] + sum(
+                s.deadline_misses for s in sessions
+            )
+            qos_classes = {s.sid: s.qos_class for s in sessions}
             inflight = len(self._window)
             # backlog() walks session queues the scheduler mutates —
             # snapshot it under the plane lock, not after it
@@ -806,6 +925,21 @@ class StreamScheduler:
                 "sessions_reaped": st["sessions_reaped"],
                 "journal_dir": self.journal_dir,
             },
+            # deadline-QoS explainability (docs/SERVING.md "Latency
+            # QoS"): the dispatch-decision vocabulary, the fairness
+            # counters bounding batch-class starvation, and the plane's
+            # deadline scorecard (closed sessions + live)
+            "deadline_qos": {
+                "dispatch_why": why,
+                "preemptions": st["preemptions"],
+                "starvation_grants": st["starvation_grants"],
+                "rejected_deadline_submits": st[
+                    "rejected_deadline_submits"
+                ],
+                "deadline_hits": d_hits,
+                "deadline_misses": d_misses,
+                "qos_classes": qos_classes,
+            },
         }
         if robustness:
             out["robustness"] = robustness
@@ -855,6 +989,13 @@ class StreamScheduler:
             strikes = self._strikes
             rebuilding = self._rebuilding
             beat_age = time.monotonic() - self._loop_beat
+            why = dict(self._stats["dispatch_why"])
+            d_hits = st["deadline_hits"] + sum(
+                s.deadline_hits for s in sessions
+            )
+            d_misses = st["deadline_misses"] + sum(
+                s.deadline_misses for s in sessions
+            )
             # Merge INSIDE the plane lock: a session folding into
             # _lat_closed (close happens under this lock) between the
             # live-session snapshot and these merges would otherwise be
@@ -870,7 +1011,14 @@ class StreamScheduler:
                     "fps": round(float(snap.get("fps", 0.0)), 2),
                     "queued": queues.get(s.sid, 0),
                     "degraded": bool(degraded.get(s.sid)),
+                    "qos_class": snap.get("qos_class", "batch"),
                 }
+                for k in (
+                    "deadline_hits", "deadline_misses",
+                    "preempted_dispatches",
+                ):
+                    if k in snap:
+                        entry[k] = snap[k]
                 if s.lat is not None:
                     plane.merge_from(s.lat)
                     rep = s.lat.report()
@@ -901,6 +1049,19 @@ class StreamScheduler:
                 "backend_rebuilds": st["backend_rebuilds"],
                 "sessions_resumed": st["sessions_resumed"],
                 "sessions_reaped": st["sessions_reaped"],
+                # deadline QoS — flat ints so merge_fleet_metrics'
+                # counter summation folds them across replicas
+                "preemptions": st["preemptions"],
+                "starvation_grants": st["starvation_grants"],
+                "rejected_deadline_submits": st[
+                    "rejected_deadline_submits"
+                ],
+                "deadline_hits": d_hits,
+                "deadline_misses": d_misses,
+                **{
+                    k.replace("dispatch.why.", "dispatch_why_"): v
+                    for k, v in why.items()
+                },
             },
             "gauges": {
                 "sessions_open": len(sessions),
@@ -1030,6 +1191,128 @@ class StreamScheduler:
 
     # -- QoS ----------------------------------------------------------------
 
+    def _horizon_model(self) -> dict:
+        """The dispatch-horizon model (plane lock taken; reentrant from
+        the pick path): per-segment p50s from the live PR-15 latency
+        histograms — closed-session rollup merged with every live
+        session, the same exact-merge plane view `metrics()` serves.
+        Cached for `serve_latency_horizon_refresh_s`, so the scheduling
+        hot path reads a dict, not quantile math. Zeros until the
+        plane has history — callers must treat an all-zero model as
+        "no prediction", never as "instant"."""
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self._horizon_cache is not None
+                and now - self._horizon_last < self.horizon_refresh_s
+            ):
+                return self._horizon_cache
+            self._horizon_last = now
+            plane = SegmentLatencies()
+            plane.merge_from(self._lat_closed)
+            for s in self._sessions.values():
+                if s.lat is not None:
+                    plane.merge_from(s.lat)
+            model = {}
+            for seg in (
+                "request.batch_form", "request.dispatch", "request.device"
+            ):
+                h = plane.segment_total(seg)
+                model[seg] = (
+                    float(h.quantile(50) or 0.0) if h.count else 0.0
+                )
+            self._horizon_cache = model
+            return model
+
+    def _horizon(self, b: int) -> float:
+        """Predicted seconds from "dispatch a b-frame window now" to
+        its results: batch-form p50 + dispatch p50 + device p50 scaled
+        by the rung's share of the full window. 0.0 with no history."""
+        m = self._horizon_model()
+        return (
+            m["request.batch_form"]
+            + m["request.dispatch"]
+            + m["request.device"] * (b / max(self.B, 1))
+        )
+
+    def _predicted_wait_locked(self, sess, queued: int) -> float | None:
+        """Predicted seconds until a frame admitted NOW into `sess`
+        (bringing its queue to `queued`) has results: the horizon
+        model's form+dispatch cost plus the whole plane's backlog —
+        in-flight window entries and every session's queued frames,
+        in full-window units — at device p50 each. None (never
+        reject) until the plane has device history. Plane lock held."""
+        m = self._horizon_model()
+        dev = m["request.device"]
+        if dev <= 0.0:
+            return None
+        total_queued = int(queued)
+        for s in self._sessions.values():
+            if s is not sess:
+                total_queued += s.backlog()
+        backlog_batches = len(self._window) + max(
+            1, -(-total_queued // self.B)
+        )
+        return (
+            m["request.batch_form"]
+            + m["request.dispatch"]
+            + backlog_batches * dev
+        )
+
+    def _latency_take_locked(self, sess, peek: bool = False):
+        """Decide dispatch-NOW vs defer for a ready latency-class
+        session (plane lock held). Returns ``(target_rung, why)`` to
+        dispatch, or None to defer — positive deadline slack against
+        the dispatch horizon buys time for the window to fill, which
+        is what turns the pre-QoS flush-everything behavior into
+        deadline-aware batching. `peek` makes it side-effect-free
+        (the idle-wait preview must mirror this exactly or the loop
+        busy-spins on a deferred session)."""
+        n = sess.ready_count()
+        if n >= self.B:
+            return self.B, "full_window"
+        # growth is impossible past a rolling-template boundary gate
+        # or once the stream is closing — waiting would be a pure
+        # latency tax with zero fill upside
+        can_grow = (not sess.closing) and len(sess.pending) == n
+        head = sess.head_deadline()
+        if head is None:
+            # no deadline signal: the pre-QoS behavior (dispatch the
+            # partial immediately, padded to the full window)
+            return self.B, "flush"
+        target = route_batch(n, self._rungs) or self.B
+        horizon = self._horizon(target)
+        if horizon <= 0.0:
+            # cold plane (no device history yet): deferring would wait
+            # until the deadline INSTANT and then dispatch with zero
+            # margin — flush instead until the model warms
+            return self.B, "flush"
+        slack = head - time.time()
+        if slack > horizon:
+            if can_grow:
+                return None  # the deadline affords waiting for fill
+            return self.B, "flush"
+        # deadline pressure: head-of-line deadline minus horizon went
+        # non-positive — dispatch the partial at the smallest covering
+        # batch-ladder rung, unless the fill floor holds it
+        floor_n = min(int(np.ceil(self.fill_floor * self.B)), self.B)
+        if n < floor_n and slack > 0 and can_grow:
+            # below the fill floor with slack remaining: hold the
+            # forced dispatch (bounded — the deadline itself releases
+            # it), so trickle traffic cannot collapse throughput into
+            # one-frame windows
+            if not peek:
+                self._floor_deferred.add(sess.sid)
+            return None
+        why = (
+            "fill_floor"
+            if sess.sid in self._floor_deferred
+            else "deadline_forced"
+        )
+        if not peek:
+            self._floor_deferred.discard(sess.sid)
+        return target, why
+
     def _get_degraded_backend(self):
         """The reduced-budget backend overload dispatches through: the
         consensus-stage knobs shrink (hypothesis budgets, refine/polish
@@ -1121,6 +1404,54 @@ class StreamScheduler:
                 stacklevel=2,
             )
 
+    def _maybe_warm_partial_rungs(self, sess) -> None:
+        """Kick a background compile of the PRIMARY backend's batch
+        programs for the partial batch-ladder rungs of `sess`'s frame
+        shape, once per (shape, rung). Only latency-class streams
+        trigger it — they are the only ones whose deadline-forced
+        dispatches pad to partial rungs — and, like the degraded warm,
+        it runs right after the reference is prepared so the first
+        forced partial never pays a JIT inline at peak deadline
+        pressure."""
+        if sess.qos_class != "latency" or sess.ref_frame is None:
+            return
+        shape = tuple(sess.frame_shape)
+        with self._lock:
+            todo = tuple(
+                rung
+                for rung in self._rungs
+                if rung < self.B
+                and (shape, rung) not in self._rung_warm_started
+            )
+            self._rung_warm_started.update((shape, r) for r in todo)
+        if not todo:
+            return
+        ref, ref_frame = sess.ref, sess.ref_frame
+        self._spawn_warmup(
+            self._warm_partial_rungs,
+            "kcmc-serve-rung-warm",
+            args=(shape, todo, ref, ref_frame),
+        )
+
+    def _warm_partial_rungs(self, shape, rungs, ref, ref_frame) -> None:
+        for rung in rungs:
+            try:
+                backend = self.mc.backend
+                dummy = np.broadcast_to(
+                    ref_frame, (rung,) + tuple(shape)
+                ).astype(np.float32)
+                out = backend.process_batch(dummy, ref, np.arange(rung))
+                for v in out.values():
+                    np.asarray(v)  # block until the compile+run finished
+            except Exception as e:
+                advise(
+                    f"kcmc serve: partial-rung warm-up (batch {rung}, "
+                    f"frame shape {shape}) failed ({e}); the first "
+                    "deadline-forced dispatch at that rung compiles "
+                    "inline",
+                    stacklevel=2,
+                )
+
     def _maybe_restore_locked(self, sess) -> None:
         # Hysteresis: quality restores once the backlog drains below
         # half the watermark (not the instant it dips under it).
@@ -1211,7 +1542,7 @@ class StreamScheduler:
         with self._wake:
             picked = self._pick_locked() if self._running else None
         if picked is not None:
-            sess, (n, batch, idx, ref, clock), degraded = picked
+            sess, (n, batch, idx, ref, clock), degraded, why = picked
             backend = self.mc.backend
             if degraded:
                 try:
@@ -1219,7 +1550,7 @@ class StreamScheduler:
                 except Exception:
                     pass  # prewarm already advised; full budgets
             entry = self._dispatch(
-                sess, backend, n, batch, idx, ref, degraded, clock
+                sess, backend, n, batch, idx, ref, degraded, clock, why
             )
             if entry is not None:
                 with self._lock:
@@ -1329,41 +1660,141 @@ class StreamScheduler:
                 sess.fail(e)
             else:
                 self._maybe_warm_degraded_shape(sess)
+                self._maybe_warm_partial_rungs(sess)
 
     def _pick_preview_locked(self):
         """Whether ANY session has dispatchable or finalizable work
-        (idle-wait predicate; does not consume anything)."""
+        (idle-wait predicate; does not consume anything). Mirrors the
+        pick's latency-deferral decision exactly — a deferred latency
+        session must NOT read as dispatchable, or the loop busy-spins
+        instead of idle-waiting (the 0.1s wait bounds the deadline-
+        expiry reaction granularity; documented in PERFORMANCE.md)."""
         for sess in self._sessions.values():
             if sess.error is None and not sess.closed and (
                 sess.ready_count() or sess.needs_reference()
             ):
-                return sess
+                if sess.needs_reference() or sess.qos_class != "latency":
+                    return sess
+                if self._latency_take_locked(sess, peek=True) is not None:
+                    return sess
+                continue
             if sess.closing and not sess.closed and sess.drained_out():
                 return sess
         return None
 
+    def _ready_batch_sessions_locked(self):
+        """Batch-class sessions with dispatchable frames (lock held) —
+        the preemption fast path's skip set and starvation ledger."""
+        return [
+            s
+            for s in self._sessions.values()
+            if s.qos_class != "latency" and s.error is None
+            and not s.closed and s.ready_count() > 0
+        ]
+
     def _pick_locked(self):
-        """Weighted round-robin pick: returns (session, padded batch,
-        degraded flag) for the next session with ready frames, else
-        None."""
+        """The dispatch pick. Latency-class sessions with deadline
+        pressure (or a full window) jump the weighted round-robin —
+        earliest head-of-line deadline first — with starvation bounded
+        by an aging credit counter: every batch-class session a
+        preemption skips gains credit, and one at
+        `serve_latency_starvation_limit` takes the slot unconditionally
+        before the next jump. Everything else is the weighted
+        round-robin. Returns (session, padded batch, degraded flag,
+        why) or None; `why` is the dispatch-decision vocabulary
+        (obs/registry.py DISPATCH_WHY_COUNTERS)."""
         order = self._order
+        if not order:
+            return None
+        lat_ready = sorted(
+            (
+                s
+                for s in self._sessions.values()
+                if s.qos_class == "latency" and s.error is None
+                and not s.closed and s.ready_count() > 0
+            ),
+            key=lambda s: (
+                d if (d := s.head_deadline()) is not None else float("inf")
+            ),
+        )
+        for sess in lat_ready:
+            take = self._latency_take_locked(sess)
+            if take is None:
+                continue  # deferred: slack buys fill time
+            target, why = take
+            skipped = self._ready_batch_sessions_locked()
+            if skipped:
+                # bounded starvation: a batch session jumped past its
+                # aging limit gets this slot instead of the preemption
+                starved = next(
+                    (
+                        s for s in skipped
+                        if self._starve_credit.get(s.sid, 0)
+                        >= self.starvation_limit
+                    ),
+                    None,
+                )
+                if starved is not None:
+                    try:
+                        taken = starved.take_batch(self.B)
+                    except Exception as e:
+                        starved.fail(e)
+                        taken = None
+                    if taken is not None:
+                        self._starve_credit[starved.sid] = 0
+                        self._stats["starvation_grants"] += 1
+                        why_b = (
+                            "full_window"
+                            if taken[0] >= self.B
+                            else "flush"
+                        )
+                        return starved, taken, starved.degraded, why_b
+            try:
+                taken = sess.take_batch(self.B, target=target)
+            except Exception as e:
+                # Batch-forming failure is that ONE stream's problem
+                # (fail drops its pending frames, so this cannot
+                # respin) — the plane keeps serving.
+                sess.fail(e)
+                continue
+            if taken is None:
+                continue
+            if skipped:
+                self._stats["preemptions"] += 1
+                sess.preempted_dispatches += 1
+                for s in skipped:
+                    self._starve_credit[s.sid] = (
+                        self._starve_credit.get(s.sid, 0) + 1
+                    )
+                if why in ("full_window", "flush"):
+                    # deadline_forced / fill_floor outrank preempted in
+                    # the why vocabulary — they explain the TIMING, the
+                    # jump is visible in the preemption counters either
+                    # way
+                    why = "preempted"
+            self._floor_deferred.discard(sess.sid)
+            return sess, taken, sess.degraded, why
         for i in range(len(order)):
             sid = order[(self._rr + i) % len(order)]
             sess = self._sessions.get(sid)
             if sess is None or sess.closed or sess.error is not None:
                 continue
+            if sess.qos_class == "latency":
+                continue  # taken (or deliberately deferred) above
             if sess.ready_count() > 0:
                 try:
                     taken = sess.take_batch(self.B)
                 except Exception as e:
-                    # Batch-forming failure is that ONE stream's
-                    # problem (fail drops its pending frames, so this
-                    # cannot respin) — the plane keeps serving.
                     sess.fail(e)
                     continue
                 if taken is not None:
                     self._rr = (self._rr + i + 1) % len(order)
-                    return sess, taken, sess.degraded
+                    # a served batch session starts its aging over
+                    self._starve_credit.pop(sid, None)
+                    why = (
+                        "full_window" if taken[0] >= self.B else "flush"
+                    )
+                    return sess, taken, sess.degraded, why
         return None
 
     def _finalize_ready(self) -> None:
@@ -1386,14 +1817,18 @@ class StreamScheduler:
                 self._rebuild_order()
 
     def _dispatch(
-        self, sess, backend, n, batch, idx, ref, degraded, clock=None
+        self, sess, backend, n, batch, idx, ref, degraded, clock=None,
+        why="full_window",
     ):
         """Dispatch one session batch; on a dispatch-time error, flush
         the window first (ordering + the ladder's synthesis template),
         then walk the session's degradation ladder. Returns a window
         entry, or None when the error path already accounted the
         batch. `clock` is the batch's RequestClock (take_batch) — the
-        dispatch segment closes here, device/drain close at drain."""
+        dispatch segment closes here, device/drain close at drain.
+        `why` is the pick's dispatch-decision reason: counted in
+        `stats`, mirrored as a registry-checked SpanShard counter when
+        tracing is armed, and ridden on the request.dispatch span."""
         if (
             not getattr(backend, "accepts_native_dtype", False)
             and batch.dtype != np.float32
@@ -1407,6 +1842,12 @@ class StreamScheduler:
             self._stats["occupied_frames"] += int(n)
             if degraded:
                 self._stats["degraded_batches"] += 1
+            self._stats["dispatch_why"]["dispatch.why." + why] += 1
+        if self.trace_shard is not None:
+            # the same literal vocabulary the _stats seed registers
+            # (obs/registry.py DISPATCH_WHY_COUNTERS) — one counter
+            # instant per dispatch decision on the span shard
+            self.trace_shard.counter("dispatch.why." + why, time.time())
         kept = batch if sess.wants_pixels() else None
         kw = {}
         warm = (
@@ -1450,7 +1891,9 @@ class StreamScheduler:
             )
             return None
         if clock is not None and sess.lat is not None:
-            clock.rung = "degraded" if degraded else "full"
+            clock.rung = "degraded" if degraded else (
+                "latency" if sess.qos_class == "latency" else "full"
+            )
             clock.t_dispatched = time.perf_counter()
             sess.lat.observe(
                 "request.dispatch", clock.t_dispatched - clock.t_formed,
@@ -1461,6 +1904,7 @@ class StreamScheduler:
                     "request.dispatch",
                     clock.t_dispatched - clock.t_formed,
                     n, clock.rung, clock.trace,
+                    args={"why": why},
                 )
         if warm and "transform" in out:
             sess.warm_seed = out["transform"][n - 1]

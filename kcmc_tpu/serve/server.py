@@ -66,6 +66,8 @@ class _Handler(socketserver.StreamRequestHandler):
                     "ok": False, "error": str(e), "code": e.code,
                     "queued": e.queued, "limit": e.limit,
                 }
+                if e.predicted_wait_s is not None:
+                    resp["predicted_wait_s"] = e.predicted_wait_s
             except (KeyError, ValueError, TypeError, TimeoutError) as e:
                 resp = {"ok": False, "error": str(e), "code": 400}
             except Exception as e:  # a stream failure must not kill the server
@@ -172,14 +174,21 @@ class ServeServer:
                 compression=msg.get("compression", "none"),
                 # client-chosen id: the reconnect-retry idempotency key
                 session_id=msg.get("session"),
+                qos_class=msg.get("qos_class", "batch"),
+                deadline_ms=msg.get("deadline_ms"),
             )
             return {"ok": True, "session": sess.sid}
         if op == "submit_frames":
             frames = proto.decode_array(msg["frames"])
             first = msg.get("first")
+            deadline_ms = msg.get("deadline_ms")
             decision = self.scheduler.submit(
                 msg["session"], frames,
                 first=int(first) if first is not None else None,
+                deadline_ms=(
+                    float(deadline_ms) if deadline_ms is not None else None
+                ),
+                replay=bool(msg.get("replay", False)),
                 trace=ctx,
             )
             return {"ok": True, **decision}

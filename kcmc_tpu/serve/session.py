@@ -64,6 +64,8 @@ class Session:
         telemetry: bool = True,
         trace_shard=None,
         exemplars=None,
+        qos_class: str = "batch",
+        deadline_ms: float | None = None,
     ):
         if output is not None and expected_frames is None:
             raise ValueError(
@@ -73,10 +75,26 @@ class Session:
             )
         if weight < 1:
             raise ValueError(f"session weight must be >= 1, got {weight}")
+        if qos_class not in ("latency", "batch"):
+            raise ValueError(
+                f"qos_class must be 'latency' or 'batch', got {qos_class!r}"
+            )
+        if deadline_ms is not None and float(deadline_ms) <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms!r}"
+            )
         self.mc = corrector
         self.sid = str(session_id)
         self.tenant = str(tenant)
         self.weight = int(weight)
+        # Latency QoS (docs/SERVING.md "Latency QoS"): the scheduling
+        # class is immutable for the stream's lifetime (journaled, so a
+        # migrated session keeps it); deadline_ms is the session-default
+        # per-frame deadline a submit may override per call.
+        self.qos_class = str(qos_class)
+        self.deadline_ms = (
+            float(deadline_ms) if deadline_ms is not None else None
+        )
         self.emit_frames = bool(emit_frames)
         self.output = output
         self.expected_frames = expected_frames
@@ -198,6 +216,32 @@ class Session:
         self._t_submit: deque = deque()
         self._t_done: deque = deque()
 
+        # Deadline-QoS state (docs/SERVING.md "Latency QoS"):
+        # `_deadlines` carries one absolute (epoch-seconds) deadline —
+        # or None — per pending frame, aligned with `pending` exactly
+        # like `_t_submit`; take_batch pops the dispatched prefix into
+        # `_inflight_deadlines` (a FIFO of per-batch lists — drains
+        # are in dispatch order, the same invariant `_outs` ordering
+        # rests on) and on_drained scores each against the wall clock.
+        # `_replay_deadlines` holds journal-restored absolute deadlines
+        # keyed by session frame index, consumed as the client replays
+        # those frames — a migrated stream keeps its ORIGINAL deadlines
+        # rather than restarting the clock at resubmit.
+        self._deadlines: deque = deque()
+        self._inflight_deadlines: deque = deque()
+        self._replay_deadlines: dict[int, float] = {}
+        # Outstanding-deadline meta changed since the last durable
+        # snapshot: deadlines arrive on SUBMIT, not drain, so the
+        # forced stop/reap save must not be skipped by the "nothing
+        # new since the last durable frame" cursor check — a migrated
+        # stream would silently drop its pending frames' deadlines.
+        self._deadlines_dirty = False
+        self.deadline_hits = 0
+        self.deadline_misses = 0
+        # Dispatches of this session that jumped the weighted round-
+        # robin (incremented by the scheduler, plane lock held).
+        self.preempted_dispatches = 0
+
         # Distributed-trace plumbing (obs/tracing.py; docs/
         # OBSERVABILITY.md "Distributed tracing"): `trace_shard` is the
         # scheduler's bounded per-process span sink, `exemplars` its
@@ -260,12 +304,14 @@ class Session:
         delivery spans of this stream parent under it."""
         self._trace_ctx = ctx
 
-    def trace_obs(self, seg, dur, n, rung, ctx) -> None:
+    def trace_obs(self, seg, dur, n, rung, ctx, args=None) -> None:
         """Emit one span-shard record (+ latency exemplar) mirroring a
         segment observation. The span's weight — ``dur × n`` — equals
         the same site's histogram-sum contribution, so per-trace span
-        sums telescope against the `metrics` segment sums. No-op
-        without a context; shard/exemplar sinks are each optional."""
+        sums telescope against the `metrics` segment sums. `args`
+        merges extra span attributes (the scheduler rides the dispatch
+        decision's `why` here). No-op without a context; shard/
+        exemplar sinks are each optional."""
         if ctx is None:
             return
         tid = ctx.get("trace_id")
@@ -273,18 +319,31 @@ class Session:
             self._trace_shard.complete(
                 seg, time.time() - dur, dur,
                 trace_id=tid, parent_id=ctx.get("span_id"),
-                args={"n": int(n), "rung": rung},
+                args={"n": int(n), "rung": rung, **(args or {})},
             )
         if self._exemplars is not None and tid:
             self._exemplars.note(seg, dur, tid, rung=rung)
 
-    def add_frames(self, frames) -> int:
+    def _rung(self) -> str:
+        """The (segment, rung) histogram dimension this stream records
+        under. Degradation wins — a degraded stream's tail must never
+        land in a healthy series — then latency-class streams get
+        their own rung, so per-class latency summaries and SLOs fall
+        out of the existing rung dimension with no new plumbing."""
+        if self.degraded:
+            return "degraded"
+        return "latency" if self.qos_class == "latency" else "full"
+
+    def add_frames(self, frames, deadline_ms: float | None = None) -> int:
         """Append admitted frames to the pending queue (admission checks
         happen in the scheduler BEFORE this). Runs on a CLIENT thread
         under the serving plane's one lock, so it only stages work:
         reference preparation (device compute, possibly a JIT) and
         writer construction (file I/O) happen on the scheduler thread
-        (`prepare_reference_now` / first drain)."""
+        (`prepare_reference_now` / first drain). `deadline_ms` (relative
+        to NOW) stamps each of this call's frames with an absolute
+        deadline; None falls back to the session default. Journal-
+        replayed frames keep their original restored deadlines."""
         if self.closing or self.closed:
             raise SessionClosed(f"session {self.sid} is closed")
         frames = np.asarray(frames)
@@ -309,7 +368,23 @@ class Session:
             self.out_dt = np.dtype(frames.dtype)
         if self.ref is None and self._ref_src is None:
             self._ref_src = np.asarray(frames[0], np.float32)
-        self.pending.extend(np.asarray(f) for f in frames)
+        if deadline_ms is None:
+            deadline_ms = self.deadline_ms
+        abs_dl = (
+            time.time() + float(deadline_ms) / 1000.0
+            if deadline_ms is not None
+            else None
+        )
+        base = self.submitted
+        for i, f in enumerate(frames):
+            self.pending.append(np.asarray(f))
+            # a replayed frame's restored deadline (absolute) beats the
+            # resubmit's fresh one — migration must not reset the clock
+            d = self._replay_deadlines.pop(base + i, None)
+            d = d if d is not None else abs_dl
+            self._deadlines.append(d)
+            if d is not None:
+                self._deadlines_dirty = True
         self.submitted += len(frames)
         return len(frames)
 
@@ -406,8 +481,37 @@ class Session:
             "expected_frames": self.expected_frames,
             "output": self.output,
             "compression": self.compression,
+            # Latency-QoS state: the class and session-default deadline
+            # survive a migration, and each pending frame's ABSOLUTE
+            # deadline is journaled keyed by its session frame index —
+            # a resumed stream's replayed frames keep the clock they
+            # were admitted under, not a fresh one.
+            "qos_class": self.qos_class,
+            "deadline_ms": self.deadline_ms,
+            "deadline_hits": int(self.deadline_hits),
+            "deadline_misses": int(self.deadline_misses),
+            "deadlines": self._outstanding_deadlines(),
         }
         return meta, new_outs, tail
+
+    def _outstanding_deadlines(self) -> dict:
+        """Absolute deadlines of every frame past the durable cursor
+        (lock held): in-flight batches first — a resume replays from
+        `done`, so their frames are outstanding too — then the pending
+        queue. Keys are session frame indices (as strings: JSON)."""
+        out: dict[str, float] = {}
+        i = self.done
+        for batch_dl in self._inflight_deadlines:
+            for d in batch_dl:
+                if d is not None:
+                    out[str(i)] = float(d)
+                i += 1
+        i = self.dispatched
+        for d in self._deadlines:
+            if d is not None:
+                out[str(i)] = float(d)
+            i += 1
+        return out
 
     def maybe_journal(self, force: bool = False) -> None:
         """Write a durable snapshot when the cadence (or `force` — the
@@ -420,10 +524,22 @@ class Session:
             done = self.done
             if done <= 0 or not (force or j.due(done)):
                 return
-            if force and done <= j.last_saved:
-                return  # nothing new since the last durable frame
+            if (
+                force and done <= j.last_saved
+                and not self._deadlines_dirty
+            ):
+                # nothing new since the last durable frame — and no
+                # deadline stamped since it either (deadlines change
+                # the meta on submit, with the cursor standing still)
+                return
             meta, new_outs, tail = self._journal_state()
             outs_high = len(self._outs)  # high-water this save covers
+            # consumed by THIS snapshot — cleared here (same lock
+            # hold), so a deadline stamped while the write runs below
+            # re-dirties for the next save instead of being lost
+            was_dirty, self._deadlines_dirty = (
+                self._deadlines_dirty, False
+            )
         arrays: dict = {}
         ref_frame = self.ref_frame
         if ref_frame is not None:
@@ -461,6 +577,11 @@ class Session:
             with self._cond:
                 self._outs_journaled = outs_high
                 self._rb = self._rb_snapshot()
+        elif was_dirty:
+            # failed write: the snapshot never became durable, so the
+            # deadline meta is still pending — re-arm the force path
+            with self._cond:
+                self._deadlines_dirty = True
 
     def restore_from_journal(
         self, meta: dict, segments: list, arrays: dict, journal=None
@@ -492,6 +613,23 @@ class Session:
                 self.out_dt = np.dtype(od)
             nb = meta.get("next_boundary")
             self._next_boundary = int(nb) if nb is not None else None
+            # Latency-QoS state: journal wins over open-time defaults —
+            # a migrated latency stream keeps its class, its session-
+            # default deadline, its hit/miss history, and the ORIGINAL
+            # absolute deadlines of every outstanding frame (consumed
+            # by add_frames as the client replays them).
+            qc = meta.get("qos_class")
+            if qc in ("latency", "batch"):
+                self.qos_class = qc
+            dm = meta.get("deadline_ms")
+            if dm is not None:
+                self.deadline_ms = float(dm)
+            self.deadline_hits = int(meta.get("deadline_hits", 0))
+            self.deadline_misses = int(meta.get("deadline_misses", 0))
+            self._replay_deadlines = {
+                int(k): float(v)
+                for k, v in (meta.get("deadlines") or {}).items()
+            }
             restored = [dict(s) for s in segments]
             if restored:
                 self._outs = restored
@@ -588,29 +726,56 @@ class Session:
             n = min(n, self._next_boundary - self.dispatched)
         return max(n, 0)
 
-    def take_batch(self, B: int):
+    def head_deadline(self) -> float | None:
+        """Earliest absolute deadline among the dispatch-ready pending
+        frames (lock held) — the scheduler's deadline-pressure signal.
+        None when no ready frame carries one."""
+        n = self.ready_count()
+        if n <= 0 or not self._deadlines:
+            return None
+        best = None
+        for i, d in enumerate(self._deadlines):
+            if i >= n:
+                break
+            if d is not None and (best is None or d < best):
+                best = d
+        return best
+
+    def take_batch(self, B: int, target: int | None = None):
         """Pop up to min(ready, B) frames as a padded dispatch batch:
-        (n_valid, frames (B, ...), global indices (B,), ref, clock).
-        Indices are the session's own frame numbers — the RANSAC keys
-        fold them in, so stream results match a one-shot run of the
-        same frames regardless of how submits were sliced into
-        batches. `clock` (a RequestClock, None with latency telemetry
-        off) carries each frame's submit stamp forward; the
+        (n_valid, frames (T, ...), global indices (T,), ref, clock),
+        where T is `target` (a batch-ladder rung covering the take —
+        the deadline-forced partial-dispatch path pads to the smallest
+        covering rung instead of the full window) or B. Indices are
+        the session's own frame numbers — the RANSAC keys fold them
+        in, so stream results match a one-shot run of the same frames
+        regardless of how submits were sliced into batches OR which
+        rung padded them (the parity contract `tests/test_serve_qos.py`
+        pins per rung). `clock` (a RequestClock, None with latency
+        telemetry off) carries each frame's submit stamp forward; the
         queue-wait and batch-formation segments are recorded here."""
         n = min(self.ready_count(), B)
         if n <= 0:
             return None
         t_take = time.perf_counter()
+        pad_to = B if target is None else max(min(int(target), B), n)
         frames = np.stack(self.pending[:n])
         del self.pending[:n]
         idx = np.arange(self.dispatched, self.dispatched + n)
         self.dispatched += n
         self.inflight += 1
+        # stage this batch's deadlines for on_drained's hit/miss
+        # scoring (drains are in dispatch order — see ctor comment)
+        taken_dl = [
+            self._deadlines.popleft() if self._deadlines else None
+            for _ in range(n)
+        ]
+        self._inflight_deadlines.append(taken_dl)
         clock = None
         if self.lat is not None:
             from kcmc_tpu.obs.latency import RequestClock
 
-            rung = "degraded" if self.degraded else "full"
+            rung = self._rung()
             stamps = [
                 self._t_submit.popleft()
                 if self._t_submit
@@ -623,7 +788,7 @@ class Session:
                 self.lat.observe(
                     "request.queue_wait", t_take - t_adm, rung=rung
                 )
-            padded = self.mc._pad_batch(frames, idx, B)
+            padded = self.mc._pad_batch(frames, idx, pad_to)
             t_formed = time.perf_counter()
             self.lat.observe(
                 "request.batch_form", t_formed - t_take, n=n, rung=rung
@@ -644,7 +809,7 @@ class Session:
                     clock.trace,
                 )
             return padded + (self.ref, clock)
-        return self.mc._pad_batch(frames, idx, B) + (self.ref, clock)
+        return self.mc._pad_batch(frames, idx, pad_to) + (self.ref, clock)
 
     def wants_pixels(self) -> bool:
         """Whether drains need the corrected frames materialized: the
@@ -755,6 +920,18 @@ class Session:
                     )
                 for t0f in clock.t_submit[:n]:
                     self._t_done.append((t0f, t_acct))
+            # score this batch's deadlines at result availability —
+            # the drain is when frames become fetchable, so it is the
+            # honest hit/miss boundary (delivery adds client wait)
+            if self._inflight_deadlines:
+                t_wall = time.time()
+                for d in self._inflight_deadlines.popleft():
+                    if d is None:
+                        continue
+                    if t_wall <= d:
+                        self.deadline_hits += 1
+                    else:
+                        self.deadline_misses += 1
             self.done += n
             # plane-locked robustness snapshot for the heartbeat/stats
             # readers (the report object is scheduler-thread-only)
@@ -822,6 +999,7 @@ class Session:
             self.closing = True
             self.pending.clear()
             self._t_submit.clear()  # stays aligned with `pending`
+            self._deadlines.clear()  # likewise
             self._cond.notify_all()
 
     def finalize(self) -> None:
@@ -842,7 +1020,7 @@ class Session:
                 # degraded stream's tail must not land in the healthy
                 # series.
                 t_now = time.perf_counter()
-                rung = "degraded" if self.degraded else "full"
+                rung = self._rung()
                 d_sum = e_sum = 0.0
                 k = 0
                 while self._t_done:
@@ -876,6 +1054,10 @@ class Session:
             deduped = self.deduped_frames
             journal = self.journal
             keep_journal = self.keep_journal or self.error is not None
+            qos = self.qos_class
+            d_hits = self.deadline_hits
+            d_misses = self.deadline_misses
+            preempted = self.preempted_dispatches
         err: BaseException | None = None
         try:
             if self.writer is not None:
@@ -898,6 +1080,18 @@ class Session:
             # carried through the close_session payload and the
             # frame-records run summary
             timing["latency"] = self.lat.report()
+        if qos == "latency" or d_hits or d_misses or preempted:
+            # deadline-QoS section (obs/registry.py TIMING_KEYS;
+            # rendered as the "Deadline QoS" table by obs/report.py) —
+            # only attached when the stream actually had QoS exposure,
+            # so batch streams without deadlines stay byte-identical
+            # to pre-QoS payloads
+            timing["deadline_qos"] = {
+                "qos_class": qos,
+                "deadline_hits": int(d_hits),
+                "deadline_misses": int(d_misses),
+                "preempted_dispatches": int(preempted),
+            }
         merged = merge_outputs(outs)
         corrected = merged.pop("corrected", None)
         transforms = merged.pop("transform", None)
@@ -987,7 +1181,7 @@ class Session:
                 # close the delivery + end-to-end segments for every
                 # frame this fetch hands over
                 t_now = time.perf_counter()
-                rung = "degraded" if self.degraded else "full"
+                rung = self._rung()
                 d_sum = e_sum = 0.0
                 k = 0
                 for _ in range(min(n, len(self._t_done))):
@@ -1053,6 +1247,10 @@ class Session:
             idle = time.monotonic() - self.last_activity
             rb = dict(self._rb)
             rb_deduped = self.deduped_frames
+            qos = self.qos_class
+            d_hits = self.deadline_hits
+            d_misses = self.deadline_misses
+            preempted = self.preempted_dispatches
         elapsed = (
             max(time.perf_counter() - t0, 1e-9)
             if t0 is not None
@@ -1063,7 +1261,13 @@ class Session:
             "frames": done,
             "fps": (done / elapsed) if elapsed else 0.0,
             "idle_s": round(max(idle, 0.0), 1),
+            "qos_class": qos,
         }
+        if d_hits or d_misses:
+            out["deadline_hits"] = int(d_hits)
+            out["deadline_misses"] = int(d_misses)
+        if preempted:
+            out["preempted_dispatches"] = int(preempted)
         if rb_deduped:
             rb["deduped_frames"] = int(rb_deduped)
         if any(

@@ -1,0 +1,465 @@
+"""Deadline-aware serve scheduling (ISSUE 20; docs/SERVING.md
+"Latency QoS").
+
+The contract under test, end to end:
+
+* **partial-window parity** — a deadline-forced partial dispatch,
+  padded to the smallest covering batch-ladder rung, produces
+  transforms equal (<= 1e-4, the `test_serve_parity.py` tolerance) to
+  a one-shot run of the same frames, and the dispatch records a
+  `deadline_forced` why;
+* **bounded starvation** — every batch-class session a latency
+  preemption skips gains aging credit; one at
+  `serve_latency_starvation_limit` takes the slot unconditionally
+  (deterministic white-box property, no scheduler thread);
+* **predictive admission** — a submit whose predicted wait exceeds
+  its deadline is rejected 429-style with a `predicted_wait_s` hint;
+  a COLD plane (no device history) never rejects;
+* **journal round-trip** — a migrated/resumed latency session keeps
+  its class, session-default deadline, hit/miss scorecard, and the
+  ORIGINAL absolute deadlines of outstanding frames;
+* **per-class observability** — SLO objectives carry `qos_class`
+  (Prometheus label included), the fleet wait hint folds per-class
+  rungs, and the report's "Deadline QoS" table renders an em dash
+  (never crashes) on pre-QoS artifacts.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from kcmc_tpu import MotionCorrector
+from kcmc_tpu.obs.latency import LatencyHistogram
+from kcmc_tpu.plans.buckets import batch_ladder, route_batch
+from kcmc_tpu.serve.scheduler import OverloadedError, StreamScheduler
+from kcmc_tpu.utils.synthetic import make_drift_stack
+
+TOL = 1e-4
+MC_KW = dict(
+    model="translation", backend="numpy", batch_size=8,
+    max_keypoints=64, n_hypotheses=32,
+)
+# Effectively uncached horizon model: every pick recomputes from the
+# live histograms, so a test's warm-up history is visible immediately
+# (the 1s default would let picks read a stale cold-plane cache).
+FRESH = dict(serve_latency_horizon_refresh_s=0.001)
+
+
+def _stack(n=24, seed=0, shape=(48, 48)):
+    d = make_drift_stack(
+        n_frames=n, shape=shape, model="translation", max_drift=3.0,
+        seed=seed,
+    )
+    return d.stack.astype(np.float32)
+
+
+def _wait_done(sched, sess, n, timeout=30.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        with sched._lock:
+            if sess.done >= n:
+                return
+        time.sleep(0.02)
+    raise AssertionError(f"session never drained {n} frames")
+
+
+def _whitebox_sched(**cfg):
+    """A scheduler that admits sessions but never dispatches: the
+    `_running` flag flips without the loop thread, so `_pick_locked`
+    can be driven deterministically from the test."""
+    mc = MotionCorrector(**cfg, **MC_KW)
+    sched = StreamScheduler(mc)
+    sched._running = True
+    return sched
+
+
+# -- the batch-bucket ladder (plans/buckets.py) -----------------------------
+
+
+def test_batch_ladder_and_route():
+    assert batch_ladder(8) == (1, 2, 4, 8)
+    assert batch_ladder(12) == (1, 2, 4, 8, 12)
+    assert batch_ladder(1) == (1,)
+    with pytest.raises(ValueError, match="batch_size"):
+        batch_ladder(0)
+    ladder = batch_ladder(8)
+    assert route_batch(1, ladder) == 1
+    assert route_batch(3, ladder) == 4  # smallest covering rung
+    assert route_batch(8, ladder) == 8
+    assert route_batch(9, ladder) is None  # caller splits the window
+    assert route_batch(0, ladder) is None
+
+
+# -- class plumbing ---------------------------------------------------------
+
+
+def test_qos_class_validated_and_exposed():
+    sched = _whitebox_sched()
+    try:
+        with pytest.raises(ValueError, match="qos_class"):
+            sched.open_session(qos_class="bogus")
+        with pytest.raises(ValueError, match="deadline_ms"):
+            sched.open_session(deadline_ms=-1.0)
+        s = sched.open_session(
+            qos_class="latency", deadline_ms=250.0, session_id="L"
+        )
+        b = sched.open_session(session_id="B")
+        assert s.qos_class == "latency" and s.deadline_ms == 250.0
+        assert b.qos_class == "batch" and b.deadline_ms is None
+        assert s.snapshot()["qos_class"] == "latency"
+        st = sched.stats()["deadline_qos"]
+        assert st["qos_classes"] == {"L": "latency", "B": "batch"}
+        assert set(st["dispatch_why"]) == {
+            "dispatch.why.full_window", "dispatch.why.deadline_forced",
+            "dispatch.why.preempted", "dispatch.why.fill_floor",
+            "dispatch.why.flush",
+        }
+    finally:
+        sched._running = False
+        sched.stop()
+
+
+# -- partial-window dispatch parity -----------------------------------------
+
+
+def test_deadline_forced_partial_dispatch_is_parity_exact():
+    """The headline contract: trickled latency-class submits with
+    already-blown deadlines dispatch as rung-padded partials
+    (deadline_forced), and the stream's transforms equal a one-shot
+    run — padding rung and batch slicing never leak into results."""
+    stack = _stack(24, seed=5)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+
+    mc = MotionCorrector(
+        serve_latency_admission=False, **FRESH, **MC_KW
+    )
+    sched = StreamScheduler(mc).start()
+    try:
+        # Warm the horizon model: a full batch-class run gives the
+        # plane batch_form/dispatch/device history, so the latency
+        # picks below see horizon > 0 (deadline_forced, not the
+        # cold-plane flush).
+        warm = sched.open_session(tenant="warm")
+        sched.submit(warm.sid, stack, first=0)
+        res_w = sched.close_session(warm.sid, timeout=120)
+        # batch stream that never touched a deadline: payload stays
+        # byte-identical to pre-QoS (no deadline_qos section)
+        assert "deadline_qos" not in res_w.timing
+
+        s = sched.open_session(tenant="lat", qos_class="latency")
+        for i in range(0, len(stack), 3):
+            # 1ms deadline is blown by pick time: every 3-frame chunk
+            # is a forced partial on the 4-rung
+            sched.submit(s.sid, stack[i:i + 3], first=i, deadline_ms=1.0)
+            _wait_done(sched, s, i + 3)
+        res = sched.close_session(s.sid, timeout=120)
+        st = sched.stats()
+    finally:
+        sched.stop()
+    assert res.timing["n_frames"] == len(stack)
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+    dq = st["deadline_qos"]
+    assert dq["dispatch_why"]["dispatch.why.deadline_forced"] >= 1
+    # the stream's close payload carries its class + scorecard
+    assert res.timing["deadline_qos"]["qos_class"] == "latency"
+    scored = (
+        res.timing["deadline_qos"]["deadline_hits"]
+        + res.timing["deadline_qos"]["deadline_misses"]
+    )
+    assert scored == len(stack)  # every deadline-stamped frame scored
+
+
+def test_take_batch_pads_to_target_rung():
+    """take_batch(target=rung) pads to the rung, not the full window —
+    the compiled-program-per-rung contract the prewarm relies on."""
+    sched = _whitebox_sched()
+    try:
+        stack = _stack(8, seed=6)
+        s = sched.open_session(
+            qos_class="latency", reference=stack[0], session_id="P"
+        )
+        sched._prepare_references()
+        sched.submit("P", stack[:3], first=0, deadline_ms=1.0)
+        with sched._lock:
+            rung = route_batch(3, batch_ladder(8))
+            taken = s.take_batch(8, target=rung)
+        assert taken is not None
+        n_valid, frames = taken[0], taken[1]
+        assert n_valid == 3
+        assert frames.shape[0] == rung == 4
+    finally:
+        sched._running = False
+        sched.stop()
+
+
+# -- class-aware preemption + bounded starvation ----------------------------
+
+
+def test_preemption_starvation_bound_is_exact():
+    """Deterministic white-box property: two latency preemptions age a
+    skipped batch session to the limit; the third pick is the
+    starvation grant — the batch session takes the slot, its credit
+    resets, and the counters record exactly 2 preemptions + 1 grant."""
+    stack = _stack(24, seed=7)
+    sched = _whitebox_sched(serve_latency_starvation_limit=2)
+    try:
+        lat = sched.open_session(
+            tenant="lat", reference=stack[0], qos_class="latency",
+            session_id="L",
+        )
+        bat = sched.open_session(
+            tenant="bat", reference=stack[0], session_id="B"
+        )
+        sched._prepare_references()
+        # cold plane: predictive admission must NEVER reject (no
+        # device history yet), even with a 1ms deadline
+        sched.submit("L", stack, first=0, deadline_ms=1.0)
+        sched.submit("B", stack[:8], first=0)
+        with sched._lock:
+            s1, t1, _, why1 = sched._pick_locked()
+            assert s1 is lat and t1[0] == 8
+            assert why1 == "preempted"  # full window, but B was skipped
+            assert sched._starve_credit["B"] == 1
+            s2, _, _, why2 = sched._pick_locked()
+            assert s2 is lat and why2 == "preempted"
+            assert sched._starve_credit["B"] == 2
+            # credit hit the limit: the batch session takes this slot
+            # even though the latency session still has a full window
+            s3, t3, _, why3 = sched._pick_locked()
+            assert s3 is bat and t3[0] == 8
+            assert why3 == "full_window"
+            assert sched._starve_credit["B"] == 0  # aging restarts
+        dq = sched.stats()["deadline_qos"]
+        assert dq["preemptions"] == 2
+        assert dq["starvation_grants"] == 1
+        assert lat.preempted_dispatches == 2
+    finally:
+        sched._running = False
+        sched.stop()
+
+
+# -- predictive admission ---------------------------------------------------
+
+
+def test_predictive_admission_rejects_with_hint():
+    stack = _stack(24, seed=8)
+    mc = MotionCorrector(**FRESH, **MC_KW)
+    sched = StreamScheduler(mc).start()
+    try:
+        warm = sched.open_session(tenant="warm")
+        sched.submit(warm.sid, stack, first=0)
+        sched.close_session(warm.sid, timeout=120)
+
+        s = sched.open_session(tenant="lat", qos_class="latency")
+        # a 1-microsecond deadline is unmeetable against any warm
+        # horizon: predictive admission rejects up front with the hint
+        with pytest.raises(OverloadedError) as ei:
+            sched.submit(s.sid, stack[:4], first=0, deadline_ms=0.001)
+        assert ei.value.predicted_wait_s is not None
+        assert ei.value.predicted_wait_s > 0
+        # the hint matches the scheduler's own model (queue would have
+        # been 4 frames deep)
+        with sched._lock:
+            want = sched._predicted_wait_locked(s, 4)
+        assert want == pytest.approx(
+            ei.value.predicted_wait_s, rel=0.5
+        )
+        with sched._lock:
+            assert s.backlog() == 0  # nothing admitted
+            assert s.submitted == 0
+        dq = sched.stats()["deadline_qos"]
+        assert dq["rejected_deadline_submits"] == 1
+        # the same frames WITHOUT a deadline admit fine
+        d = sched.submit(s.sid, stack[:4], first=0)
+        assert d["accepted"] == 4
+        res = sched.close_session(s.sid, timeout=120)
+        assert res.timing["n_frames"] == 4
+    finally:
+        sched.stop()
+
+
+# -- journal round-trip: class + outstanding deadlines ----------------------
+
+
+def test_journal_roundtrip_preserves_class_and_deadlines(tmp_path):
+    from kcmc_tpu.serve.journal import journal_path
+
+    stack = _stack(24, seed=9)
+    truth = MotionCorrector(**MC_KW).correct(stack)
+
+    mc = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4,
+        **MC_KW,
+    )
+    sched = StreamScheduler(mc).start()
+    s = sched.open_session(
+        tenant="q", session_id="Q1", qos_class="latency",
+        deadline_ms=250.0,
+    )
+    sched.submit(s.sid, stack[:14], first=0, deadline_ms=60000.0)
+    _wait_done(sched, s, 14)
+    # 6 more frames with far-future deadlines: the warm plane's slack
+    # check DEFERS them (deadline affords fill time), so they are
+    # still pending — with live absolute deadlines — at stop()
+    sched.submit(s.sid, stack[14:20], first=14, deadline_ms=60000.0)
+    with sched._lock:
+        orig_deadlines = dict(s._outstanding_deadlines())
+        hits0, misses0 = s.deadline_hits, s.deadline_misses
+    assert hits0 + misses0 == 14  # 60s deadlines: all scored by now
+    sched.stop()
+    assert os.path.exists(journal_path(str(tmp_path), "Q1"))
+
+    mc2 = MotionCorrector(
+        serve_journal_dir=str(tmp_path), serve_journal_every=4,
+        **MC_KW,
+    )
+    sched2 = StreamScheduler(mc2).start()
+    try:
+        sess, cursor, resumed = sched2.resume_session("Q1")
+        assert resumed and cursor == 14
+        # class, session default, and scorecard survive the seam
+        assert sess.qos_class == "latency"
+        assert sess.deadline_ms == 250.0
+        assert (sess.deadline_hits, sess.deadline_misses) == (
+            hits0, misses0
+        )
+        # outstanding frames keep their ORIGINAL absolute deadlines —
+        # a migrated stream's budget keeps burning, it never resets
+        assert set(sess._replay_deadlines) == set(
+            int(k) for k in orig_deadlines
+        )
+        for k, v in orig_deadlines.items():
+            assert sess._replay_deadlines[int(k)] == pytest.approx(
+                v, abs=1e-6
+            )
+        sched2.submit("Q1", stack[cursor:], first=cursor)
+        with sched2._lock:
+            # the replayed frames consumed their restored deadlines
+            assert not sess._replay_deadlines
+        res = sched2.close_session("Q1", timeout=120)
+    finally:
+        sched2.stop()
+    assert res.timing["n_frames"] == 24
+    assert np.abs(res.transforms - truth.transforms).max() < TOL
+    assert res.timing["deadline_qos"]["qos_class"] == "latency"
+
+
+# -- per-class SLOs (obs/slo.py) --------------------------------------------
+
+
+def test_slo_objectives_carry_qos_class():
+    from kcmc_tpu.obs.slo import parse_objectives
+
+    objs = parse_objectives("latency:0.25:0.99;full:2:0.95;avail:0.999")
+    by = {o.name: o for o in objs}
+    lat = by["latency_latency_lt_0.25s"]
+    ful = by["latency_full_lt_2s"]
+    assert lat.qos_class == "latency"
+    assert ful.qos_class == "batch"  # full rung measures batch traffic
+    assert by["availability"].qos_class is None
+    assert lat.describe()["qos_class"] == "latency"
+    assert "qos_class" not in by["availability"].describe()
+
+
+def test_slo_prometheus_has_per_class_labels():
+    from kcmc_tpu.obs.slo import render_slo_prometheus
+
+    slo = {
+        "objectives": [
+            {
+                "name": "latency_latency_lt_0.25s", "kind": "latency",
+                "rung": "latency", "threshold_s": 0.25, "target": 0.99,
+                "qos_class": "latency",
+            },
+            {
+                "name": "latency_batch_lt_2s", "kind": "latency",
+                "rung": "batch", "threshold_s": 2.0, "target": 0.95,
+                "qos_class": "batch",
+            },
+            {"name": "availability", "kind": "availability",
+             "target": 0.999},
+        ],
+        "burn_rates": {}, "alerts": [],
+    }
+    text = "\n".join(render_slo_prometheus(slo))
+    assert 'qos_class="latency"' in text
+    assert 'qos_class="batch"' in text
+    # availability carries no class label (pre-QoS scrape compatible)
+    avail = [
+        ln for ln in text.splitlines()
+        if 'objective="availability"' in ln
+    ]
+    assert avail and all("qos_class" not in ln for ln in avail)
+
+
+# -- fleet per-class wait hint (serve/fleet.py) -----------------------------
+
+
+def _hist_dict(value_s, count):
+    h = LatencyHistogram()
+    h.record(value_s, n=count)
+    return h.to_dict()
+
+
+def test_fleet_predicted_wait_is_class_scoped():
+    from kcmc_tpu.serve.fleet import predicted_wait_s
+
+    metrics = {
+        "plane": {
+            "histograms": {
+                "request.total": {
+                    "latency": _hist_dict(0.01, 20),   # fast class
+                    "full": _hist_dict(1.0, 20),       # slow batch
+                    "degraded": _hist_dict(2.0, 4),
+                },
+            },
+            "totals": {"request.total": {"p50_s": 0.5}},
+        },
+    }
+    w_lat = predicted_wait_s(metrics, 0, 8, qos_class="latency")
+    w_bat = predicted_wait_s(metrics, 0, 8, qos_class="batch")
+    w_any = predicted_wait_s(metrics, 0, 8)
+    assert w_lat is not None and w_bat is not None
+    assert w_lat < w_bat  # the latency rung's history, not the fold
+    assert w_bat > 0.5  # full+degraded fold dominates the blind total
+    assert w_any == pytest.approx(0.5)  # class-blind: totals p50
+    # a class with no history falls back to the class-blind total
+    del metrics["plane"]["histograms"]["request.total"]["latency"]
+    assert predicted_wait_s(
+        metrics, 0, 8, qos_class="latency"
+    ) == pytest.approx(0.5)
+    # pre-QoS payload (no histograms at all): same fallback
+    assert predicted_wait_s(
+        {"plane": {"totals": {"request.total": {"p50_s": 0.5}}}},
+        4, 8, qos_class="latency",
+    ) == pytest.approx(0.75)
+    # no history anywhere: None (never reject blind)
+    assert predicted_wait_s({}, 0, 8, qos_class="latency") is None
+
+
+# -- report surface (obs/report.py) -----------------------------------------
+
+
+def test_report_deadline_qos_table_renders_and_degrades():
+    from kcmc_tpu.obs.report import _deadline_qos_table
+
+    # pre-QoS artifacts (missing / malformed section): em dash, never
+    # a crash
+    for timing in (None, {}, {"deadline_qos": None},
+                   {"deadline_qos": "bogus"}, "not-a-dict"):
+        lines = _deadline_qos_table(timing)
+        assert len(lines) == 1 and "—" in lines[0]
+    lines = _deadline_qos_table({
+        "deadline_qos": {
+            "qos_class": "latency", "deadline_hits": 9,
+            "deadline_misses": 1, "preempted_dispatches": 3,
+        }
+    })
+    body = "\n".join(lines)
+    assert "class=latency" in body
+    assert "hit_rate=90.0%" in body
+    assert "preempted_dispatches=3" in body

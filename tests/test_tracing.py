@@ -437,8 +437,13 @@ def test_render_slo_prometheus_lines_and_absence():
     text = "\n".join(lines)
     assert 'kcmc_slo_burn_rate{objective="latency_full_lt_0.25s"' in text
     assert 'window="5m"' in text and 'window="3d"' in text
-    assert 'kcmc_slo_target{objective="latency_full_lt_0.25s"} 0.99' \
-        in text
+    # the full rung measures batch-class traffic: every line of the
+    # objective carries the per-class label (docs/SERVING.md
+    # "Latency QoS")
+    assert (
+        'kcmc_slo_target{objective="latency_full_lt_0.25s"'
+        ',qos_class="batch"} 0.99'
+    ) in text
     assert "kcmc_slo_alerts 0" in text
     # every TYPE has a HELP (the exposition format contract)
     types = {l.split()[2] for l in lines if l.startswith("# TYPE")}
